@@ -1,0 +1,252 @@
+"""Single dataclass-tree configuration for the whole framework.
+
+Replaces the reference's three-layer config (HfArgumentParser dataclasses +
+DeepSpeed JSON + bash scripts; SURVEY.md §5 "Config / flag system") with one
+serializable tree. Every component takes its sub-config explicitly; presets
+below pin the published model geometries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """Qwen2/Yi-class decoder geometry.
+
+    Defaults are Qwen2-7B-Instruct (the Oryx-7B backbone).
+    """
+
+    vocab_size: int = 152064
+    hidden_size: int = 3584
+    intermediate_size: int = 18944
+    num_layers: int = 28
+    num_heads: int = 28
+    num_kv_heads: int = 4
+    head_dim: int = 128
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-6
+    max_position_embeddings: int = 32768
+    tie_word_embeddings: bool = False
+    # Qwen2 uses bias on q/k/v projections (not o); Yi/Llama-class uses none.
+    attention_bias: bool = True
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    """OryxViT-equivalent geometry: SigLIP-so400m-patch14 derived encoder
+    that accepts arbitrary (h, w) patch grids (SURVEY.md §2 "OryxViT")."""
+
+    hidden_size: int = 1152
+    intermediate_size: int = 4304
+    num_layers: int = 27
+    num_heads: int = 16
+    head_dim: int = 72
+    patch_size: int = 14
+    # Side of the square grid the learned position embedding is stored at;
+    # arbitrary grids are bilinearly interpolated from this (384px / 14).
+    base_grid: int = 27
+    layer_norm_eps: float = 1e-6
+    num_channels: int = 3
+    # Longest packed patch-sequence bucket (see ops/packing.py). 1536 covers
+    # a ~540x540 image at patch 14; larger inputs use more buckets.
+    max_patches_per_image: int = 4096
+
+
+@dataclass(frozen=True)
+class CompressorConfig:
+    """Dynamic Compressor: region pooling + cross-attention + MLP projector
+    into the LLM embedding space (SURVEY.md §2 "Dynamic Compressor")."""
+
+    num_heads: int = 16
+    # Hidden size is taken from VisionConfig; output dim from LLMConfig.
+    # Downsample factors *per spatial side* available at runtime; area
+    # compression is the square (1 -> 1x, 2 -> 4x, 4 -> 16x).
+    side_factors: tuple[int, ...] = (1, 2, 4)
+    projector_hidden_layers: int = 2  # mlp2x_gelu-equivalent
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axes: dp (pure data parallel across slices),
+    fsdp (param/optimizer sharding, ZeRO-3-equivalent), tp (tensor parallel),
+    sp (sequence/context parallel for ring attention). Sizes of 1 collapse an
+    axis; product must equal the device count."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 1e-5
+    projector_lr: float | None = None  # separate LR for projector, ref-style
+    vision_lr: float | None = None
+    warmup_ratio: float = 0.03
+    lr_schedule: str = "cosine"
+    weight_decay: float = 0.0
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+    max_grad_norm: float = 1.0
+    global_batch_size: int = 128
+    grad_accum_steps: int = 1
+    num_train_steps: int = 1000
+    seed: int = 0
+    remat: bool = True  # gradient checkpointing per decoder block
+    # Which parameter groups train: "full", "projector_only" (stage-1
+    # pretraining of the compressor/projector), "no_vision".
+    tune: str = "full"
+    max_seq_len: int = 8192
+    checkpoint_every: int = 500
+    checkpoint_dir: str = "checkpoints"
+    log_every: int = 10
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 128
+    temperature: float = 0.0  # 0 => greedy
+    top_p: float = 1.0
+    top_k: int = 0
+    eos_token_id: int = 151645  # <|im_end|> for Qwen2-Instruct
+
+
+@dataclass(frozen=True)
+class OryxConfig:
+    """Root config for the multimodal model + runtime."""
+
+    llm: LLMConfig = field(default_factory=LLMConfig)
+    vision: VisionConfig = field(default_factory=VisionConfig)
+    compressor: CompressorConfig = field(default_factory=CompressorConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    generation: GenerationConfig = field(default_factory=GenerationConfig)
+    # Compute dtype for matmuls/activations; params kept fp32 for training.
+    dtype: str = "bfloat16"
+    # "xla" (portable, CPU-testable) or "pallas" (TPU kernels).
+    attn_impl: str = "xla"
+
+    # ---- (de)serialization -------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "OryxConfig":
+        def build(tp, val):
+            if dataclasses.is_dataclass(tp) and isinstance(val, dict):
+                fields = {f.name: f for f in dataclasses.fields(tp)}
+                kwargs = {}
+                for k, v in val.items():
+                    if k not in fields:
+                        continue
+                    ft = fields[k].type
+                    ftype = _FIELD_TYPES.get((tp, k), None)
+                    if ftype is not None:
+                        v = build(ftype, v)
+                    elif isinstance(v, list):
+                        v = tuple(v)
+                    kwargs[k] = v
+                return tp(**kwargs)
+            return val
+
+        return build(cls, d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "OryxConfig":
+        return cls.from_dict(json.loads(s))
+
+
+# Nested dataclass field types for from_dict (avoids evaluating string
+# annotations under `from __future__ import annotations`).
+_FIELD_TYPES = {
+    (OryxConfig, "llm"): LLMConfig,
+    (OryxConfig, "vision"): VisionConfig,
+    (OryxConfig, "compressor"): CompressorConfig,
+    (OryxConfig, "mesh"): MeshConfig,
+    (OryxConfig, "train"): TrainConfig,
+    (OryxConfig, "generation"): GenerationConfig,
+}
+
+
+# ---- Presets ---------------------------------------------------------------
+
+def qwen2_7b() -> LLMConfig:
+    """Qwen2-7B-Instruct geometry (Oryx-7B backbone)."""
+    return LLMConfig()
+
+
+def yi_34b() -> LLMConfig:
+    """Yi-34B geometry (Oryx-34B backbone): Llama-class, no attention bias."""
+    return LLMConfig(
+        vocab_size=64000,
+        hidden_size=7168,
+        intermediate_size=20480,
+        num_layers=60,
+        num_heads=56,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=5_000_000.0,
+        rms_norm_eps=1e-5,
+        max_position_embeddings=32768,
+        attention_bias=False,
+    )
+
+
+def tiny_llm(vocab_size: int = 512) -> LLMConfig:
+    """Tiny geometry for tests (CPU-fast, GQA exercised)."""
+    return LLMConfig(
+        vocab_size=vocab_size,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        max_position_embeddings=512,
+    )
+
+
+def tiny_vision() -> VisionConfig:
+    return VisionConfig(
+        hidden_size=48,
+        intermediate_size=96,
+        num_layers=2,
+        num_heads=4,
+        head_dim=12,
+        patch_size=14,
+        base_grid=8,
+        max_patches_per_image=256,
+    )
+
+
+def oryx_7b() -> OryxConfig:
+    return OryxConfig(llm=qwen2_7b())
+
+
+def oryx_34b() -> OryxConfig:
+    return OryxConfig(llm=yi_34b())
+
+
+def oryx_tiny() -> OryxConfig:
+    return OryxConfig(
+        llm=tiny_llm(),
+        vision=tiny_vision(),
+        compressor=CompressorConfig(num_heads=4),
+        dtype="float32",
+    )

@@ -1,0 +1,121 @@
+"""Conversation prompt templating.
+
+Reference parity: oryx/conversation.py (LLaVA-family; SURVEY.md §2
+"Conversation templating"). Pure host-side CPU code — templates build the
+prompt strings that mm_utils.tokenizer_image_token() then tokenizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum, auto
+
+
+class SeparatorStyle(Enum):
+    PLAIN = auto()      # bare concatenation (stage-1 projector pretraining)
+    TWO = auto()        # vicuna-style two separators
+    CHATML = auto()     # Qwen/ChatML: <|im_start|>role\n...<|im_end|>\n
+
+
+@dataclasses.dataclass
+class Conversation:
+    """A left-to-right conversation being built into a prompt string."""
+
+    system: str
+    roles: tuple[str, str]
+    messages: list[list[str | None]]
+    sep_style: SeparatorStyle = SeparatorStyle.CHATML
+    sep: str = "<|im_end|>\n"
+    sep2: str | None = None
+    version: str = "qwen"
+
+    def get_prompt(self) -> str:
+        if self.sep_style == SeparatorStyle.CHATML:
+            parts = []
+            if self.system:
+                parts.append(f"<|im_start|>system\n{self.system}{self.sep}")
+            for role, msg in self.messages:
+                if msg is None:
+                    # Generation prompt: open the assistant turn.
+                    parts.append(f"<|im_start|>{role}\n")
+                else:
+                    parts.append(f"<|im_start|>{role}\n{msg}{self.sep}")
+            return "".join(parts)
+        if self.sep_style == SeparatorStyle.TWO:
+            seps = [self.sep, self.sep2 or self.sep]
+            out = self.system + seps[0] if self.system else ""
+            for i, (role, msg) in enumerate(self.messages):
+                if msg is None:
+                    out += role + ":"
+                else:
+                    out += f"{role}: {msg}{seps[i % 2]}"
+            return out
+        if self.sep_style == SeparatorStyle.PLAIN:
+            out = ""
+            for _, msg in self.messages:
+                if msg is not None:
+                    out += msg + (self.sep or "")
+            return out
+        raise ValueError(f"unknown sep style {self.sep_style}")
+
+    def append_message(self, role: str, message: str | None) -> None:
+        self.messages.append([role, message])
+
+    def copy(self) -> "Conversation":
+        return Conversation(
+            system=self.system,
+            roles=self.roles,
+            messages=[[r, m] for r, m in self.messages],
+            sep_style=self.sep_style,
+            sep=self.sep,
+            sep2=self.sep2,
+            version=self.version,
+        )
+
+    @property
+    def stop_str(self) -> str:
+        if self.sep_style == SeparatorStyle.CHATML:
+            return "<|im_end|>"
+        return self.sep2 or self.sep
+
+
+conv_qwen = Conversation(
+    system="You are a helpful assistant.",
+    roles=("user", "assistant"),
+    messages=[],
+    sep_style=SeparatorStyle.CHATML,
+    sep="<|im_end|>\n",
+    version="qwen",
+)
+
+conv_plain = Conversation(
+    system="",
+    roles=("", ""),
+    messages=[],
+    sep_style=SeparatorStyle.PLAIN,
+    sep="\n",
+    version="plain",
+)
+
+conv_vicuna = Conversation(
+    system=(
+        "A chat between a curious user and an artificial intelligence "
+        "assistant. The assistant gives helpful, detailed, and polite "
+        "answers to the user's questions."
+    ),
+    roles=("USER", "ASSISTANT"),
+    messages=[],
+    sep_style=SeparatorStyle.TWO,
+    sep=" ",
+    sep2="</s>",
+    version="v1",
+)
+
+conv_templates: dict[str, Conversation] = {
+    "qwen": conv_qwen,
+    "qwen_1_5": conv_qwen,
+    "plain": conv_plain,
+    "v1": conv_vicuna,
+}
+
+default_conversation = conv_qwen

@@ -1,0 +1,20 @@
+"""Test config: force an 8-device CPU platform before jax initializes.
+
+This simulates the multi-chip mesh (SURVEY.md §4 "Distributed") so FSDP /
+shard_map / tp tests run anywhere with no TPU. Must run before any
+`import jax` in the test session, hence top of conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# fp32 matmuls on CPU for parity tests (defensive; CPU default is highest).
+jax.config.update("jax_default_matmul_precision", "highest")

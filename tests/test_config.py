@@ -1,0 +1,26 @@
+import oryx_tpu
+from oryx_tpu.config import OryxConfig, oryx_7b, oryx_34b, oryx_tiny
+
+
+def test_presets():
+    c7 = oryx_7b()
+    assert c7.llm.hidden_size == 3584
+    assert c7.llm.num_kv_heads == 4
+    assert c7.llm.attention_bias
+    c34 = oryx_34b()
+    assert c34.llm.hidden_size == 7168
+    assert c34.llm.num_layers == 60
+    assert not c34.llm.attention_bias
+
+
+def test_json_roundtrip():
+    c = oryx_34b()
+    c2 = OryxConfig.from_json(c.to_json())
+    assert c2 == c
+    t = oryx_tiny()
+    assert OryxConfig.from_json(t.to_json()) == t
+
+
+def test_mesh_devices():
+    c = oryx_7b()
+    assert c.mesh.num_devices == 1

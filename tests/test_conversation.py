@@ -1,0 +1,35 @@
+from oryx_tpu.conversation import conv_templates
+
+
+def test_chatml_prompt():
+    conv = conv_templates["qwen"].copy()
+    conv.append_message("user", "<image>\nWhat is this?")
+    conv.append_message("assistant", None)
+    p = conv.get_prompt()
+    assert p == (
+        "<|im_start|>system\nYou are a helpful assistant.<|im_end|>\n"
+        "<|im_start|>user\n<image>\nWhat is this?<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+    assert conv.stop_str == "<|im_end|>"
+
+
+def test_chatml_closed_turn():
+    conv = conv_templates["qwen"].copy()
+    conv.append_message("user", "hi")
+    conv.append_message("assistant", "hello")
+    p = conv.get_prompt()
+    assert p.endswith("<|im_start|>assistant\nhello<|im_end|>\n")
+
+
+def test_copy_isolated():
+    conv = conv_templates["qwen"].copy()
+    conv.append_message("user", "hi")
+    assert conv_templates["qwen"].messages == []
+
+
+def test_plain():
+    conv = conv_templates["plain"].copy()
+    conv.append_message("", "<image>")
+    conv.append_message("", "a photo of a cat")
+    assert conv.get_prompt() == "<image>\na photo of a cat\n"
