@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import typing
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -52,8 +53,8 @@ class VisionConfig:
     base_grid: int = 27
     layer_norm_eps: float = 1e-6
     num_channels: int = 3
-    # Longest packed patch-sequence bucket (see ops/packing.py). 1536 covers
-    # a ~540x540 image at patch 14; larger inputs use more buckets.
+    # Cap on patches per image (see ops/packing.py buckets). 4096 covers a
+    # ~896x896 image at patch 14; larger inputs are resized down to fit.
     max_patches_per_image: int = 4096
 
 
@@ -150,11 +151,14 @@ class OryxConfig:
         def build(tp, val):
             if dataclasses.is_dataclass(tp) and isinstance(val, dict):
                 fields = {f.name: f for f in dataclasses.fields(tp)}
+                unknown = set(val) - set(fields)
+                if unknown:
+                    raise ValueError(
+                        f"unknown config key(s) for {tp.__name__}: "
+                        f"{sorted(unknown)}"
+                    )
                 kwargs = {}
                 for k, v in val.items():
-                    if k not in fields:
-                        continue
-                    ft = fields[k].type
                     ftype = _FIELD_TYPES.get((tp, k), None)
                     if ftype is not None:
                         v = build(ftype, v)
@@ -171,15 +175,13 @@ class OryxConfig:
         return cls.from_dict(json.loads(s))
 
 
-# Nested dataclass field types for from_dict (avoids evaluating string
-# annotations under `from __future__ import annotations`).
+# Nested dataclass field types for from_dict, derived from type hints so
+# new nested-config fields are picked up automatically (string annotations
+# under `from __future__ import annotations` resolve fine at module level).
 _FIELD_TYPES = {
-    (OryxConfig, "llm"): LLMConfig,
-    (OryxConfig, "vision"): VisionConfig,
-    (OryxConfig, "compressor"): CompressorConfig,
-    (OryxConfig, "mesh"): MeshConfig,
-    (OryxConfig, "train"): TrainConfig,
-    (OryxConfig, "generation"): GenerationConfig,
+    (OryxConfig, name): hint
+    for name, hint in typing.get_type_hints(OryxConfig).items()
+    if dataclasses.is_dataclass(hint)
 }
 
 

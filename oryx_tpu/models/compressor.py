@@ -1,0 +1,128 @@
+"""Dynamic Compressor — on-demand visual token compression.
+
+Reference parity: the compressor + projector built by
+`build_vision_projector()` (SURVEY.md §1 L1b, §2 "Dynamic Compressor";
+reference mount empty — behavior reconstructed): downsample each image's
+(h, w) feature grid by a per-modality side factor s ∈ {1, 2, 4} (area 1×/
+4×/16×), where each downsampled token is produced by average pooling its
+s×s source region and then cross-attending to that region's tokens, followed
+by an MLP projector into the LLM embedding space.
+
+TPU-first formulation: no per-image loops. The packed feature buffer
+(ops/packing.py) carries `region_ids`; pooling is one `segment_sum` and the
+region cross-attention is the generic segment-id-masked attention with
+query segments = region ids. Everything is static-shape over the bucketed
+patch/query buffers, so one compiled program serves any mix of image /
+multi-image / video inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.config import CompressorConfig, LLMConfig, VisionConfig
+from oryx_tpu.ops.attention import attention
+from oryx_tpu.ops.norms import layer_norm
+
+Params = dict[str, Any]
+
+
+def init_params(
+    cfg: CompressorConfig,
+    vision_cfg: VisionConfig,
+    llm_cfg: LLMConfig,
+    key: jax.Array,
+    dtype: jnp.dtype = jnp.float32,
+) -> Params:
+    Hv, Hl = vision_cfg.hidden_size, llm_cfg.hidden_size
+    keys = iter(jax.random.split(key, 8))
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    def proj(din, dout):
+        return {
+            "kernel": dense(next(keys), (din, dout)),
+            "bias": jnp.zeros((dout,), dtype),
+        }
+
+    def ln(dim):
+        return {"weight": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+    return {
+        "norm_q": ln(Hv),
+        "norm_kv": ln(Hv),
+        "q_proj": proj(Hv, Hv),
+        "k_proj": proj(Hv, Hv),
+        "v_proj": proj(Hv, Hv),
+        "o_proj": proj(Hv, Hv),
+        "projector": {"fc1": proj(Hv, Hl), "fc2": proj(Hl, Hl)},
+    }
+
+
+def _linear(x, p):
+    return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def forward(
+    params: Params,
+    cfg: CompressorConfig,
+    vision_cfg: VisionConfig,
+    features: jnp.ndarray,
+    region_ids: jnp.ndarray,
+    q_region_ids: jnp.ndarray,
+    *,
+    eps: float = 1e-6,
+) -> jnp.ndarray:
+    """Compress packed ViT features into packed LLM-space visual embeddings.
+
+    features:     [P, Hv] packed ViT output (pad rows garbage, region id 0).
+    region_ids:   [P] int32 — compressor region per patch (0 = pad).
+    q_region_ids: [Q] int32 — region served by each query slot (0 = pad).
+
+    Returns [Q, H_llm] visual embeddings; pad rows (q_region_ids == 0) are
+    zeros. Queries are ordered image-major, row-major within each image's
+    downsampled grid (the order splice.py interleaves into the text stream).
+    """
+    P, Hv = features.shape
+    Q = q_region_ids.shape[0]
+    feat32 = features.astype(jnp.float32)
+    valid_p = (region_ids > 0).astype(jnp.float32)[:, None]
+
+    # Region average pooling via one segment-sum (region 0 collects pads).
+    num_segments = Q + 1
+    sums = jax.ops.segment_sum(
+        feat32 * valid_p, region_ids, num_segments=num_segments
+    )
+    counts = jax.ops.segment_sum(
+        valid_p[:, 0], region_ids, num_segments=num_segments
+    )
+    pooled = sums[q_region_ids] / jnp.maximum(counts[q_region_ids], 1.0)[:, None]
+    pooled = pooled.astype(features.dtype)  # [Q, Hv]
+
+    # Region cross-attention: query = pooled token, keys/values = its s×s
+    # source region (segment-id mask on region equality).
+    nq = layer_norm(pooled, params["norm_q"]["weight"], params["norm_q"]["bias"], eps)
+    nkv = layer_norm(
+        features, params["norm_kv"]["weight"], params["norm_kv"]["bias"], eps
+    )
+    nh, hd = cfg.num_heads, Hv // cfg.num_heads
+    q = _linear(nq, params["q_proj"]).reshape(1, Q, nh, hd)
+    k = _linear(nkv, params["k_proj"]).reshape(1, P, nh, hd)
+    v = _linear(nkv, params["v_proj"]).reshape(1, P, nh, hd)
+    o = attention(
+        q, k, v,
+        q_segment_ids=q_region_ids[None],
+        kv_segment_ids=region_ids[None],
+    ).reshape(Q, Hv)
+    x = pooled + _linear(o, params["o_proj"])
+
+    # MLP projector into LLM embedding space (mlp2x_gelu-equivalent).
+    x = jax.nn.gelu(_linear(x, params["projector"]["fc1"]), approximate=True)
+    x = _linear(x, params["projector"]["fc2"])
+
+    valid_q = (q_region_ids > 0)[:, None]
+    return jnp.where(valid_q, x, 0).astype(features.dtype)

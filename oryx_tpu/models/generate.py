@@ -1,0 +1,132 @@
+"""Autoregressive generation: jitted prefill + lax.scan decode loop.
+
+Reference parity: HF `generate()` as driven by `OryxQwenForCausalLM`
+(SURVEY.md §3.2): greedy or sampled decoding with a KV cache, stopping on
+EOS. TPU-first: the whole decode loop is ONE compiled program (`lax.scan`
+over steps, no host round-trip per token); right-padded batches advance
+with per-row positions, so mixed-length multimodal prefills need no
+left-padding shuffle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.config import GenerationConfig, LLMConfig
+from oryx_tpu.models import qwen2
+
+
+def sample_token(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    *,
+    temperature: float,
+    top_p: float,
+    top_k: int,
+) -> jnp.ndarray:
+    """Sample next token ids from [B, V] logits. temperature==0 → greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always
+        # keeps the top token).
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "gen_cfg", "max_new_tokens", "cache_len", "attn_impl",
+        "compute_dtype",
+    ),
+)
+def generate(
+    params,
+    cfg: LLMConfig,
+    gen_cfg: GenerationConfig,
+    *,
+    inputs_embeds: jnp.ndarray,  # [B, T, H] (pre-spliced; right-padded)
+    lengths: jnp.ndarray,  # [B] real prompt lengths
+    max_new_tokens: int,
+    cache_len: int,
+    key: jax.Array | None = None,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens [B, max_new_tokens] int32, num_generated [B] int32).
+
+    Slots after EOS are filled with eos_token_id. cache_len must be a bucket
+    >= T + max_new_tokens.
+    """
+    B, T, _ = inputs_embeds.shape
+    assert cache_len >= T + max_new_tokens, (cache_len, T, max_new_tokens)
+    if key is None:
+        key = jax.random.key(0)
+
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+    kv_mask = (slot_ar < lengths[:, None]).astype(jnp.int32)
+
+    cache = qwen2.init_kv_cache(
+        cfg, B, cache_len,
+        dtype=compute_dtype or jnp.float32,
+    )
+    logits, cache = qwen2.forward(
+        params, cfg,
+        inputs_embeds=inputs_embeds, positions=positions,
+        kv_cache=cache, write_slots=jnp.zeros((B,), jnp.int32),
+        kv_mask=kv_mask, attn_impl=attn_impl, compute_dtype=compute_dtype,
+    )
+    # Last real logit per row (right padding ⇒ index lengths-1).
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+
+    key, sk = jax.random.split(key)
+    tok0 = sample_token(
+        last, sk, temperature=gen_cfg.temperature, top_p=gen_cfg.top_p,
+        top_k=gen_cfg.top_k,
+    )
+
+    def step(carry, step_key):
+        cache, tok, cur_len, finished = carry
+        pos = cur_len[:, None]  # [B, 1] absolute position of tok
+        kv_mask = (slot_ar <= cur_len[:, None]).astype(jnp.int32)
+        logits, cache = qwen2.forward(
+            params, cfg,
+            input_ids=tok[:, None], positions=pos,
+            kv_cache=cache, write_slots=cur_len,
+            kv_mask=kv_mask, attn_impl=attn_impl,
+            compute_dtype=compute_dtype,
+        )
+        nxt = sample_token(
+            logits[:, 0], step_key, temperature=gen_cfg.temperature,
+            top_p=gen_cfg.top_p, top_k=gen_cfg.top_k,
+        )
+        finished = jnp.logical_or(finished, tok == gen_cfg.eos_token_id)
+        nxt = jnp.where(finished, gen_cfg.eos_token_id, nxt)
+        return (cache, nxt, cur_len + 1, finished), tok
+
+    init = (cache, tok0, lengths, jnp.zeros((B,), bool))
+    step_keys = jax.random.split(key, max_new_tokens)
+    (_, _, _, finished), toks = jax.lax.scan(init=init, f=step, xs=step_keys)
+    toks = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
+    # num generated = tokens up to and including first EOS.
+    is_eos = toks == gen_cfg.eos_token_id
+    first_eos = jnp.argmax(is_eos, axis=1)
+    any_eos = jnp.any(is_eos, axis=1)
+    num = jnp.where(any_eos, first_eos + 1, max_new_tokens)
+    return toks, num.astype(jnp.int32)

@@ -1,0 +1,211 @@
+"""HF-checkpoint ⇄ oryx_tpu weight conversion.
+
+Reference parity: the reference loads `Qwen2ForCausalLM.from_pretrained` +
+OryxViT safetensors (SURVEY.md §2 "Model builder", §5 "Checkpoint / resume").
+This module is the interop path: import HF safetensors → stacked JAX pytrees,
+and export back for users of the reference checkpoints.
+
+Works from (a) an in-memory numpy state dict, or (b) a directory of
+*.safetensors shards (with or without an index json). No torch required.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Iterable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.config import LLMConfig, VisionConfig
+
+Params = dict[str, Any]
+StateDict = Mapping[str, np.ndarray]
+
+
+# ---------------------------------------------------------------------------
+# Safetensors directory reading
+# ---------------------------------------------------------------------------
+
+
+def load_safetensors_dir(path: str) -> dict[str, np.ndarray]:
+    """Load all tensors from a HF checkpoint directory into numpy."""
+    from safetensors.numpy import load_file
+
+    index = os.path.join(path, "model.safetensors.index.json")
+    out: dict[str, np.ndarray] = {}
+    if os.path.exists(index):
+        with open(index) as f:
+            shards = sorted(set(json.load(f)["weight_map"].values()))
+        for shard in shards:
+            out.update(load_file(os.path.join(path, shard)))
+    else:
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".safetensors"):
+                out.update(load_file(os.path.join(path, name)))
+    if not out:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    return out
+
+
+def _get(sd: StateDict, key: str) -> np.ndarray:
+    if key not in sd:
+        raise KeyError(f"missing weight {key!r}; have e.g. "
+                       f"{sorted(sd)[:5]}...")
+    arr = np.asarray(sd[key])
+    if arr.dtype == np.dtype("V2"):  # raw bf16 from safetensors.numpy
+        import jax
+        arr = np.asarray(jax.numpy.asarray(arr.view(jnp.bfloat16)))
+    return arr
+
+
+def _stack(
+    sd: StateDict, n: int, fmt: str, post: Callable[[np.ndarray], np.ndarray]
+) -> jnp.ndarray:
+    return jnp.stack([jnp.asarray(post(_get(sd, fmt.format(i)))) for i in range(n)])
+
+
+# ---------------------------------------------------------------------------
+# Qwen2 / Yi decoder
+# ---------------------------------------------------------------------------
+
+_T = lambda w: np.ascontiguousarray(w.T)  # torch [out,in] -> jax [in,out]
+_I = lambda w: w
+
+
+def import_qwen2(
+    sd: StateDict, cfg: LLMConfig, dtype: jnp.dtype = jnp.float32
+) -> Params:
+    """HF Qwen2/Llama-family state dict → stacked pytree (models/qwen2.py).
+
+    Accepts either `model.`-prefixed names (full ForCausalLM dict) or the
+    bare inner-model names; the bare form carries no `lm_head.weight`, so it
+    requires `cfg.tie_word_embeddings` (a clear KeyError otherwise).
+    """
+    p = "model." if any(k.startswith("model.") for k in sd) else ""
+    L = cfg.num_layers
+    lyr = p + "layers.{}."
+
+    def stacked(suffix: str, post=_I) -> jnp.ndarray:
+        return _stack(sd, L, lyr + suffix, post)
+
+    cast = lambda x: jnp.asarray(x).astype(dtype)
+    layers: Params = {
+        "input_norm": {"weight": stacked("input_layernorm.weight")},
+        "post_attn_norm": {"weight": stacked("post_attention_layernorm.weight")},
+        "q_proj": {"kernel": stacked("self_attn.q_proj.weight", _T)},
+        "k_proj": {"kernel": stacked("self_attn.k_proj.weight", _T)},
+        "v_proj": {"kernel": stacked("self_attn.v_proj.weight", _T)},
+        "o_proj": {"kernel": stacked("self_attn.o_proj.weight", _T)},
+        "gate_proj": {"kernel": stacked("mlp.gate_proj.weight", _T)},
+        "up_proj": {"kernel": stacked("mlp.up_proj.weight", _T)},
+        "down_proj": {"kernel": stacked("mlp.down_proj.weight", _T)},
+    }
+    if cfg.attention_bias:
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            layers[proj]["bias"] = stacked(f"self_attn.{proj}.bias")
+    params: Params = {
+        "embed": {"weight": cast(_get(sd, p + "embed_tokens.weight"))},
+        "layers": {k: {kk: cast(vv) for kk, vv in v.items()}
+                   for k, v in layers.items()},
+        "final_norm": {"weight": cast(_get(sd, p + "norm.weight"))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": cast(_T(_get(sd, "lm_head.weight")))}
+    return params
+
+
+def export_qwen2(params: Params, cfg: LLMConfig) -> dict[str, np.ndarray]:
+    """Stacked pytree → HF state-dict names (fp32 numpy)."""
+    out: dict[str, np.ndarray] = {}
+    f32 = lambda x: np.asarray(jnp.asarray(x, jnp.float32))
+    out["model.embed_tokens.weight"] = f32(params["embed"]["weight"])
+    out["model.norm.weight"] = f32(params["final_norm"]["weight"])
+    if not cfg.tie_word_embeddings:
+        out["lm_head.weight"] = _T(f32(params["lm_head"]["kernel"]))
+    lp = params["layers"]
+    names = {
+        "input_layernorm.weight": (lp["input_norm"]["weight"], _I),
+        "post_attention_layernorm.weight": (lp["post_attn_norm"]["weight"], _I),
+        "self_attn.q_proj.weight": (lp["q_proj"]["kernel"], _T),
+        "self_attn.k_proj.weight": (lp["k_proj"]["kernel"], _T),
+        "self_attn.v_proj.weight": (lp["v_proj"]["kernel"], _T),
+        "self_attn.o_proj.weight": (lp["o_proj"]["kernel"], _T),
+        "mlp.gate_proj.weight": (lp["gate_proj"]["kernel"], _T),
+        "mlp.up_proj.weight": (lp["up_proj"]["kernel"], _T),
+        "mlp.down_proj.weight": (lp["down_proj"]["kernel"], _T),
+    }
+    if cfg.attention_bias:
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            names[f"self_attn.{proj}.bias"] = (lp[proj]["bias"], _I)
+    for suffix, (stacked, post) in names.items():
+        arr = f32(stacked)
+        for i in range(cfg.num_layers):
+            out[f"model.layers.{i}.{suffix}"] = post(arr[i])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SigLIP-family vision tower (OryxViT)
+# ---------------------------------------------------------------------------
+
+
+def import_siglip(
+    sd: StateDict, cfg: VisionConfig, dtype: jnp.dtype = jnp.float32
+) -> Params:
+    """HF `SiglipVisionModel`-layout state dict → OryxViT pytree
+    (models/oryx_vit.py). Accepts optional `vision_model.` prefix."""
+    p = ""
+    for cand in ("vision_model.", "vision_tower.vision_model.", ""):
+        if any(k.startswith(cand + "encoder.layers.0.") for k in sd):
+            p = cand
+            break
+    L = cfg.num_layers
+    lyr = p + "encoder.layers.{}."
+    cast = lambda x: jnp.asarray(x).astype(dtype)
+
+    def stacked(suffix: str, post=_I) -> jnp.ndarray:
+        return _stack(sd, L, lyr + suffix, post).astype(dtype)
+
+    def ln(prefix: str) -> Params:
+        return {"weight": stacked(prefix + ".weight"),
+                "bias": stacked(prefix + ".bias")}
+
+    def dense(prefix: str) -> Params:
+        return {"kernel": stacked(prefix + ".weight", _T),
+                "bias": stacked(prefix + ".bias")}
+
+    # HF stores patch embedding as Conv2d [H, C, ph, pw]; our patchify is an
+    # unfold + matmul, so flatten to [ph*pw*C, H] matching the host-side
+    # patch extraction order (channel-last pixels within a patch).
+    conv = _get(sd, p + "embeddings.patch_embedding.weight")
+    Hd, C, ph, pw = conv.shape
+    kernel = np.ascontiguousarray(
+        conv.transpose(2, 3, 1, 0).reshape(ph * pw * C, Hd)
+    )
+    params: Params = {
+        "patch_embed": {
+            "kernel": cast(kernel),
+            "bias": cast(_get(sd, p + "embeddings.patch_embedding.bias")),
+        },
+        "pos_embed": {
+            # [P, H] learned table at base_grid**2 positions.
+            "weight": cast(_get(sd, p + "embeddings.position_embedding.weight")),
+        },
+        "layers": {
+            "norm1": ln("layer_norm1"),
+            "norm2": ln("layer_norm2"),
+            "q_proj": dense("self_attn.q_proj"),
+            "k_proj": dense("self_attn.k_proj"),
+            "v_proj": dense("self_attn.v_proj"),
+            "o_proj": dense("self_attn.out_proj"),
+            "fc1": dense("mlp.fc1"),
+            "fc2": dense("mlp.fc2"),
+        },
+        "post_norm": {
+            "weight": cast(_get(sd, p + "post_layernorm.weight")),
+            "bias": cast(_get(sd, p + "post_layernorm.bias")),
+        },
+    }
+    return params

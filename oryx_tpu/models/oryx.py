@@ -1,0 +1,169 @@
+"""Oryx multimodal model: OryxViT + Dynamic Compressor + Qwen2/Yi decoder.
+
+Reference parity: `OryxQwenForCausalLM` + `OryxMetaForCausalLM`
+(`oryx/model/language_model/oryx_qwen.py`, `oryx/model/oryx_arch.py`;
+SURVEY.md §1 L1c/L1d). The reference threads `images=` kwargs through HF
+`forward`/`generate`; here the visual encode, splice, decoder forward and
+decode loop are separate pure functions composed under one jit, all
+operating on the static-shape packed buffers from ops/packing.py +
+models/splice.py.
+
+Param tree: {"llm": qwen2 params, "vit": oryx_vit params,
+             "compressor": compressor params}.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.config import OryxConfig
+from oryx_tpu.models import compressor as compressor_lib
+from oryx_tpu.models import generate as generate_lib
+from oryx_tpu.models import oryx_vit, qwen2, splice
+from oryx_tpu.ops.packing import PackedVisual, round_up_bucket
+
+Params = dict[str, Any]
+
+
+def init_params(cfg: OryxConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "llm": qwen2.init_params(cfg.llm, k1, dtype),
+        "vit": oryx_vit.init_params(cfg.vision, k2, dtype),
+        "compressor": compressor_lib.init_params(
+            cfg.compressor, cfg.vision, cfg.llm, k3, dtype
+        ),
+    }
+
+
+def encode_visual(
+    params: Params,
+    cfg: OryxConfig,
+    patches: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    pos_coords: jnp.ndarray,
+    region_ids: jnp.ndarray,
+    q_region_ids: jnp.ndarray,
+    *,
+    remat: bool = False,
+    compute_dtype=None,
+) -> jnp.ndarray:
+    """Packed patches → packed LLM-space visual embeddings [Q, H_llm].
+
+    The reference's `encode_images` (SURVEY.md §3.4): one ViT pass over all
+    images/frames of the batch, then the Dynamic Compressor.
+    """
+    feats = oryx_vit.forward(
+        params["vit"], cfg.vision, patches, segment_ids, pos_coords,
+        remat=remat, attn_impl=cfg.attn_impl, compute_dtype=compute_dtype,
+    )
+    return compressor_lib.forward(
+        params["compressor"], cfg.compressor, cfg.vision,
+        feats, region_ids, q_region_ids,
+    )
+
+
+def forward(
+    params: Params,
+    cfg: OryxConfig,
+    *,
+    # Packed visual arrays (ops/packing.PackedVisual fields, device arrays):
+    patches: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    pos_coords: jnp.ndarray,
+    region_ids: jnp.ndarray,
+    q_region_ids: jnp.ndarray,
+    # Spliced text stream (models/splice.MMBatch fields, device arrays):
+    token_ids: jnp.ndarray,
+    visual_idx: jnp.ndarray,
+    is_visual: jnp.ndarray,
+    attn_mask: jnp.ndarray,
+    positions: jnp.ndarray,
+    remat: bool = False,
+    compute_dtype=None,
+    logits_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Training/prefill forward: visual encode → splice → decoder logits."""
+    vis = encode_visual(
+        params, cfg, patches, segment_ids, pos_coords, region_ids,
+        q_region_ids, remat=remat, compute_dtype=compute_dtype,
+    )
+    embeds = splice.embed_spliced(
+        params["llm"]["embed"]["weight"], vis, token_ids, visual_idx, is_visual
+    )
+    logits, _ = qwen2.forward(
+        params["llm"], cfg.llm,
+        inputs_embeds=embeds, positions=positions, kv_mask=attn_mask,
+        remat=remat, attn_impl=cfg.attn_impl, compute_dtype=compute_dtype,
+        logits_dtype=logits_dtype,
+    )
+    return logits
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "cache_len"))
+def _jit_mm_generate(
+    params, cfg: OryxConfig, arrays, max_new_tokens: int, cache_len: int, key
+):
+    vis = encode_visual(
+        params, cfg,
+        arrays["patches"], arrays["segment_ids"], arrays["pos_coords"],
+        arrays["region_ids"], arrays["q_region_ids"],
+        compute_dtype=_dtype(cfg),
+    )
+    embeds = splice.embed_spliced(
+        params["llm"]["embed"]["weight"], vis,
+        arrays["token_ids"], arrays["visual_idx"], arrays["is_visual"],
+    )
+    return generate_lib.generate(
+        params["llm"], cfg.llm, cfg.generation,
+        inputs_embeds=embeds, lengths=arrays["lengths"],
+        max_new_tokens=max_new_tokens, cache_len=cache_len, key=key,
+        attn_impl=cfg.attn_impl, compute_dtype=_dtype(cfg),
+    )
+
+
+def _dtype(cfg: OryxConfig):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[cfg.dtype]
+
+
+def mm_generate(
+    params: Params,
+    cfg: OryxConfig,
+    packed: PackedVisual,
+    batch: splice.MMBatch,
+    *,
+    max_new_tokens: int | None = None,
+    key: jax.Array | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """End-to-end multimodal generation from host-side packed inputs.
+
+    Returns (tokens [B, max_new_tokens], num_generated [B]) as numpy.
+    The reference equivalent is `model.generate(input_ids, images=...)`
+    (SURVEY.md §3.2).
+    """
+    if max_new_tokens is None:
+        max_new_tokens = cfg.generation.max_new_tokens
+    if key is None:
+        key = jax.random.key(0)
+    T = batch.token_ids.shape[1]
+    cache_len = round_up_bucket(T + max_new_tokens)
+    arrays = {
+        "patches": jnp.asarray(packed.patches),
+        "segment_ids": jnp.asarray(packed.segment_ids),
+        "pos_coords": jnp.asarray(packed.pos_coords),
+        "region_ids": jnp.asarray(packed.region_ids),
+        "q_region_ids": jnp.asarray(packed.q_region_ids),
+        "token_ids": jnp.asarray(batch.token_ids),
+        "visual_idx": jnp.asarray(batch.visual_idx),
+        "is_visual": jnp.asarray(batch.is_visual),
+        "lengths": jnp.asarray(batch.lengths),
+    }
+    toks, num = _jit_mm_generate(
+        params, cfg, arrays, max_new_tokens, cache_len, key
+    )
+    return np.asarray(toks), np.asarray(num)

@@ -1,0 +1,263 @@
+"""Qwen2/Yi-class causal decoder, TPU-first functional implementation.
+
+Reference parity: the HF `Qwen2ForCausalLM` backbone that Oryx wraps
+(SURVEY.md §1 L1d, §2 "LLM wrapper"). Geometry covers both Oryx-7B
+(Qwen2-7B, attention bias) and Oryx-34B (Yi-34B, no bias) via `LLMConfig`.
+
+Design (deliberately not a torch translation):
+  * Params are plain nested-dict pytrees; per-layer weights are STACKED along
+    a leading layer axis and the block is applied with `lax.scan`. One block
+    compiles once regardless of depth, remat applies per scan step, and FSDP
+    all-gathers one layer at a time — the idiomatic XLA/TPU layout.
+  * All matmuls take bf16 inputs with fp32 softmax/norm accumulation
+    (ops/norms.py, ops/attention.py) so TPU runs track the CUDA reference.
+  * KV cache is a pytree of [L, B, S, Hk, D] arrays written with per-row
+    dynamic slices — static shapes throughout, decode step fully jittable.
+
+Weight layout: linear kernels are [in, out] (x @ W); the HF importer
+transposes torch's [out, in].
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.config import LLMConfig
+from oryx_tpu.ops.attention import attention
+from oryx_tpu.ops.norms import rms_norm
+from oryx_tpu.ops.rope import apply_rope, rope_cos_sin
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: LLMConfig, key: jax.Array, dtype: jnp.dtype = jnp.float32
+) -> Params:
+    """Random-normal init (scale 0.02, zero biases) in the stacked layout."""
+    L, H = cfg.num_layers, cfg.hidden_size
+    Dq = cfg.num_heads * cfg.head_dim
+    Dkv = cfg.num_kv_heads * cfg.head_dim
+    I = cfg.intermediate_size
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    def stack(shape):
+        return dense(next(keys), (L, *shape))
+
+    params: Params = {
+        "embed": {"weight": dense(next(keys), (cfg.vocab_size, H))},
+        "layers": {
+            "input_norm": {"weight": jnp.ones((L, H), dtype)},
+            "post_attn_norm": {"weight": jnp.ones((L, H), dtype)},
+            "q_proj": {"kernel": stack((H, Dq))},
+            "k_proj": {"kernel": stack((H, Dkv))},
+            "v_proj": {"kernel": stack((H, Dkv))},
+            "o_proj": {"kernel": stack((Dq, H))},
+            "gate_proj": {"kernel": stack((H, I))},
+            "up_proj": {"kernel": stack((H, I))},
+            "down_proj": {"kernel": stack((I, H))},
+        },
+        "final_norm": {"weight": jnp.ones((H,), dtype)},
+    }
+    if cfg.attention_bias:
+        params["layers"]["q_proj"]["bias"] = jnp.zeros((L, Dq), dtype)
+        params["layers"]["k_proj"]["bias"] = jnp.zeros((L, Dkv), dtype)
+        params["layers"]["v_proj"]["bias"] = jnp.zeros((L, Dkv), dtype)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense(next(keys), (H, cfg.vocab_size))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(
+    cfg: LLMConfig, batch: int, max_len: int, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cache_write(cache_layer: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray):
+    """Write new [B, T, Hk, D] into cache [B, S, Hk, D] at per-row start slots.
+
+    slots: [B] int32 — index of the first written position per row. Assumes
+    the T new entries occupy contiguous slots (true for prefill-from-0 and
+    single-token decode).
+    """
+
+    def row(c, x, s):
+        return jax.lax.dynamic_update_slice(c, x.astype(c.dtype), (s, 0, 0))
+
+    return jax.vmap(row)(cache_layer, new, slots)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _linear(x, p):
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def _block(
+    cfg: LLMConfig,
+    h: jnp.ndarray,
+    lp: Params,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    positions: jnp.ndarray,
+    cache_k: jnp.ndarray | None,
+    cache_v: jnp.ndarray | None,
+    write_slots: jnp.ndarray | None,
+    kv_mask: jnp.ndarray | None,
+    attn_fn,
+):
+    """One decoder block. h: [B, T, H]. Returns (h, new_k, new_v)."""
+    B, T, _ = h.shape
+    x = rms_norm(h, lp["input_norm"]["weight"], cfg.rms_norm_eps)
+    q = _linear(x, lp["q_proj"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = _linear(x, lp["k_proj"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = _linear(x, lp["v_proj"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, k, cos, sin)
+
+    if cache_k is not None:
+        cache_k = _cache_write(cache_k, k, write_slots)
+        cache_v = _cache_write(cache_v, v, write_slots)
+        attn_out = attn_fn(
+            q, cache_k, cache_v,
+            q_positions=positions,
+            kv_positions=None,  # arange over cache slots == absolute positions
+            kv_mask=kv_mask,
+        )
+    else:
+        attn_out = attn_fn(
+            q, k, v,
+            q_positions=positions,
+            kv_positions=positions,
+            kv_mask=kv_mask,
+        )
+    attn_out = attn_out.reshape(B, T, -1)
+    h = h + _linear(attn_out, lp["o_proj"])
+
+    x = rms_norm(h, lp["post_attn_norm"]["weight"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(_linear(x, lp["gate_proj"]))
+    h = h + _linear(gate * _linear(x, lp["up_proj"]), lp["down_proj"])
+    return h, cache_k, cache_v
+
+
+def forward(
+    params: Params,
+    cfg: LLMConfig,
+    *,
+    input_ids: jnp.ndarray | None = None,
+    inputs_embeds: jnp.ndarray | None = None,
+    positions: jnp.ndarray | None = None,
+    kv_cache: Params | None = None,
+    write_slots: jnp.ndarray | None = None,
+    kv_mask: jnp.ndarray | None = None,
+    remat: bool = False,
+    attn_impl: str = "xla",
+    compute_dtype: jnp.dtype | None = None,
+    logits_dtype: jnp.dtype = jnp.float32,
+) -> tuple[jnp.ndarray, Params | None]:
+    """Full decoder forward.
+
+    Args:
+      input_ids / inputs_embeds: exactly one; ids [B, T] or embeds [B, T, H].
+        (Multimodal calls pass pre-spliced `inputs_embeds`; SURVEY.md §3.4.)
+      positions: [B, T] absolute positions (RoPE + causal mask). Defaults to
+        arange when no cache is used.
+      kv_cache: pytree from `init_kv_cache`; when present, k/v are written at
+        `write_slots` ([B] first-slot indices, default positions[:, 0]) and
+        attention runs over the whole cache with `kv_mask` [B, S] validity.
+      kv_mask: with no cache, [B, T] padding mask; with cache, [B, S] slot
+        validity — caller maintains it (see models/generate.py).
+
+    Returns (logits [B, T, V] in logits_dtype, updated kv_cache or None).
+    """
+    assert (input_ids is None) != (inputs_embeds is None)
+    if inputs_embeds is None:
+        inputs_embeds = params["embed"]["weight"][input_ids]
+    if compute_dtype is not None:
+        inputs_embeds = inputs_embeds.astype(compute_dtype)
+    h = inputs_embeds
+    B, T, _ = h.shape
+
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)  # [B,T,D]
+
+    if kv_cache is not None and write_slots is None:
+        write_slots = positions[:, 0]
+
+    if attn_impl == "pallas":
+        try:
+            from oryx_tpu.ops.pallas import flash_attention as _fa
+        except ImportError as e:  # pragma: no cover
+            raise NotImplementedError(
+                "attn_impl='pallas' requires oryx_tpu.ops.pallas; "
+                "use attn_impl='xla'"
+            ) from e
+
+        def attn_fn(q, k, v, **kw):
+            return _fa.flash_attention(q, k, v, causal=True, **kw)
+    elif attn_impl == "xla":
+        def attn_fn(q, k, v, **kw):
+            return attention(q, k, v, causal=True, **kw)
+    else:
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+
+    def body(carry, xs):
+        h = carry
+        if kv_cache is not None:
+            lp, ck, cv = xs
+        else:
+            lp, ck, cv = xs, None, None
+        h, ck, cv = _block(
+            cfg, h, lp, cos, sin,
+            positions=positions,
+            cache_k=ck, cache_v=cv,
+            write_slots=write_slots,
+            kv_mask=kv_mask,
+            attn_fn=attn_fn,
+        )
+        return h, (ck, cv) if kv_cache is not None else None
+
+    if remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+
+    if kv_cache is not None:
+        xs = (params["layers"], kv_cache["k"], kv_cache["v"])
+    else:
+        xs = params["layers"]
+    h, ys = jax.lax.scan(body, h, xs)
+
+    h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = h @ params["embed"]["weight"].astype(h.dtype).T
+    else:
+        logits = h @ params["lm_head"]["kernel"].astype(h.dtype)
+    logits = logits.astype(logits_dtype)
+
+    new_cache = None
+    if kv_cache is not None:
+        new_cache = {"k": ys[0], "v": ys[1]}
+    return logits, new_cache

@@ -1,0 +1,99 @@
+"""Attention ops — XLA reference path.
+
+This is the portable (CPU-testable) attention used for parity work; the
+Pallas TPU kernels in `oryx_tpu/ops/pallas/` are drop-in replacements
+selected by `OryxConfig.attn_impl` (SURVEY.md §2a: flash-attn CUDA →
+Pallas flash attention; flash-attn varlen → segment-id attention).
+
+Conventions:
+  q: [B, Tq, Hq, D]   k/v: [B, Tk, Hk, D]   with Hq % Hk == 0 (GQA).
+  Logits and softmax are computed in float32 regardless of input dtype
+  (the bit-closeness policy, SURVEY.md §7 hard part 2); the probs·V matmul
+  runs in the input dtype so the MXU stays in bf16 on TPU.
+
+Masking model (all optional, combined by logical AND):
+  * causal        — query position i attends to key positions <= i + offset.
+  * segment ids   — packed varlen: token i attends to token j iff
+                    q_segment_ids[b, i] == kv_segment_ids[b, j]. This is the
+                    TPU-native replacement for cu_seqlens varlen attention:
+                    many images packed into one sequence, each attending only
+                    within itself. Padding uses segment id 0 by convention
+                    (still self-consistent; pad outputs are discarded).
+  * kv_mask       — explicit boolean key validity [B, Tk] (KV-cache length
+                    masking during decode, padding masks).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    q_positions: jnp.ndarray | None = None,
+    kv_positions: jnp.ndarray | None = None,
+    q_segment_ids: jnp.ndarray | None = None,
+    kv_segment_ids: jnp.ndarray | None = None,
+    kv_mask: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """General GQA attention. Returns [B, Tq, Hq, D] in q.dtype.
+
+    For causal masking with a KV cache, pass `q_positions`/`kv_positions`
+    (absolute token positions, int32 [B, T*]); without them, positions
+    default to arange (pure prefill).
+    """
+    B, Tq, Hq, D = q.shape
+    _, Tk, Hk, _ = k.shape
+    assert Hq % Hk == 0, f"GQA requires Hq % Hk == 0, got {Hq=} {Hk=}"
+    G = Hq // Hk
+    if scale is None:
+        scale = D**-0.5
+
+    # [B, Tk, Hk, G, ...] grouped layout so k/v are never materialized
+    # repeated (XLA keeps the broadcast virtual on TPU).
+    qg = q.reshape(B, Tq, Hk, G, D)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale  # [B, Hk, G, Tq, Tk] fp32
+
+    mask = None  # [B, 1, 1, Tq, Tk] broadcastable
+
+    def _and(m, new):
+        return new if m is None else jnp.logical_and(m, new)
+
+    if causal:
+        if q_positions is None:
+            q_positions = jnp.arange(Tq, dtype=jnp.int32)[None, :]
+        if kv_positions is None:
+            kv_positions = jnp.arange(Tk, dtype=jnp.int32)[None, :]
+        mask = _and(
+            mask, q_positions[:, :, None] >= kv_positions[:, None, :]
+        )
+    if q_segment_ids is not None:
+        assert kv_segment_ids is not None
+        mask = _and(
+            mask, q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+        )
+    if kv_mask is not None:
+        mask = _and(mask, kv_mask[:, None, :].astype(bool))
+
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    # fp32 softmax; rows that are fully masked (e.g. cache slots past the
+    # current length for padded queries) produce uniform probs over masked
+    # slots — harmless because those outputs are themselves discarded.
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs.astype(v.dtype)
+
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Tq, Hq, D).astype(q.dtype)

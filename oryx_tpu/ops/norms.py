@@ -1,0 +1,43 @@
+"""Normalization ops with a strict fp32-accumulation policy.
+
+Reference parity: Qwen2 RMSNorm and SigLIP LayerNorm (HF implementations;
+SURVEY.md §2 "LLM wrapper" / "OryxViT"). Computation is always performed in
+float32 regardless of input dtype, then cast back — this is the policy that
+makes bf16 TPU runs track the fp32 CUDA reference closely (SURVEY.md §7 hard
+part 2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm as in Qwen2/Llama: x / rms(x) * weight (no bias, no mean sub).
+
+    Matches HF `Qwen2RMSNorm`: variance over the last dim in fp32, weight
+    multiply after the cast back to input dtype.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xf = xf * (1.0 / jnp.sqrt(var + eps))
+    # HF casts the normalized activations back to input dtype *before* the
+    # weight multiply; replicate for bit-closeness.
+    return (weight * xf.astype(dtype)).astype(dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float = 1e-6,
+) -> jnp.ndarray:
+    """LayerNorm (SigLIP / ViT blocks): mean-subtracted, fp32 accumulation."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xf = (xf - mean) * (1.0 / jnp.sqrt(var + eps))
+    out = xf * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(dtype)

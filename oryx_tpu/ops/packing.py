@@ -1,0 +1,174 @@
+"""Host-side packing of variable-resolution visual inputs into static shapes.
+
+This is the TPU-native answer to the reference's `flash_attn_varlen_func` +
+`cu_seqlens` pipeline (SURVEY.md §2a, §7 hard part 1): where CUDA varlen
+kernels consume ragged sequences directly, XLA wants static shapes. We pack
+all images/frames of a batch into ONE padded buffer with:
+
+  * segment ids   — per-patch image membership; attention masks on equality,
+                    so each image attends only within itself (ViT blocks).
+  * region ids    — per-patch compressor-region membership; the Dynamic
+                    Compressor's region cross-attention masks on these.
+  * pos coords    — continuous source-space coordinates into the learned
+                    position-embedding table (bilinear, align_corners=False
+                    semantics), so arbitrary (h, w) grids reuse one table.
+
+Buffer lengths are rounded up to a small set of buckets so XLA compiles a
+bounded number of programs. All code here is numpy on the host; device code
+(models/oryx_vit.py, models/compressor.py) sees only fixed-shape arrays.
+
+Convention: id 0 is padding everywhere; real images/regions are numbered
+from 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+# Default packed-length buckets (patches). Powers-of-two ladder keeps the
+# number of distinct compiled programs small while bounding padding waste
+# at <2x (typically ~25%).
+DEFAULT_BUCKETS = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536)
+
+
+def round_up_bucket(n: int, buckets: tuple[int, ...] = DEFAULT_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} patches exceed the largest bucket {buckets[-1]}")
+
+
+def patchify(image: np.ndarray, patch_size: int) -> tuple[np.ndarray, tuple[int, int]]:
+    """[H, W, C] (H, W multiples of patch_size) → ([h*w, p*p*C], (h, w)).
+
+    Patch-internal pixel order is (py, px, c), matching the conv-kernel
+    flattening in import_hf.import_siglip.
+    """
+    H, W, C = image.shape
+    p = patch_size
+    assert H % p == 0 and W % p == 0, f"image {H}x{W} not multiple of {p}"
+    h, w = H // p, W // p
+    x = image.reshape(h, p, w, p, C).transpose(0, 2, 1, 3, 4)
+    return np.ascontiguousarray(x.reshape(h * w, p * p * C)), (h, w)
+
+
+def posemb_source_coords(h: int, w: int, base_grid: int) -> np.ndarray:
+    """Continuous coords [h*w, 2] into the base_grid×base_grid posemb table.
+
+    Uses torch `F.interpolate(..., mode="bilinear", align_corners=False)`
+    source-coordinate semantics: src = (dst + 0.5) * (G / size) - 0.5, edge
+    clamped by the device-side gather.
+    """
+    ys = (np.arange(h, dtype=np.float32) + 0.5) * (base_grid / h) - 0.5
+    xs = (np.arange(w, dtype=np.float32) + 0.5) * (base_grid / w) - 0.5
+    yy, xx = np.meshgrid(ys, xs, indexing="ij")
+    return np.stack([yy.reshape(-1), xx.reshape(-1)], axis=-1)
+
+
+@dataclasses.dataclass
+class PackedVisual:
+    """One batch of packed visual inputs (all numpy, host-side).
+
+    Patch stream (length P, bucketed):
+      patches      [P, patch_dim] float32 — raw patch pixels (0 on padding)
+      segment_ids  [P] int32 — image membership (0 = pad)
+      region_ids   [P] int32 — compressor region membership (0 = pad)
+      pos_coords   [P, 2] float32 — posemb table coords
+    Query stream (length Q, bucketed) — one query per compressor region:
+      q_segment_ids [Q] int32 — image membership of each query (0 = pad)
+      q_region_ids  [Q] int32 — region id of each query (0 = pad)
+    Bookkeeping:
+      grids        per-image patch grids (h, w)
+      q_grids      per-image query grids (hq, wq)
+      side_factors per-image compressor side factor (1, 2, or 4)
+      num_patches  real (unpadded) patch count
+      num_queries  real (unpadded) query count
+    """
+
+    patches: np.ndarray
+    segment_ids: np.ndarray
+    region_ids: np.ndarray
+    pos_coords: np.ndarray
+    q_segment_ids: np.ndarray
+    q_region_ids: np.ndarray
+    grids: list[tuple[int, int]]
+    q_grids: list[tuple[int, int]]
+    side_factors: list[int]
+    num_patches: int
+    num_queries: int
+
+
+def pack_images(
+    images: list[np.ndarray],
+    *,
+    patch_size: int,
+    base_grid: int,
+    side_factors: list[int] | int = 1,
+    buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+) -> PackedVisual:
+    """Pack preprocessed images (pixel arrays, dims multiples of patch_size)
+    into one static-shape buffer.
+
+    side_factors: compressor downsample factor per spatial side for each
+    image (scalar broadcast). Area compression is the square: 1→1x, 2→4x,
+    4→16x (constants.COMPRESSOR_RATIO).
+    """
+    n = len(images)
+    if isinstance(side_factors, int):
+        side_factors = [side_factors] * n
+    assert len(side_factors) == n
+
+    patch_list, seg_list, reg_list, coord_list = [], [], [], []
+    qseg_list, qreg_list = [], []
+    grids: list[tuple[int, int]] = []
+    q_grids: list[tuple[int, int]] = []
+    next_region = 1
+    for i, (img, s) in enumerate(zip(images, side_factors), start=1):
+        patches, (h, w) = patchify(img, patch_size)
+        grids.append((h, w))
+        patch_list.append(patches)
+        seg_list.append(np.full(h * w, i, np.int32))
+        coord_list.append(posemb_source_coords(h, w, base_grid))
+
+        hq, wq = math.ceil(h / s), math.ceil(w / s)
+        q_grids.append((hq, wq))
+        rows = np.arange(h)[:, None] // s  # [h, 1]
+        cols = np.arange(w)[None, :] // s  # [1, w]
+        rid = next_region + rows * wq + cols  # [h, w]
+        reg_list.append(rid.reshape(-1).astype(np.int32))
+        qseg_list.append(np.full(hq * wq, i, np.int32))
+        qreg_list.append(
+            np.arange(next_region, next_region + hq * wq, dtype=np.int32)
+        )
+        next_region += hq * wq
+
+    patches = np.concatenate(patch_list, axis=0)
+    P_real = patches.shape[0]
+    P = round_up_bucket(P_real, buckets)
+    patch_dim = patches.shape[1]
+
+    def pad_to(arr, length, fill=0):
+        out = np.full((length, *arr.shape[1:]), fill, arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    q_seg = np.concatenate(qseg_list)
+    Q_real = q_seg.shape[0]
+    Q = round_up_bucket(Q_real, buckets)
+
+    return PackedVisual(
+        patches=pad_to(patches.astype(np.float32), P),
+        segment_ids=pad_to(np.concatenate(seg_list), P),
+        region_ids=pad_to(np.concatenate(reg_list), P),
+        pos_coords=pad_to(np.concatenate(coord_list), P),
+        q_segment_ids=pad_to(q_seg, Q),
+        q_region_ids=pad_to(np.concatenate(qreg_list), Q),
+        grids=grids,
+        q_grids=q_grids,
+        side_factors=list(side_factors),
+        num_patches=P_real,
+        num_queries=Q_real,
+    )

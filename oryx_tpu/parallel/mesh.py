@@ -1,0 +1,81 @@
+"""Device-mesh construction (dp, fsdp, tp, sp axes).
+
+Reference parity: the reference's "distributed backend" is NCCL process
+groups set up by the deepspeed launcher (SURVEY.md §2c). The TPU-native
+equivalent is a `jax.sharding.Mesh` whose axes carry all parallelism:
+
+  dp    pure data parallelism (replicated params; gradients psum)
+  fsdp  ZeRO-3-equivalent axis: params/optimizer state sharded, batch also
+        sharded (so dp×fsdp is the total data-parallel width)
+  tp    tensor parallelism (attention heads / MLP columns)
+  sp    sequence/context parallelism (ring attention, ops/ring_attention.py)
+
+Multi-slice pods: `build_hybrid_mesh` puts the slice-local axes on ICI and
+the leading dp axis on DCN (SURVEY.md §5 "Distributed comm backend").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from oryx_tpu.config import MeshConfig
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    """Dense single-slice mesh over ICI. Axis sizes must multiply to the
+    device count; size-1 axes are kept (cheap, keeps PartitionSpecs stable).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if cfg.num_devices != n:
+        raise ValueError(
+            f"mesh {cfg.dp}x{cfg.fsdp}x{cfg.tp}x{cfg.sp}="
+            f"{cfg.num_devices} != {n} devices"
+        )
+    arr = np.asarray(devices).reshape(cfg.dp, cfg.fsdp, cfg.tp, cfg.sp)
+    return Mesh(arr, AXES)
+
+
+def build_hybrid_mesh(cfg: MeshConfig, *, num_slices: int) -> Mesh:
+    """Multi-slice (DCN×ICI) mesh: dp spans slices over DCN; fsdp/tp/sp stay
+    inside each slice on ICI. Requires cfg.dp % num_slices == 0."""
+    from jax.experimental import mesh_utils
+
+    if cfg.dp % num_slices != 0:
+        raise ValueError(f"dp={cfg.dp} not divisible by {num_slices} slices")
+    per_slice_dp = cfg.dp // num_slices
+    dev = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_slice_dp, cfg.fsdp, cfg.tp, cfg.sp),
+        dcn_mesh_shape=(num_slices, 1, 1, 1),
+    )
+    return Mesh(dev, AXES)
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host rendezvous — the NCCL/env-var `init_process_group`
+    equivalent (SURVEY.md §2c). On TPU pods arguments are auto-detected."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_batch_slice(global_batch: int) -> tuple[int, int]:
+    """(start, size) of this host's slice of the global batch — host-side
+    data sharding, one process per host (SURVEY.md §2c(c))."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} % {n} processes != 0")
+    per = global_batch // n
+    return jax.process_index() * per, per

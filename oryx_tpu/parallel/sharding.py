@@ -1,0 +1,156 @@
+"""Parameter/activation sharding rules (GSPMD under jit).
+
+Reference parity: DeepSpeed ZeRO partitioning + NCCL collectives
+(SURVEY.md §2b). Here sharding is declarative: every param leaf gets a
+logical-axis tuple from path-pattern rules, logical axes map to mesh axes,
+and XLA inserts the all-gathers / reduce-scatters (the "kernels" the
+reference gets from DeepSpeed's C++ runtime).
+
+  ZeRO-3 / FSDP  → mode="fsdp":  params sharded on the fsdp axis
+  ZeRO-2         → mode="zero2": params replicated, optimizer state sharded
+  DDP            → mode="ddp":   everything replicated over dp
+
+Tensor parallelism composes orthogonally: head/mlp/vocab logical axes map
+to "tp" whenever cfg.mesh.tp > 1.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+# path-pattern → logical axes (matched with fnmatch on "/"-joined paths;
+# first match wins; patterns cover llm/vit/compressor subtrees).
+LOGICAL_RULES: tuple[tuple[str, tuple[str | None, ...]], ...] = (
+    # LLM (stacked layers: leading "layer" axis)
+    ("llm/embed/weight", ("vocab", "embed")),
+    ("llm/layers/*_norm/weight", ("layer", None)),
+    ("llm/layers/q_proj/kernel", ("layer", "embed", "heads")),
+    ("llm/layers/k_proj/kernel", ("layer", "embed", "heads")),
+    ("llm/layers/v_proj/kernel", ("layer", "embed", "heads")),
+    ("llm/layers/o_proj/kernel", ("layer", "heads", "embed")),
+    ("llm/layers/*_proj/bias", ("layer", "heads")),
+    ("llm/layers/gate_proj/kernel", ("layer", "embed", "mlp")),
+    ("llm/layers/up_proj/kernel", ("layer", "embed", "mlp")),
+    ("llm/layers/down_proj/kernel", ("layer", "mlp", "embed")),
+    ("llm/final_norm/weight", (None,)),
+    ("llm/lm_head/kernel", ("embed", "vocab")),
+    # Vision tower
+    ("vit/patch_embed/kernel", (None, "embed")),
+    ("vit/patch_embed/bias", ("embed",)),
+    ("vit/pos_embed/weight", (None, "embed")),
+    ("vit/layers/norm*/weight", ("layer", None)),
+    ("vit/layers/norm*/bias", ("layer", None)),
+    ("vit/layers/?_proj/kernel", ("layer", "embed", "heads")),
+    ("vit/layers/o_proj/kernel", ("layer", "heads", "embed")),
+    ("vit/layers/?_proj/bias", ("layer", "heads")),
+    ("vit/layers/o_proj/bias", ("layer", "embed")),
+    ("vit/layers/fc1/kernel", ("layer", "embed", "mlp")),
+    ("vit/layers/fc1/bias", ("layer", "mlp")),
+    ("vit/layers/fc2/kernel", ("layer", "mlp", "embed")),
+    ("vit/layers/fc2/bias", ("layer", "embed")),
+    ("vit/post_norm/*", (None,)),
+    # Compressor (small; shard the projector matmuls only)
+    ("compressor/projector/fc1/kernel", ("embed", "mlp")),
+    ("compressor/projector/fc2/kernel", ("mlp", "embed")),
+    ("compressor/*/kernel", (None, None)),
+    ("compressor/*/bias", (None,)),
+    ("compressor/*/weight", (None,)),
+)
+
+# logical axis → mesh axis, per mode.
+def mesh_rules(mode: str) -> dict[str, str | None]:
+    base = {"layer": None, "vocab": None, "heads": "tp", "mlp": "tp",
+            "embed": None}
+    if mode == "fsdp":
+        base["embed"] = "fsdp"
+    elif mode not in ("zero2", "ddp"):
+        raise ValueError(f"unknown sharding mode {mode!r}")
+    return base
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        p.key if hasattr(p, "key") else str(getattr(p, "idx", p))
+        for p in path
+    )
+
+
+def logical_axes(params: Params) -> Params:
+    """Pytree of logical-axis tuples, same structure as params."""
+
+    def lookup(path, leaf):
+        s = _path_str(path)
+        for pat, axes in LOGICAL_RULES:
+            if fnmatch.fnmatch(s, pat):
+                if len(axes) != leaf.ndim:
+                    raise ValueError(
+                        f"rule {pat} has {len(axes)} axes but {s} is "
+                        f"rank {leaf.ndim}"
+                    )
+                return axes
+        return (None,) * leaf.ndim  # replicate unknown leaves
+
+    return jax.tree_util.tree_map_with_path(lookup, params)
+
+
+def param_specs(params: Params, mode: str = "fsdp") -> Params:
+    """Pytree of PartitionSpecs for params (also correct for same-shaped
+    optimizer-state leaves)."""
+    rules = mesh_rules(mode)
+
+    def to_spec(axes):
+        return P(*(rules.get(a) if a is not None else None for a in axes))
+
+    return jax.tree.map(
+        to_spec, logical_axes(params),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def param_shardings(mesh: Mesh, params: Params, mode: str = "fsdp") -> Params:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(params, mode),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Params, shardings: Params) -> Params:
+    """Place (or re-place) a param pytree onto the mesh."""
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def batch_spec() -> P:
+    """Activations/batch shard over the full data-parallel width."""
+    return P(("dp", "fsdp"))
+
+
+def opt_state_specs(opt_state, params: Params, mode: str = "fsdp"):
+    """Shardings for optax state: leaves with a param-shaped counterpart
+    inherit that param's spec; scalars/steps replicate.
+
+    For ZeRO-2 the optimizer state shards over fsdp even though params
+    replicate — pass mode="fsdp" here with mode="zero2" for params.
+    """
+    specs = param_specs(params, mode)
+    flat_specs = {
+        tuple(str(p) for p in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+
+    def match(path, leaf):
+        suffix = tuple(str(p) for p in path)
+        for ppath, spec in flat_specs.items():
+            if suffix[-len(ppath):] == ppath:
+                if hasattr(leaf, "ndim") and leaf.ndim == len(spec):
+                    return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(match, opt_state)

@@ -1,0 +1,278 @@
+"""SFT data pipeline: lazy conversation dataset → packed multimodal batches.
+
+Reference parity: `LazySupervisedDataset`, per-template `preprocess_*`
+tokenization with label masking, and `DataCollatorForSupervisedDataset`
+in `oryx/train/train.py`, plus the modality-grouped sampler of
+`oryx/train/oryx_trainer.py` (SURVEY.md §2 "Training entry" / "Trainer
+subclass"). Record schema is the LLaVA-mix JSON family:
+
+    {"id": ..., "conversations": [{"from": "human"|"gpt", "value": ...}],
+     "image": path | [paths], "video": path}
+
+TPU-first differences: the collator emits the static-shape packed arrays
+(ops/packing + models/splice) that feed the jitted step directly — all
+raggedness is resolved host-side; batches are length- AND modality-grouped
+so bucket padding waste stays low; media decode is pluggable (a host-side
+CPU concern, SURVEY.md §2a last row).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+from oryx_tpu.constants import (
+    COMPRESSOR_RATIO,
+    DEFAULT_IMAGE_TOKEN,
+    IGNORE_INDEX,
+    MODALITY_IMAGE,
+    MODALITY_MULTI_IMAGE,
+    MODALITY_VIDEO,
+)
+from oryx_tpu.conversation import Conversation, conv_templates
+from oryx_tpu.data import mm_utils
+from oryx_tpu.models import splice
+from oryx_tpu.ops import packing
+
+
+def record_modality(rec: dict[str, Any]) -> str:
+    if rec.get("video") is not None:
+        return MODALITY_VIDEO
+    img = rec.get("image")
+    if isinstance(img, (list, tuple)) and len(img) > 1:
+        return MODALITY_MULTI_IMAGE
+    return MODALITY_IMAGE
+
+
+def side_factor(modality: str) -> int:
+    return int(COMPRESSOR_RATIO[modality] ** 0.5)
+
+
+def preprocess_conversation(
+    rec: dict[str, Any],
+    tokenizer,
+    conv: Conversation,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Tokenize one record with label masking.
+
+    Returns (input_ids with IMAGE_TOKEN_INDEX sentinels, labels aligned to
+    input_ids with IGNORE_INDEX on everything except assistant replies —
+    the reference's per-template `preprocess_qwen`-style masking).
+    """
+    ids: list[int] = []
+    labels: list[int] = []
+
+    def emit(text: str, supervised: bool):
+        toks = mm_utils.tokenizer_image_token(text, tokenizer)
+        ids.extend(int(t) for t in toks)
+        labels.extend(
+            (int(t) if supervised and t >= 0 else IGNORE_INDEX) for t in toks
+        )
+
+    if conv.system:
+        emit(f"<|im_start|>system\n{conv.system}{conv.sep}", False)
+    role_map = {"human": conv.roles[0], "gpt": conv.roles[1]}
+    for msg in rec["conversations"]:
+        role = role_map.get(msg["from"], msg["from"])
+        supervised = msg["from"] == "gpt"
+        emit(f"<|im_start|>{role}\n", False)
+        emit(f"{msg['value']}{conv.sep}", supervised)
+    return np.asarray(ids, np.int64), np.asarray(labels, np.int64)
+
+
+@dataclass
+class Example:
+    """One preprocessed sample (host-side, pre-batching)."""
+
+    input_ids: np.ndarray  # with sentinels
+    labels: np.ndarray
+    images: list[np.ndarray]  # preprocessed pixel arrays (patch-multiple)
+    modality: str
+
+    @property
+    def approx_len(self) -> int:
+        """Text tokens + compressed visual tokens (for length grouping)."""
+        s = side_factor(self.modality)
+        vis = sum(
+            -(-(img.shape[0] // 14) // s) * -(-(img.shape[1] // 14) // s)
+            for img in self.images
+        )
+        return len(self.input_ids) + vis
+
+
+class SupervisedDataset:
+    """Lazy JSON-conversation dataset.
+
+    media_loader(record) -> list of raw HWC uint8/float arrays (images, or
+    sampled video frames). Defaults to PIL file loading for "image" records;
+    videos require an explicit loader (decord/ffmpeg stay host-side deps).
+    """
+
+    def __init__(
+        self,
+        records: Sequence[dict[str, Any]] | str,
+        tokenizer,
+        *,
+        template: str = "qwen",
+        patch_size: int = 14,
+        max_patches_per_image: int = 4096,
+        video_frames: int = 64,
+        media_loader: Callable[[dict[str, Any]], list[np.ndarray]] | None = None,
+    ) -> None:
+        if isinstance(records, str):
+            with open(records) as f:
+                records = json.load(f)
+        self.records = list(records)
+        self.tokenizer = tokenizer
+        self.conv = conv_templates[template]
+        self.patch_size = patch_size
+        self.max_patches = max_patches_per_image
+        self.video_frames = video_frames
+        self.media_loader = media_loader or self._default_loader
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def _default_loader(self, rec: dict[str, Any]) -> list[np.ndarray]:
+        paths = rec.get("image")
+        if paths is None:
+            raise ValueError(
+                "video records need an explicit media_loader "
+                f"(record id {rec.get('id')})"
+            )
+        if isinstance(paths, str):
+            paths = [paths]
+        from PIL import Image
+
+        return [np.asarray(Image.open(p).convert("RGB")) for p in paths]
+
+    def __getitem__(self, i: int) -> Example:
+        rec = self.records[i]
+        modality = record_modality(rec)
+        raw = self.media_loader(rec) if (
+            rec.get("image") is not None or rec.get("video") is not None
+        ) else []
+        # Video frames share one budget; images each get the full cap.
+        per_img_cap = (
+            max(1, self.max_patches // max(len(raw), 1))
+            if modality == MODALITY_VIDEO else self.max_patches
+        )
+        images = [
+            mm_utils.preprocess_image(img, self.patch_size, per_img_cap)
+            for img in raw
+        ]
+        ids, labels = preprocess_conversation(rec, self.tokenizer, self.conv)
+        n_sentinels = int(np.sum(ids == -200))
+        if n_sentinels != len(images):
+            # Reference behavior: video/multi-image records carry one
+            # placeholder expanded to all frames.
+            if n_sentinels == 1 and len(images) > 1:
+                pass  # one sentinel consumes frames sequentially (collator)
+            else:
+                raise ValueError(
+                    f"record {rec.get('id')}: {n_sentinels} image tokens vs "
+                    f"{len(images)} images"
+                )
+        return Example(ids, labels, images, modality)
+
+
+def collate(
+    examples: Sequence[Example],
+    *,
+    patch_size: int = 14,
+    base_grid: int = 27,
+    max_len: int | None = None,
+    buckets: tuple[int, ...] = packing.DEFAULT_BUCKETS,
+) -> dict[str, np.ndarray]:
+    """Pack a list of Examples into one static-shape training batch
+    (all BATCH_FIELDS of train.step, numpy)."""
+    all_images: list[np.ndarray] = []
+    factors: list[int] = []
+    per_sample_ids: list[np.ndarray] = []
+    per_sample_labels: list[np.ndarray] = []
+    image_counts: list[int] = []
+    for ex in examples:
+        ids, labels = ex.input_ids, ex.labels
+        n_sent = int(np.sum(ids == -200))
+        if n_sent == 1 and len(ex.images) > 1:
+            # Expand the single placeholder to one sentinel per frame.
+            idx = int(np.where(ids == -200)[0][0])
+            ids = np.concatenate(
+                [ids[:idx], np.full(len(ex.images), -200, ids.dtype),
+                 ids[idx + 1:]]
+            )
+            labels = np.concatenate(
+                [labels[:idx],
+                 np.full(len(ex.images), IGNORE_INDEX, labels.dtype),
+                 labels[idx + 1:]]
+            )
+        per_sample_ids.append(ids)
+        per_sample_labels.append(labels)
+        all_images.extend(ex.images)
+        factors.extend([side_factor(ex.modality)] * len(ex.images))
+        image_counts.append(len(ex.images))
+
+    packed = packing.pack_images(
+        all_images, patch_size=patch_size, base_grid=base_grid,
+        side_factors=factors, buckets=buckets,
+    )
+    slots = splice.query_slots(packed)
+    batch = splice.build_mm_batch(
+        per_sample_ids, slots, labels=per_sample_labels,
+        max_len=max_len, buckets=buckets,
+    )
+    return {
+        "patches": packed.patches,
+        "segment_ids": packed.segment_ids,
+        "pos_coords": packed.pos_coords,
+        "region_ids": packed.region_ids,
+        "q_region_ids": packed.q_region_ids,
+        "token_ids": batch.token_ids,
+        "visual_idx": batch.visual_idx,
+        "is_visual": batch.is_visual,
+        "attn_mask": batch.attn_mask,
+        "positions": batch.positions,
+        "labels": batch.labels,
+    }
+
+
+def grouped_batch_iterator(
+    dataset: SupervisedDataset,
+    batch_size: int,
+    *,
+    seed: int = 0,
+    num_epochs: int | None = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    **collate_kw,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Modality-grouped, shuffled, per-process-sharded batch stream.
+
+    The reference's modality-grouped LengthGroupedSampler: indices are
+    shuffled within modality groups so image and video samples never share
+    a batch (their compression ratios and shapes differ wildly), then
+    round-robined across processes (host-side data sharding, SURVEY.md
+    §2c(c)).
+    """
+    rng = np.random.default_rng(seed)
+    by_mod: dict[str, list[int]] = {}
+    for i in range(len(dataset)):
+        by_mod.setdefault(record_modality(dataset.records[i]), []).append(i)
+
+    epoch = 0
+    while num_epochs is None or epoch < num_epochs:
+        batches: list[list[int]] = []
+        for idxs in by_mod.values():
+            idxs = list(idxs)
+            rng.shuffle(idxs)
+            for j in range(0, len(idxs) - batch_size + 1, batch_size):
+                batches.append(idxs[j : j + batch_size])
+        rng.shuffle(batches)
+        for bi, b in enumerate(batches):
+            if bi % process_count != process_index:
+                continue
+            yield collate([dataset[i] for i in b], **collate_kw)
+        epoch += 1

@@ -1,0 +1,40 @@
+"""Masked causal-LM loss.
+
+Reference parity: HF Trainer's CE over shifted logits with labels ==
+IGNORE_INDEX masked out (SURVEY.md §3.1 "loss = CE(shifted logits,
+labels≠IGNORE_INDEX)"). Labels arrive PRE-SHIFTED from
+splice.build_mm_batch (labels[t] is the target for the prediction at t),
+so this is a pure masked softmax-CE. Accumulation in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.constants import IGNORE_INDEX
+
+
+def causal_lm_loss(
+    logits: jnp.ndarray,  # [B, T, V] (any float dtype; promoted to fp32)
+    labels: jnp.ndarray,  # [B, T] int32, IGNORE_INDEX where unsupervised
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Returns (mean CE over supervised tokens, metrics dict)."""
+    logits = logits.astype(jnp.float32)
+    mask = labels != IGNORE_INDEX
+    safe_labels = jnp.where(mask, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, safe_labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    tok_loss = (logz - gold) * mask
+    num = jnp.maximum(jnp.sum(mask), 1)
+    loss = jnp.sum(tok_loss) / num
+    metrics = {
+        "loss": loss,
+        "num_tokens": jnp.sum(mask).astype(jnp.int32),
+        "accuracy": jnp.sum(
+            (jnp.argmax(logits, axis=-1) == safe_labels) * mask
+        ) / num,
+    }
+    return loss, metrics

@@ -1,0 +1,123 @@
+"""Optimizer construction: AdamW + warmup-cosine with per-group LRs and
+freeze masks.
+
+Reference parity: DeepSpeed fused AdamW + HF cosine schedule with
+`warmup_ratio`, plus `OryxTrainer`'s optimizer param-grouping (separate
+projector / vision-tower LRs) and the freeze/unfreeze logic in train()
+(`tune_mm_mlp_adapter`, SURVEY.md §2 "Trainer subclass" / "Training
+entry"). Sharded optimizer state (= ZeRO's partitioned Adam moments) comes
+from parallel/sharding.opt_state_specs, not from the optimizer itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+
+from oryx_tpu.config import TrainConfig
+
+Params = dict[str, Any]
+
+
+def _group_of(path: tuple[str, ...]) -> str:
+    top = path[0] if path else ""
+    if top == "compressor":
+        return "projector"
+    if top == "vit":
+        return "vision"
+    return "llm"
+
+
+def param_groups(params: Params) -> Params:
+    """Label every leaf 'llm' / 'projector' / 'vision'."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _group_of(
+            tuple(p.key for p in path if hasattr(p, "key"))
+        ),
+        params,
+    )
+
+
+def trainable_mask(params: Params, tune: str) -> Params:
+    """tune: 'full' | 'projector_only' | 'no_vision' (reference freeze
+    modes: full FT, stage-1 adapter pretraining, frozen vision tower)."""
+    groups = param_groups(params)
+    allowed = {
+        "full": {"llm", "projector", "vision"},
+        "projector_only": {"projector"},
+        "no_vision": {"llm", "projector"},
+    }[tune]
+    return jax.tree.map(lambda g: g in allowed, groups)
+
+
+def make_schedule(cfg: TrainConfig, base_lr: float) -> optax.Schedule:
+    warmup = max(1, int(cfg.warmup_ratio * cfg.num_train_steps))
+    if cfg.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, base_lr, warmup, max(cfg.num_train_steps, warmup + 1), 0.0
+        )
+    if cfg.lr_schedule == "linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, base_lr, warmup),
+                optax.linear_schedule(
+                    base_lr, 0.0, max(cfg.num_train_steps - warmup, 1)
+                ),
+            ],
+            [warmup],
+        )
+    if cfg.lr_schedule == "constant":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, base_lr, warmup),
+             optax.constant_schedule(base_lr)],
+            [warmup],
+        )
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+
+
+def make_optimizer(
+    cfg: TrainConfig, params: Params
+) -> optax.GradientTransformation:
+    """AdamW with grad clipping, per-group LR schedules, and freeze mask.
+
+    Weight decay follows the reference's HF-Trainer convention: applied to
+    all params except norms/biases (ndim < 2).
+    """
+    def adamw(lr_schedule):
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.scale_by_adam(
+                b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps
+            ),
+            optax.add_decayed_weights(
+                cfg.weight_decay,
+                mask=lambda p: jax.tree.map(lambda x: x.ndim >= 2, p),
+            )
+            if cfg.weight_decay else optax.identity(),
+            optax.scale_by_learning_rate(lr_schedule),
+        )
+
+    lrs = {
+        "llm": cfg.learning_rate,
+        "projector": cfg.projector_lr or cfg.learning_rate,
+        "vision": cfg.vision_lr or cfg.learning_rate,
+    }
+    tx = optax.multi_transform(
+        {g: adamw(make_schedule(cfg, lr)) for g, lr in lrs.items()},
+        param_groups(params),
+    )
+    mask = trainable_mask(params, cfg.tune)
+    if not all(jax.tree.leaves(mask)):
+        tx = optax.chain(
+            optax.masked(tx, mask),
+            # Hard-zero frozen grads so masked branches stay untouched.
+            optax.masked(
+                optax.set_to_zero(), jax.tree.map(lambda m: not m, mask)
+            ),
+        )
+    # NOTE: gradient accumulation is handled by the microbatch scan inside
+    # train.step.train_step (not optax.MultiSteps), so the optimizer state
+    # carries no extra accumulation buffers.
+    return tx

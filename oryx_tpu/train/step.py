@@ -1,0 +1,120 @@
+"""Training state + jitted SFT step (single program over the mesh).
+
+Reference parity: the HF Trainer + DeepSpeed step loop (SURVEY.md §3.1):
+forward (ViT → compressor → splice → decoder), masked CE, backward,
+AdamW — but compiled as ONE XLA program per microbatch group. Gradient
+reduction, ZeRO sharding collectives and the fused optimizer all come out
+of GSPMD given the shardings from parallel/sharding.py; remat
+(gradient_checkpointing) is applied per scan-block inside the model.
+
+Grad accumulation: a `lax.scan` over leading-axis microbatches, averaging
+losses/grads in fp32 — equivalent to DeepSpeed's accumulate-then-step with
+no Python-side loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from oryx_tpu.config import OryxConfig
+from oryx_tpu.models import oryx
+from oryx_tpu.train.loss import causal_lm_loss
+
+Params = dict[str, Any]
+
+BATCH_FIELDS = (
+    "patches", "segment_ids", "pos_coords", "region_ids", "q_region_ids",
+    "token_ids", "visual_idx", "is_visual", "attn_mask", "positions",
+    "labels",
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Params
+    opt_state: Any
+
+
+def init_state(
+    cfg: OryxConfig, tx: optax.GradientTransformation, key: jax.Array
+) -> TrainState:
+    params = oryx.init_params(cfg, key)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+    )
+
+
+def microbatch_loss(
+    params: Params, cfg: OryxConfig, mb: dict[str, jnp.ndarray]
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    logits = oryx.forward(
+        params, cfg,
+        patches=mb["patches"], segment_ids=mb["segment_ids"],
+        pos_coords=mb["pos_coords"], region_ids=mb["region_ids"],
+        q_region_ids=mb["q_region_ids"],
+        token_ids=mb["token_ids"], visual_idx=mb["visual_idx"],
+        is_visual=mb["is_visual"], attn_mask=mb["attn_mask"],
+        positions=mb["positions"],
+        remat=cfg.train.remat,
+        compute_dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+            cfg.dtype
+        ],
+    )
+    return causal_lm_loss(logits, mb["labels"])
+
+
+@partial(jax.jit, static_argnames=("cfg", "tx"), donate_argnames=("state",))
+def train_step(
+    state: TrainState,
+    batch: dict[str, jnp.ndarray],
+    cfg: OryxConfig,
+    tx: optax.GradientTransformation,
+) -> tuple[TrainState, dict[str, jnp.ndarray]]:
+    """One optimizer step over `accum` microbatches.
+
+    batch: each leaf has leading [accum, ...] microbatch axis (accum == 1
+    for plain steps); visual buffers are packed per-microbatch.
+    """
+    grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
+
+    def one_micro(carry, mb):
+        grads_acc, loss_acc, ntok_acc = carry
+        (loss, metrics), grads = grad_fn(state.params, cfg, mb)
+        grads_acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+        )
+        return (
+            grads_acc, loss_acc + loss, ntok_acc + metrics["num_tokens"]
+        ), metrics
+
+    accum = jax.tree.leaves(batch)[0].shape[0]
+    zeros = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+    )
+    (grads, loss_sum, ntok), _ = jax.lax.scan(
+        one_micro, (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        batch,
+    )
+    grads = jax.tree.map(lambda g: g / accum, grads)
+
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    metrics = {
+        "loss": loss_sum / accum,
+        "grad_norm": optax.global_norm(grads),
+        "num_tokens": ntok,
+    }
+    return (
+        TrainState(step=state.step + 1, params=params, opt_state=opt_state),
+        metrics,
+    )

@@ -1,0 +1,126 @@
+"""SFT trainer: mesh setup, sharded state, step loop, checkpoint/resume.
+
+Reference parity: `train()` in `oryx/train/train.py` + the HF
+Trainer/DeepSpeed loop (SURVEY.md §3.1), re-composed TPU-first:
+mesh + GSPMD shardings replace the DeepSpeed engine; the jitted
+`train.step.train_step` replaces forward/backward/fused-Adam; orbax
+replaces ZeRO partitioned checkpoints. Entry scripts call `Trainer.fit()`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.config import OryxConfig
+from oryx_tpu.models import oryx
+from oryx_tpu.parallel import mesh as mesh_lib
+from oryx_tpu.parallel import sharding
+from oryx_tpu.train import step as step_lib
+from oryx_tpu.train.optimizer import make_optimizer
+from oryx_tpu.utils.checkpoint import CheckpointManager
+from oryx_tpu.utils.metrics import MetricLogger, rank0_print
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: OryxConfig,
+        *,
+        params: dict[str, Any] | None = None,
+        sharding_mode: str = "fsdp",
+        metrics_path: str | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh_lib.build_mesh(cfg.mesh)
+        self.sharding_mode = sharding_mode
+        self.logger = MetricLogger(metrics_path, log_every=cfg.train.log_every)
+        self.ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+
+        with self.mesh:
+            if params is None:
+                params = oryx.init_params(cfg, jax.random.key(cfg.train.seed))
+            self.tx = make_optimizer(cfg.train, params)
+            pspecs = sharding.param_shardings(self.mesh, params, sharding_mode)
+            params = sharding.shard_params(params, pspecs)
+            opt_state = self.tx.init(params)
+            opt_mode = "fsdp" if sharding_mode in ("fsdp", "zero2") else "ddp"
+            ospecs = sharding.opt_state_specs(opt_state, params, opt_mode)
+            opt_state = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, jax.sharding.NamedSharding(self.mesh, s)
+                ),
+                opt_state, ospecs,
+            )
+            self.state = step_lib.TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=opt_state,
+            )
+
+    def resume_if_available(self) -> int:
+        """Restore latest checkpoint if present; returns start step."""
+        if self.ckpt.latest_step() is None:
+            return 0
+        self.state = self.ckpt.restore(self.state)
+        start = int(self.state.step)
+        rank0_print(f"resumed from step {start}")
+        return start
+
+    def _device_batch(self, batch: dict[str, np.ndarray]) -> dict[str, Any]:
+        """Host batch → device with [accum, ...] leading axis and batch
+        sharding over (dp, fsdp)."""
+        accum = self.cfg.train.grad_accum_steps
+        bspec = sharding.batch_spec()
+
+        def put(x):
+            x = np.asarray(x)
+            if accum > 1:
+                # Leading batch-ish axis split into [accum, ...].
+                assert x.shape[0] % accum == 0, (x.shape, accum)
+                x = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+            else:
+                x = x[None]
+            # Shard the per-microbatch leading axis where divisible;
+            # replicate otherwise (packed visual buffers are global).
+            width = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+            spec = (
+                jax.sharding.PartitionSpec(None, *bspec)
+                if x.shape[1] % max(width, 1) == 0
+                else jax.sharding.PartitionSpec()
+            )
+            return jax.device_put(
+                jnp.asarray(x), jax.sharding.NamedSharding(self.mesh, spec)
+            )
+
+        return {k: put(v) for k, v in batch.items()}
+
+    def fit(
+        self,
+        batches: Iterator[dict[str, np.ndarray]],
+        *,
+        num_steps: int | None = None,
+        resume: bool = True,
+    ) -> step_lib.TrainState:
+        cfg = self.cfg
+        num_steps = num_steps or cfg.train.num_train_steps
+        start = self.resume_if_available() if resume else 0
+        with self.mesh:
+            for step_i in range(start, num_steps):
+                try:
+                    host_batch = next(batches)
+                except StopIteration:
+                    rank0_print("data exhausted; stopping")
+                    break
+                batch = self._device_batch(host_batch)
+                self.state, metrics = step_lib.train_step(
+                    self.state, batch, cfg, self.tx
+                )
+                self.logger.log_step(step_i + 1, jax.device_get(metrics))
+                if (step_i + 1) % cfg.train.checkpoint_every == 0:
+                    self.ckpt.save(step_i + 1, self.state)
+        self.ckpt.save(num_steps, self.state, force=True)
+        self.ckpt.wait()
+        return self.state

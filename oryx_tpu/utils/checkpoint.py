@@ -1,0 +1,91 @@
+"""Checkpoint / resume via orbax (async, sharded-native).
+
+Reference parity: HF Trainer `save_steps` checkpoints + DeepSpeed ZeRO
+per-rank partitioned state + `zero_to_fp32.py` consolidation +
+`safe_save_model_for_hf_trainer` / projector-only partial saves
+(SURVEY.md §5 "Checkpoint / resume"). Orbax writes sharded arrays
+natively, so there is no consolidation step; interop with reference
+checkpoints goes through models/import_hf (safetensors import/export).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+Params = dict[str, Any]
+
+
+class CheckpointManager:
+    """Async step-numbered checkpoints with retention, plus resume."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3) -> None:
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Async-save a pytree (TrainState or bare params)."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, state_like: Any, step: int | None = None) -> Any:
+        """Restore into the structure/shardings of `state_like` (an
+        abstract or concrete pytree of the same shape)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(state_like)
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until pending async saves finish."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_projector_only(path: str, params: Params) -> None:
+    """Stage-1-style partial checkpoint: compressor/projector weights only
+    (the reference's `mm_projector.bin` analog), as a flat npz."""
+    flat = jax.tree_util.tree_flatten_with_path(params["compressor"])[0]
+    arrays = {
+        "/".join(p.key for p in path): np.asarray(leaf)
+        for path, leaf in flat
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **arrays)
+
+
+def load_projector_only(path: str, params: Params) -> Params:
+    """Merge a projector-only checkpoint into a full param tree (the
+    reference's `pretrain_mm_mlp_adapter` load path, SURVEY.md §3.3)."""
+    data = np.load(path)
+    comp = params["compressor"]
+
+    def fill(path, leaf):
+        key = "/".join(p.key for p in path)
+        if key in data:
+            arr = data[key]
+            assert arr.shape == leaf.shape, (key, arr.shape, leaf.shape)
+            return jax.numpy.asarray(arr, dtype=leaf.dtype)
+        return leaf
+
+    new_comp = jax.tree_util.tree_map_with_path(fill, comp)
+    return {**params, "compressor": new_comp}

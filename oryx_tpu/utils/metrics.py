@@ -1,0 +1,71 @@
+"""Metrics / logging / observability.
+
+Reference parity: HF Trainer `report_to` (wandb/tensorboard) with loss,
+LR, grad-norm, it/s, plus `rank0_print` (SURVEY.md §5 "Metrics"). Here:
+a structured CSV/JSONL writer plus stdout logging on process 0, tracking
+the north-star metric tokens/sec/chip; TensorBoard/wandb attach via the
+same record dict if present.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any
+
+import jax
+
+
+def rank0_print(*args, **kwargs) -> None:
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+class MetricLogger:
+    """JSONL metric stream + rolling throughput (tokens/sec/chip)."""
+
+    def __init__(self, path: str | None = None, *, log_every: int = 10):
+        self.path = path
+        self.log_every = log_every
+        self._f = None
+        if path and jax.process_index() == 0:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a")
+        self._last_time = time.perf_counter()
+        self._last_step = 0
+        self._tokens_since = 0
+
+    def log_step(self, step: int, metrics: dict[str, Any]) -> None:
+        self._tokens_since += int(metrics.get("num_tokens", 0))
+        if step % self.log_every != 0:
+            return
+        now = time.perf_counter()
+        dt = max(now - self._last_time, 1e-9)
+        nsteps = max(step - self._last_step, 1)
+        n_chips = jax.device_count()
+        rec = {
+            "step": step,
+            **{
+                k: float(v) for k, v in metrics.items()
+                if k != "num_tokens"
+            },
+            "steps_per_sec": nsteps / dt,
+            "tokens_per_sec_per_chip": self._tokens_since / dt / n_chips,
+        }
+        self._last_time, self._last_step = now, step
+        self._tokens_since = 0
+        rank0_print(
+            f"step {step}: " + " ".join(
+                f"{k}={v:.4g}" for k, v in rec.items() if k != "step"
+            )
+        )
+        if self._f:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()

@@ -7,7 +7,19 @@ shard_map / tp tests run anywhere with no TPU. Must run before any
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment pins JAX_PLATFORMS to the real
+# TPU platform, and two processes contending for the single chip deadlock.
+# Tests always run on the forced-host CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Defense-in-depth: sitecustomize has already run by now, but an empty
+# PALLAS_AXON_POOL_IPS keeps any late axon code path from claiming the
+# chip. The real guard is launching pytest with
+# `PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu` (see .claude/skills/verify).
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# Persistent compilation cache: this box has 1 CPU core and recompiles
+# dominate test wall-clock; cache survives across pytest runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,171 @@
+"""End-to-end slice tests (SURVEY.md §7 Stage 3 / BASELINE config 1):
+splice correctness, greedy generation vs HF, and full multimodal
+image→answer decode on CPU with tiny configs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.constants import IGNORE_INDEX, IMAGE_TOKEN_INDEX
+from oryx_tpu.models import generate as gen_lib
+from oryx_tpu.models import import_hf, oryx, qwen2, splice
+from oryx_tpu.ops import packing
+
+
+def test_build_mm_batch_layout():
+    ids0 = np.array([5, 6, IMAGE_TOKEN_INDEX, 7], np.int64)
+    ids1 = np.array([9, IMAGE_TOKEN_INDEX, 10, IMAGE_TOKEN_INDEX], np.int64)
+    labels0 = np.array([IGNORE_INDEX, IGNORE_INDEX, IGNORE_INDEX, 7], np.int64)
+    # image slots: sample0 img -> (0, 3); sample1 imgs -> (3, 2), (5, 4)
+    batch = splice.build_mm_batch(
+        [ids0, ids1], [(0, 3), (3, 2), (5, 4)],
+        labels=[labels0, None if False else np.full(4, IGNORE_INDEX)],
+        buckets=(16,),
+    )
+    assert batch.token_ids.shape == (2, 16)
+    # Row 0: text(2) + vis(3) + text(1) = 6
+    assert batch.lengths[0] == 6
+    np.testing.assert_array_equal(batch.is_visual[0, :6],
+                                  [False, False, True, True, True, False])
+    np.testing.assert_array_equal(batch.visual_idx[0, 2:5], [0, 1, 2])
+    assert batch.token_ids[0, 5] == 7
+    # Row 1: text(1) + vis(2) + text(1) + vis(4) = 8
+    assert batch.lengths[1] == 8
+    np.testing.assert_array_equal(batch.visual_idx[1, 1:3], [3, 4])
+    np.testing.assert_array_equal(batch.visual_idx[1, 4:8], [5, 6, 7, 8])
+    #
+
+    # Labels were shifted by one: position 4 supervises token at slot 5 (=7).
+    assert batch.labels[0, 4] == 7
+    assert np.all(batch.labels[0, 5:] == IGNORE_INDEX)
+
+
+def test_mm_batch_missing_sentinel_raises():
+    with pytest.raises(ValueError):
+        splice.build_mm_batch([np.array([1, 2])], [(0, 3)], buckets=(16,))
+
+
+def test_embed_spliced_gather():
+    table = jnp.asarray(np.arange(20, dtype=np.float32).reshape(10, 2))
+    vis = jnp.asarray(100 + np.arange(8, dtype=np.float32).reshape(4, 2))
+    token_ids = jnp.asarray([[1, 0, 2]])
+    visual_idx = jnp.asarray([[0, 3, 0]])
+    is_visual = jnp.asarray([[False, True, False]])
+    out = np.asarray(
+        splice.embed_spliced(table, vis, token_ids, visual_idx, is_visual)
+    )
+    np.testing.assert_array_equal(out[0, 0], [2, 3])      # token 1
+    np.testing.assert_array_equal(out[0, 1], [106, 107])  # vis row 3
+    np.testing.assert_array_equal(out[0, 2], [4, 5])      # token 2
+
+
+def test_greedy_generate_matches_hf():
+    """Greedy text-only generation equals HF generate (tiny random model)."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    tiny = cfg_lib.tiny_llm(vocab_size=128)
+    torch.manual_seed(0)
+    hf = Qwen2ForCausalLM(
+        Qwen2Config(
+            vocab_size=tiny.vocab_size, hidden_size=tiny.hidden_size,
+            intermediate_size=tiny.intermediate_size,
+            num_hidden_layers=tiny.num_layers,
+            num_attention_heads=tiny.num_heads,
+            num_key_value_heads=tiny.num_kv_heads, head_dim=tiny.head_dim,
+            rope_theta=tiny.rope_theta, rms_norm_eps=tiny.rms_norm_eps,
+            tie_word_embeddings=False, attention_dropout=0.0,
+        )
+    ).eval()
+    params = import_hf.import_qwen2(
+        {k: v.detach().numpy() for k, v in hf.state_dict().items()}, tiny
+    )
+    rng = np.random.default_rng(0)
+    NEW = 8
+    ids = rng.integers(0, 128, size=(2, 7))
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor(ids), max_new_tokens=NEW, do_sample=False,
+            eos_token_id=None, pad_token_id=0,
+        ).numpy()[:, 7:]
+
+    gen_cfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=-1)
+    embeds = params["embed"]["weight"][jnp.asarray(ids)]
+    toks, num = gen_lib.generate(
+        params, tiny, gen_cfg,
+        inputs_embeds=embeds, lengths=jnp.full((2,), 7, jnp.int32),
+        max_new_tokens=NEW, cache_len=32,
+    )
+    np.testing.assert_array_equal(np.asarray(toks), ref)
+    np.testing.assert_array_equal(np.asarray(num), [NEW, NEW])
+
+
+def test_mm_generate_end_to_end():
+    """BASELINE config 1 shape: single-image VQA greedy decode, tiny model."""
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(1)
+    img = rng.standard_normal((3 * 14, 4 * 14, 3)).astype(np.float32)
+
+    packed = packing.pack_images(
+        [img], patch_size=cfg.vision.patch_size,
+        base_grid=cfg.vision.base_grid, side_factors=1,
+        buckets=(16, 64, 256),
+    )
+    slots = splice.query_slots(packed)
+    assert slots == [(0, 12)]
+    prompt_ids = np.array([3, 4, IMAGE_TOKEN_INDEX, 5, 6], np.int64)
+    batch = splice.build_mm_batch([prompt_ids], slots, buckets=(64,))
+    assert batch.lengths[0] == 4 + 12
+
+    toks, num = oryx.mm_generate(
+        params, cfg, packed, batch, max_new_tokens=4, key=jax.random.key(7)
+    )
+    assert toks.shape == (1, 4)
+    assert np.all((toks >= 0) & (toks < cfg.llm.vocab_size))
+
+    # Determinism under identical inputs.
+    toks2, _ = oryx.mm_generate(
+        params, cfg, packed, batch, max_new_tokens=4, key=jax.random.key(7)
+    )
+    np.testing.assert_array_equal(toks, toks2)
+
+
+def test_mm_forward_multi_image_compression():
+    """BASELINE config 2 shape: multi-image with 4x compression."""
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(2))
+    rng = np.random.default_rng(3)
+    imgs = [rng.standard_normal((2 * 14, 2 * 14, 3)).astype(np.float32)
+            for _ in range(3)]
+    packed = packing.pack_images(
+        imgs, patch_size=cfg.vision.patch_size,
+        base_grid=cfg.vision.base_grid, side_factors=2,  # 4x compression
+        buckets=(16, 64, 256),
+    )
+    slots = splice.query_slots(packed)
+    assert [c for _, c in slots] == [1, 1, 1]  # ceil(2/2)*ceil(2/2)
+    ids = np.array(
+        [7, IMAGE_TOKEN_INDEX, IMAGE_TOKEN_INDEX, IMAGE_TOKEN_INDEX, 8],
+        np.int64,
+    )
+    batch = splice.build_mm_batch([ids], slots, buckets=(16,))
+    logits = oryx.forward(
+        params, cfg,
+        patches=jnp.asarray(packed.patches),
+        segment_ids=jnp.asarray(packed.segment_ids),
+        pos_coords=jnp.asarray(packed.pos_coords),
+        region_ids=jnp.asarray(packed.region_ids),
+        q_region_ids=jnp.asarray(packed.q_region_ids),
+        token_ids=jnp.asarray(batch.token_ids),
+        visual_idx=jnp.asarray(batch.visual_idx),
+        is_visual=jnp.asarray(batch.is_visual),
+        attn_mask=jnp.asarray(batch.attn_mask),
+        positions=jnp.asarray(batch.positions),
+    )
+    assert logits.shape == (1, 16, cfg.llm.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits[0, : batch.lengths[0]])))

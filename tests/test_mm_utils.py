@@ -1,0 +1,77 @@
+"""Host-side preprocessing tests (SURVEY.md §2 "MM utils")."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.constants import IMAGE_TOKEN_INDEX
+from oryx_tpu.data import mm_utils
+
+
+class FakeTokenizer:
+    """chars → ord codes; enough to test chunk splitting."""
+
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) for c in text]
+
+
+def test_tokenizer_image_token_interleaves_sentinels():
+    ids = mm_utils.tokenizer_image_token("ab<image>cd<image>", FakeTokenizer())
+    assert list(ids) == [97, 98, IMAGE_TOKEN_INDEX, 99, 100, IMAGE_TOKEN_INDEX]
+
+
+def test_tokenizer_image_token_no_image():
+    ids = mm_utils.tokenizer_image_token("xyz", FakeTokenizer())
+    assert list(ids) == [120, 121, 122]
+
+
+def test_resize_to_patch_grid_native_and_capped():
+    # 448x448 at patch 14 → exactly 32x32 patches, no cap.
+    assert mm_utils.resize_to_patch_grid((448, 448), 14, 4096) == (448, 448)
+    # Cap: 100x100 patches > 4096 → scaled under cap, aspect kept ~1:1.
+    H, W = mm_utils.resize_to_patch_grid((1400, 1400), 14, 4096)
+    assert (H // 14) * (W // 14) <= 4096
+    assert H == W
+    # Wild aspect ratio preserved approximately.
+    H, W = mm_utils.resize_to_patch_grid((280, 2800), 14, 100)
+    assert (H // 14) * (W // 14) <= 100
+    assert W / H == pytest.approx(10, rel=0.35)
+
+
+def test_preprocess_image_normalization_and_snapping():
+    rng = np.random.default_rng(0)
+    img = (rng.uniform(0, 255, (100, 130, 3))).astype(np.uint8)
+    out = mm_utils.preprocess_image(img, 14, 4096)
+    assert out.shape[0] % 14 == 0 and out.shape[1] % 14 == 0
+    assert out.dtype == np.float32
+    # Normalized to ~[-1, 1].
+    assert out.min() >= -1.0 - 1e-5 and out.max() <= 1.0 + 1e-5
+
+
+def test_bilinear_resize_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    img = rng.standard_normal((11, 7, 3)).astype(np.float32)
+    got = mm_utils._bilinear_resize(img, 28, 14)
+    ref = (
+        torch.nn.functional.interpolate(
+            torch.tensor(img).permute(2, 0, 1)[None], size=(28, 14),
+            mode="bilinear", align_corners=False,
+        )[0].permute(1, 2, 0).numpy()
+    )
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_sample_frames():
+    np.testing.assert_array_equal(mm_utils.sample_frames(5, 8), np.arange(5))
+    idx = mm_utils.sample_frames(1000, 64)
+    assert len(idx) == 64
+    assert idx[0] == 0 and idx[-1] == 999
+    assert np.all(np.diff(idx) > 0)
+
+
+def test_get_model_name_from_path():
+    assert mm_utils.get_model_name_from_path("/a/b/oryx-7b") == "oryx-7b"
+    assert (
+        mm_utils.get_model_name_from_path("/a/oryx-7b/checkpoint-100")
+        == "oryx-7b_checkpoint-100"
+    )

@@ -1,0 +1,142 @@
+"""Qwen2 backbone parity vs HF transformers on CPU (SURVEY.md §4 "Unit").
+
+Builds a tiny random HF `Qwen2ForCausalLM`, imports its weights through
+`import_hf.import_qwen2`, and requires logits to match to fp32-CPU
+tolerance. This simultaneously validates model math and the importer —
+the reference's "bit-close" parity bar (BASELINE.json north_star).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import import_hf, qwen2
+
+TINY = cfg_lib.tiny_llm(vocab_size=128)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = Qwen2Config(
+        vocab_size=TINY.vocab_size,
+        hidden_size=TINY.hidden_size,
+        intermediate_size=TINY.intermediate_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        num_key_value_heads=TINY.num_kv_heads,
+        head_dim=TINY.head_dim,
+        rope_theta=TINY.rope_theta,
+        rms_norm_eps=TINY.rms_norm_eps,
+        max_position_embeddings=TINY.max_position_embeddings,
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+    )
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def jx_params(hf_model):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    return import_hf.import_qwen2(sd, TINY)
+
+
+def test_logits_parity_full_sequence(hf_model, jx_params):
+    import torch
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, TINY.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    got, _ = qwen2.forward(jx_params, TINY, input_ids=jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_logits_parity_padded_batch(hf_model, jx_params):
+    """Right-padded rows with a kv padding mask must match per-row HF runs."""
+    import torch
+
+    rng = np.random.default_rng(1)
+    lens = [5, 11]
+    T = max(lens)
+    ids = rng.integers(1, TINY.vocab_size, size=(2, T))
+    mask = np.zeros((2, T), np.int32)
+    for i, l in enumerate(lens):
+        ids[i, l:] = 0
+        mask[i, :l] = 1
+    got, _ = qwen2.forward(
+        jx_params, TINY, input_ids=jnp.asarray(ids),
+        kv_mask=jnp.asarray(mask),
+    )
+    got = np.asarray(got)
+    for i, l in enumerate(lens):
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(ids[None, i, :l])).logits.numpy()[0]
+        np.testing.assert_allclose(got[i, :l], ref, atol=2e-4, rtol=2e-3)
+
+
+def test_kv_cache_decode_matches_full_forward(jx_params):
+    """Prefill + single-token cached decode == one uncached forward."""
+    rng = np.random.default_rng(2)
+    B, T = 2, 13
+    ids = jnp.asarray(rng.integers(0, TINY.vocab_size, size=(B, T)))
+
+    full, _ = qwen2.forward(jx_params, TINY, input_ids=ids)
+
+    S = 16
+    cache = qwen2.init_kv_cache(TINY, B, S, dtype=jnp.float32)
+    prefill_len = T - 1
+    pos = jnp.broadcast_to(jnp.arange(prefill_len, dtype=jnp.int32), (B, prefill_len))
+    kv_mask = (jnp.arange(S) < prefill_len)[None, :].astype(jnp.int32)
+    kv_mask = jnp.broadcast_to(kv_mask, (B, S))
+    logits_p, cache = qwen2.forward(
+        jx_params, TINY, input_ids=ids[:, :prefill_len], positions=pos,
+        kv_cache=cache, kv_mask=kv_mask,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(full[:, :prefill_len]),
+        atol=1e-5, rtol=1e-5,
+    )
+
+    pos1 = jnp.full((B, 1), prefill_len, dtype=jnp.int32)
+    kv_mask1 = (jnp.arange(S) < T)[None, :].astype(jnp.int32)
+    kv_mask1 = jnp.broadcast_to(kv_mask1, (B, S))
+    logits_d, _ = qwen2.forward(
+        jx_params, TINY, input_ids=ids[:, prefill_len:], positions=pos1,
+        kv_cache=cache, kv_mask=kv_mask1,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_d[:, 0]), np.asarray(full[:, -1]),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_tied_embeddings_and_no_bias():
+    cfg = cfg_lib.LLMConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, num_kv_heads=1, head_dim=16, tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    params = qwen2.init_params(cfg, jax.random.key(0))
+    assert "lm_head" not in params
+    assert "bias" not in params["layers"]["q_proj"]
+    ids = jnp.zeros((1, 4), jnp.int32)
+    logits, _ = qwen2.forward(params, cfg, input_ids=ids)
+    assert logits.shape == (1, 4, 64)
+
+
+def test_export_roundtrip():
+    params = qwen2.init_params(TINY, jax.random.key(1))
+    sd = import_hf.export_qwen2(params, TINY)
+    back = import_hf.import_qwen2(sd, TINY)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+        params, back,
+    )

@@ -1,0 +1,193 @@
+"""OryxViT / packing / Dynamic Compressor tests (SURVEY.md §4 "Unit").
+
+Key properties:
+  * packed-buffer encoding == encoding each image alone (segment isolation),
+  * block math parity vs HF `SiglipVisionModel` at the base resolution,
+  * posemb interpolation parity vs torch F.interpolate bilinear,
+  * compressor region pooling/attention correctness.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import compressor, import_hf, oryx_vit
+from oryx_tpu.ops import packing
+
+VCFG = cfg_lib.tiny_vision()  # hidden 48, heads 4, patch 14, base_grid 8
+
+
+def _rand_image(rng, h_patches, w_patches):
+    return rng.standard_normal(
+        (h_patches * VCFG.patch_size, w_patches * VCFG.patch_size, 3)
+    ).astype(np.float32)
+
+
+def test_patchify_shapes_and_order():
+    rng = np.random.default_rng(0)
+    img = _rand_image(rng, 2, 3)
+    patches, (h, w) = packing.patchify(img, VCFG.patch_size)
+    assert (h, w) == (2, 3)
+    assert patches.shape == (6, VCFG.patch_size**2 * 3)
+    # Patch (1, 2) top-left pixel == image pixel (14, 28), channel order kept.
+    np.testing.assert_array_equal(patches[5, :3], img[14, 28, :3])
+
+
+def test_posemb_interp_matches_torch_bilinear():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    G, H = VCFG.base_grid, 16
+    table = rng.standard_normal((G * G, H)).astype(np.float32)
+    for (h, w) in [(G, G), (5, 11), (13, 3), (1, 1)]:
+        coords = packing.posemb_source_coords(h, w, G)
+        got = np.asarray(
+            oryx_vit.interp_pos_embed(jnp.asarray(table), jnp.asarray(coords), G)
+        )
+        ref = (
+            torch.nn.functional.interpolate(
+                torch.tensor(table).reshape(1, G, G, H).permute(0, 3, 1, 2),
+                size=(h, w), mode="bilinear", align_corners=False,
+            )
+            .permute(0, 2, 3, 1).reshape(h * w, H).numpy()
+        )
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_packed_equals_solo_encoding():
+    """Two images packed together encode identically to each alone."""
+    rng = np.random.default_rng(2)
+    imgs = [_rand_image(rng, 3, 4), _rand_image(rng, 2, 2)]
+    params = oryx_vit.init_params(VCFG, jax.random.key(0))
+
+    def encode(image_list):
+        pk = packing.pack_images(
+            image_list, patch_size=VCFG.patch_size, base_grid=VCFG.base_grid,
+            buckets=(64, 128, 256),
+        )
+        feats = oryx_vit.forward(
+            params, VCFG,
+            jnp.asarray(pk.patches), jnp.asarray(pk.segment_ids),
+            jnp.asarray(pk.pos_coords),
+        )
+        return np.asarray(feats), pk
+
+    both, pk_both = encode(imgs)
+    for i, img in enumerate(imgs):
+        solo, pk_solo = encode([img])
+        n = pk_solo.num_patches
+        packed_rows = both[pk_both.segment_ids == i + 1]
+        np.testing.assert_allclose(packed_rows, solo[:n], atol=1e-4, rtol=1e-4)
+
+
+def test_parity_vs_hf_siglip_base_resolution():
+    """At exactly base_grid resolution (posemb identity), our packed encoder
+    must match HF SiglipVisionModel (same weights via the importer)."""
+    torch = pytest.importorskip("torch")
+    from transformers import SiglipVisionConfig, SiglipVisionModel
+
+    torch.manual_seed(0)
+    hf_cfg = SiglipVisionConfig(
+        hidden_size=VCFG.hidden_size,
+        intermediate_size=VCFG.intermediate_size,
+        num_hidden_layers=VCFG.num_layers,
+        num_attention_heads=VCFG.num_heads,
+        image_size=VCFG.base_grid * VCFG.patch_size,
+        patch_size=VCFG.patch_size,
+        layer_norm_eps=VCFG.layer_norm_eps,
+        vision_use_head=False,
+    )
+    hf = SiglipVisionModel(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = import_hf.import_siglip(sd, VCFG)
+
+    rng = np.random.default_rng(3)
+    img = _rand_image(rng, VCFG.base_grid, VCFG.base_grid)
+    with torch.no_grad():
+        ref = hf(
+            torch.tensor(img).permute(2, 0, 1)[None]
+        ).last_hidden_state.numpy()[0]
+
+    pk = packing.pack_images(
+        [img], patch_size=VCFG.patch_size, base_grid=VCFG.base_grid,
+        buckets=(64, 128, 256),
+    )
+    got = oryx_vit.forward(
+        params, VCFG,
+        jnp.asarray(pk.patches), jnp.asarray(pk.segment_ids),
+        jnp.asarray(pk.pos_coords),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[: pk.num_patches], ref, atol=2e-4, rtol=2e-3
+    )
+
+
+def test_compressor_pooling_and_shapes():
+    """Factor-2 compression of a 4x4 grid: 4 queries, each pooling its 2x2
+    region; identity-ish check on the pooling path."""
+    rng = np.random.default_rng(4)
+    ccfg = cfg_lib.CompressorConfig(num_heads=4)
+    lcfg = cfg_lib.tiny_llm()
+    img = _rand_image(rng, 4, 4)
+    pk = packing.pack_images(
+        [img], patch_size=VCFG.patch_size, base_grid=VCFG.base_grid,
+        side_factors=2, buckets=(16, 64, 256),
+    )
+    assert pk.q_grids[0] == (2, 2)
+    assert pk.num_queries == 4
+    # Region ids: patch (r, c) -> region 1 + (r//2)*2 + (c//2)
+    rid = pk.region_ids[: pk.num_patches].reshape(4, 4)
+    assert rid[0, 0] == rid[1, 1] == 1
+    assert rid[0, 2] == rid[1, 3] == 2
+    assert rid[3, 3] == 4
+
+    params = compressor.init_params(ccfg, VCFG, lcfg, jax.random.key(0))
+    feats = jnp.asarray(rng.standard_normal((pk.patches.shape[0], VCFG.hidden_size)).astype(np.float32))
+    out = compressor.forward(
+        params, ccfg, VCFG, feats,
+        jnp.asarray(pk.region_ids), jnp.asarray(pk.q_region_ids),
+    )
+    assert out.shape == (pk.q_region_ids.shape[0], lcfg.hidden_size)
+    out = np.asarray(out)
+    assert np.all(out[pk.num_queries:] == 0)  # pad rows zeroed
+    assert np.all(np.isfinite(out[: pk.num_queries]))
+
+
+def test_compressor_packed_equals_solo():
+    rng = np.random.default_rng(5)
+    ccfg = cfg_lib.CompressorConfig(num_heads=4)
+    lcfg = cfg_lib.tiny_llm()
+    params = compressor.init_params(ccfg, VCFG, lcfg, jax.random.key(1))
+    vit_params = oryx_vit.init_params(VCFG, jax.random.key(2))
+    imgs = [_rand_image(rng, 4, 4), _rand_image(rng, 2, 4)]
+
+    def run(image_list, factors):
+        pk = packing.pack_images(
+            image_list, patch_size=VCFG.patch_size, base_grid=VCFG.base_grid,
+            side_factors=factors, buckets=(16, 64, 256),
+        )
+        feats = oryx_vit.forward(
+            params=vit_params, cfg=VCFG,
+            patches=jnp.asarray(pk.patches),
+            segment_ids=jnp.asarray(pk.segment_ids),
+            pos_coords=jnp.asarray(pk.pos_coords),
+        )
+        out = compressor.forward(
+            params, ccfg, VCFG, feats,
+            jnp.asarray(pk.region_ids), jnp.asarray(pk.q_region_ids),
+        )
+        return np.asarray(out), pk
+
+    both, pk_both = run(imgs, [2, 1])
+    solo0, pk0 = run([imgs[0]], [2])
+    solo1, pk1 = run([imgs[1]], [1])
+    np.testing.assert_allclose(
+        both[pk_both.q_segment_ids == 1], solo0[: pk0.num_queries],
+        atol=1e-4, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        both[pk_both.q_segment_ids == 2], solo1[: pk1.num_queries],
+        atol=1e-4, rtol=1e-4,
+    )
