@@ -1,0 +1,166 @@
+"""Benchmark: Oryx SFT training throughput (tokens/sec/chip).
+
+Runs the full multimodal SFT step — OryxViT → Dynamic Compressor → splice →
+decoder forward, masked CE, backward, AdamW — under jit on whatever backend
+is available, and prints ONE JSON line:
+
+    {"metric": "sft_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
+     "vs_baseline": R}
+
+The model geometry scales with the backend: a ~350M-param decoder (Qwen2-
+style GQA, bf16 compute, remat) with the SigLIP-class vision tower on TPU;
+a tiny config on CPU so the script stays runnable anywhere.
+
+`vs_baseline` is measured against BASELINE.json's published numbers when
+present; BASELINE.json currently publishes none (`"published": {}`), so the
+ratio uses the documented placeholder below (an 8xA100 Oryx-7B SFT
+tokens/sec/chip estimate) and is to be re-anchored when real reference
+numbers appear.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# Placeholder reference throughput (tokens/sec/chip) for Oryx-7B SFT on
+# 8xA100; BASELINE.json `published` is empty. Replace when measured.
+PLACEHOLDER_BASELINE_TOK_S_CHIP = 2000.0
+
+WARMUP_STEPS = 2
+TIMED_STEPS = 5
+
+
+def _bench_cfg(backend: str):
+    from oryx_tpu import config as cfg_lib
+
+    if backend == "tpu" and not os.environ.get("BENCH_SMALL"):
+        llm = cfg_lib.LLMConfig(
+            vocab_size=16384,
+            hidden_size=1536,
+            intermediate_size=4096,
+            num_layers=12,
+            num_heads=12,
+            num_kv_heads=4,
+            head_dim=128,
+            attention_bias=True,
+        )
+        vision = cfg_lib.VisionConfig(
+            hidden_size=768,
+            intermediate_size=2048,
+            num_layers=6,
+            num_heads=12,
+            head_dim=64,
+            patch_size=14,
+            base_grid=27,
+        )
+        batch_size, seq_bucket, img_patches_side = 8, (2048,), 16
+        comp_heads = 12
+    else:
+        llm = cfg_lib.tiny_llm()
+        vision = cfg_lib.tiny_vision()
+        batch_size, seq_bucket, img_patches_side = 2, (128,), 4
+        comp_heads = 4
+    cfg = cfg_lib.OryxConfig(
+        llm=llm,
+        vision=vision,
+        compressor=cfg_lib.CompressorConfig(num_heads=comp_heads),
+        dtype="bfloat16",
+    )
+    return cfg, batch_size, seq_bucket, img_patches_side
+
+
+def _make_batch(cfg, batch_size, seq_bucket, img_side):
+    from oryx_tpu.constants import IGNORE_INDEX, IMAGE_TOKEN_INDEX
+    from oryx_tpu.models import splice
+    from oryx_tpu.ops import packing
+
+    rng = np.random.default_rng(0)
+    p = cfg.vision.patch_size
+    images = [
+        rng.standard_normal((img_side * p, img_side * p, 3)).astype(np.float32)
+        for _ in range(batch_size)
+    ]
+    packed = packing.pack_images(
+        images,
+        patch_size=p,
+        base_grid=cfg.vision.base_grid,
+        side_factors=2,
+    )
+    slots = splice.query_slots(packed)
+    vis_tokens = slots[0][1]
+    # Fill the sequence bucket: prompt + image + supervised text.
+    text_len = seq_bucket[-1] - vis_tokens - 1
+    ids, labels = [], []
+    for _ in range(batch_size):
+        text = rng.integers(3, cfg.llm.vocab_size, size=text_len)
+        row = np.concatenate([text[:8], [IMAGE_TOKEN_INDEX], text[8:]])
+        lab = np.full(row.shape, IGNORE_INDEX, np.int64)
+        lab[9 + 8:] = row[9 + 8:]
+        ids.append(row)
+        labels.append(lab)
+    batch = splice.build_mm_batch(ids, slots, labels=labels, buckets=seq_bucket)
+    return {
+        "patches": packed.patches,
+        "segment_ids": packed.segment_ids,
+        "pos_coords": packed.pos_coords,
+        "region_ids": packed.region_ids,
+        "q_region_ids": packed.q_region_ids,
+        "token_ids": batch.token_ids,
+        "visual_idx": batch.visual_idx,
+        "is_visual": batch.is_visual.astype(np.bool_),
+        "attn_mask": batch.attn_mask,
+        "positions": batch.positions,
+        "labels": batch.labels,
+    }
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.models import oryx
+    from oryx_tpu.train import step as step_lib
+    from oryx_tpu.train.optimizer import make_optimizer
+
+    backend = jax.default_backend()
+    n_chips = jax.device_count()
+    cfg, batch_size, seq_bucket, img_side = _bench_cfg(backend)
+    host = _make_batch(cfg, batch_size, seq_bucket, img_side)
+    batch = {k: jnp.asarray(v)[None] for k, v in host.items()}  # accum=1
+
+    params = oryx.init_params(cfg, jax.random.key(0))
+    tx = make_optimizer(cfg.train, params)
+    state = step_lib.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params)
+    )
+
+    # NOTE: sync via device_get, not block_until_ready — the latter is a
+    # no-op over the remote-chip (axon) transport and fakes the timing.
+    tokens_per_step = int(np.sum(host["attn_mask"]))
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step_lib.train_step(state, batch, cfg, tx)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = step_lib.train_step(state, batch, cfg, tx)
+    loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    if not np.isfinite(loss):
+        raise RuntimeError(f"non-finite loss {loss} in bench step")
+
+    tok_s_chip = tokens_per_step * TIMED_STEPS / dt / n_chips
+    print(json.dumps({
+        "metric": "sft_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s_chip / PLACEHOLDER_BASELINE_TOK_S_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
