@@ -11,9 +11,9 @@ subclass"). Record schema is the LLaVA-mix JSON family:
 
 TPU-first differences: the collator emits the static-shape packed arrays
 (ops/packing + models/splice) that feed the jitted step directly — all
-raggedness is resolved host-side; batches are length- AND modality-grouped
-so bucket padding waste stays low; media decode is pluggable (a host-side
-CPU concern, SURVEY.md §2a last row).
+raggedness is resolved host-side; batches are modality-grouped so bucket
+padding waste stays low; media decode is pluggable (a host-side CPU
+concern, SURVEY.md §2a last row).
 """
 
 from __future__ import annotations
@@ -28,11 +28,12 @@ from oryx_tpu.constants import (
     COMPRESSOR_RATIO,
     DEFAULT_IMAGE_TOKEN,
     IGNORE_INDEX,
+    IMAGE_TOKEN_INDEX,
     MODALITY_IMAGE,
     MODALITY_MULTI_IMAGE,
     MODALITY_VIDEO,
 )
-from oryx_tpu.conversation import Conversation, conv_templates
+from oryx_tpu.conversation import Conversation, SeparatorStyle, conv_templates
 from oryx_tpu.data import mm_utils
 from oryx_tpu.models import splice
 from oryx_tpu.ops import packing
@@ -72,15 +73,44 @@ def preprocess_conversation(
             (int(t) if supervised and t >= 0 else IGNORE_INDEX) for t in toks
         )
 
-    if conv.system:
-        emit(f"<|im_start|>system\n{conv.system}{conv.sep}", False)
-    role_map = {"human": conv.roles[0], "gpt": conv.roles[1]}
-    for msg in rec["conversations"]:
-        role = role_map.get(msg["from"], msg["from"])
-        supervised = msg["from"] == "gpt"
-        emit(f"<|im_start|>{role}\n", False)
-        emit(f"{msg['value']}{conv.sep}", supervised)
+    for text, supervised in _conversation_parts(rec, conv):
+        emit(text, supervised)
     return np.asarray(ids, np.int64), np.asarray(labels, np.int64)
+
+
+def _conversation_parts(
+    rec: dict[str, Any], conv: Conversation
+) -> list[tuple[str, bool]]:
+    """(text, supervised) segments per the template's sep_style, matching
+    Conversation.get_prompt formatting so training and inference prompts
+    agree; assistant message bodies (+ closing separator) are supervised."""
+    role_map = {"human": conv.roles[0], "gpt": conv.roles[1]}
+    msgs = [
+        (role_map.get(m["from"], m["from"]), m["from"] == "gpt", m["value"])
+        for m in rec["conversations"]
+    ]
+    parts: list[tuple[str, bool]] = []
+    if conv.sep_style == SeparatorStyle.CHATML:
+        if conv.system:
+            parts.append((f"<|im_start|>system\n{conv.system}{conv.sep}", False))
+        for role, sup, value in msgs:
+            parts.append((f"<|im_start|>{role}\n", False))
+            parts.append((f"{value}{conv.sep}", sup))
+    elif conv.sep_style == SeparatorStyle.TWO:
+        seps = [conv.sep, conv.sep2 or conv.sep]
+        if conv.system:
+            parts.append((conv.system + seps[0], False))
+        for i, (role, sup, value) in enumerate(msgs):
+            parts.append((f"{role}: ", False))
+            parts.append((f"{value}{seps[i % 2]}", sup))
+    elif conv.sep_style == SeparatorStyle.PLAIN:
+        # Stage-1 projector pretraining: bare concatenation; only the
+        # assistant (caption) text is supervised.
+        for _, sup, value in msgs:
+            parts.append((f"{value}{conv.sep or ''}", sup))
+    else:
+        raise ValueError(f"unknown sep style {conv.sep_style}")
+    return parts
 
 
 @dataclass
@@ -91,16 +121,6 @@ class Example:
     labels: np.ndarray
     images: list[np.ndarray]  # preprocessed pixel arrays (patch-multiple)
     modality: str
-
-    @property
-    def approx_len(self) -> int:
-        """Text tokens + compressed visual tokens (for length grouping)."""
-        s = side_factor(self.modality)
-        vis = sum(
-            -(-(img.shape[0] // 14) // s) * -(-(img.shape[1] // 14) // s)
-            for img in self.images
-        )
-        return len(self.input_ids) + vis
 
 
 class SupervisedDataset:
@@ -165,7 +185,7 @@ class SupervisedDataset:
             for img in raw
         ]
         ids, labels = preprocess_conversation(rec, self.tokenizer, self.conv)
-        n_sentinels = int(np.sum(ids == -200))
+        n_sentinels = int(np.sum(ids == IMAGE_TOKEN_INDEX))
         if n_sentinels != len(images):
             # Reference behavior: video/multi-image records carry one
             # placeholder expanded to all frames.
@@ -196,12 +216,13 @@ def collate(
     image_counts: list[int] = []
     for ex in examples:
         ids, labels = ex.input_ids, ex.labels
-        n_sent = int(np.sum(ids == -200))
+        n_sent = int(np.sum(ids == IMAGE_TOKEN_INDEX))
         if n_sent == 1 and len(ex.images) > 1:
             # Expand the single placeholder to one sentinel per frame.
-            idx = int(np.where(ids == -200)[0][0])
+            idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
             ids = np.concatenate(
-                [ids[:idx], np.full(len(ex.images), -200, ids.dtype),
+                [ids[:idx],
+                 np.full(len(ex.images), IMAGE_TOKEN_INDEX, ids.dtype),
                  ids[idx + 1:]]
             )
             labels = np.concatenate(
@@ -239,6 +260,47 @@ def collate(
     }
 
 
+def _pad_to_shape(arr: np.ndarray, shape: tuple[int, ...], fill) -> np.ndarray:
+    """Pad `arr` up to `shape` with `fill` (no-op when equal)."""
+    if arr.shape == shape:
+        return arr
+    out = np.full(shape, fill, arr.dtype)
+    out[tuple(slice(0, s) for s in arr.shape)] = arr
+    return out
+
+
+def collate_microbatches(
+    examples: Sequence[Example],
+    grad_accum_steps: int,
+    **collate_kw,
+) -> dict[str, np.ndarray]:
+    """Collate `grad_accum_steps` microbatches into stacked arrays with a
+    leading [accum, ...] axis (the train.step.train_step batch layout).
+
+    Each microbatch is packed SEPARATELY — its visual_idx/region_ids
+    reference its own packed visual buffer — then all microbatches are
+    re-padded to common bucket shapes so they stack. Padding uses id 0 /
+    IGNORE_INDEX, which every consumer already treats as padding.
+    """
+    n = len(examples)
+    if n % grad_accum_steps != 0:
+        raise ValueError(f"batch of {n} not divisible by {grad_accum_steps}")
+    per = n // grad_accum_steps
+    micro = [
+        collate(examples[i * per : (i + 1) * per], **collate_kw)
+        for i in range(grad_accum_steps)
+    ]
+    out: dict[str, np.ndarray] = {}
+    for key in micro[0]:
+        fill = IGNORE_INDEX if key == "labels" else 0
+        shape = tuple(
+            max(m[key].shape[d] for m in micro)
+            for d in range(micro[0][key].ndim)
+        )
+        out[key] = np.stack([_pad_to_shape(m[key], shape, fill) for m in micro])
+    return out
+
+
 def grouped_batch_iterator(
     dataset: SupervisedDataset,
     batch_size: int,
@@ -247,6 +309,7 @@ def grouped_batch_iterator(
     num_epochs: int | None = None,
     process_index: int = 0,
     process_count: int = 1,
+    grad_accum_steps: int = 1,
     **collate_kw,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Modality-grouped, shuffled, per-process-sharded batch stream.
@@ -255,24 +318,37 @@ def grouped_batch_iterator(
     shuffled within modality groups so image and video samples never share
     a batch (their compression ratios and shapes differ wildly), then
     round-robined across processes (host-side data sharding, SURVEY.md
-    §2c(c)).
+    §2c(c)). Per-modality tails smaller than batch_size carry over to the
+    next epoch (and are reshuffled into it) so no modality is starved.
+
+    With grad_accum_steps > 1, each yielded dict has a leading [accum, ...]
+    axis from `collate_microbatches` and batch_size counts samples per
+    FULL step (so batch_size % grad_accum_steps must be 0).
     """
     rng = np.random.default_rng(seed)
     by_mod: dict[str, list[int]] = {}
     for i in range(len(dataset)):
         by_mod.setdefault(record_modality(dataset.records[i]), []).append(i)
+    leftover: dict[str, list[int]] = {m: [] for m in by_mod}
 
     epoch = 0
     while num_epochs is None or epoch < num_epochs:
         batches: list[list[int]] = []
-        for idxs in by_mod.values():
-            idxs = list(idxs)
+        for mod, idxs in by_mod.items():
+            idxs = leftover[mod] + list(idxs)
             rng.shuffle(idxs)
-            for j in range(0, len(idxs) - batch_size + 1, batch_size):
+            full = len(idxs) - len(idxs) % batch_size
+            for j in range(0, full, batch_size):
                 batches.append(idxs[j : j + batch_size])
+            leftover[mod] = idxs[full:]
         rng.shuffle(batches)
         for bi, b in enumerate(batches):
             if bi % process_count != process_index:
                 continue
-            yield collate([dataset[i] for i in b], **collate_kw)
+            examples = [dataset[i] for i in b]
+            if grad_accum_steps > 1:
+                yield collate_microbatches(examples, grad_accum_steps,
+                                           **collate_kw)
+            else:
+                yield collate(examples, **collate_kw)
         epoch += 1

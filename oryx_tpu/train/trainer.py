@@ -70,22 +70,34 @@ class Trainer:
         return start
 
     def _device_batch(self, batch: dict[str, np.ndarray]) -> dict[str, Any]:
-        """Host batch → device with [accum, ...] leading axis and batch
-        sharding over (dp, fsdp)."""
+        """Host batch → device with [accum, ...] leading axis.
+
+        With grad_accum_steps > 1 the host batch must ALREADY be stacked
+        per-microbatch (data.collate_microbatches) — each microbatch owns
+        its own packed visual buffer; slicing a globally-packed buffer
+        would corrupt visual_idx/region_ids.
+
+        Every field shards its per-microbatch leading axis over the data
+        width: for token-stream fields that is plain data parallelism; for
+        packed visual buffers it is sequence parallelism over the packing
+        axis (ViT projections/MLP shard over patches; GSPMD all-gathers
+        K/V for the segment-masked attention).
+        """
         accum = self.cfg.train.grad_accum_steps
         bspec = sharding.batch_spec()
+        width = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
 
-        def put(x):
+        def put(name, x):
             x = np.asarray(x)
             if accum > 1:
-                # Leading batch-ish axis split into [accum, ...].
-                assert x.shape[0] % accum == 0, (x.shape, accum)
-                x = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+                if x.shape[0] != accum:
+                    raise ValueError(
+                        f"{name}: expected stacked [accum={accum}, ...] "
+                        f"microbatches (use data.collate_microbatches), "
+                        f"got shape {x.shape}"
+                    )
             else:
                 x = x[None]
-            # Shard the per-microbatch leading axis where divisible;
-            # replicate otherwise (packed visual buffers are global).
-            width = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
             spec = (
                 jax.sharding.PartitionSpec(None, *bspec)
                 if x.shape[1] % max(width, 1) == 0
@@ -95,7 +107,7 @@ class Trainer:
                 jnp.asarray(x), jax.sharding.NamedSharding(self.mesh, spec)
             )
 
-        return {k: put(v) for k, v in batch.items()}
+        return {k: put(k, v) for k, v in batch.items()}
 
     def fit(
         self,
@@ -121,6 +133,8 @@ class Trainer:
                 self.logger.log_step(step_i + 1, jax.device_get(metrics))
                 if (step_i + 1) % cfg.train.checkpoint_every == 0:
                     self.ckpt.save(step_i + 1, self.state)
-        self.ckpt.save(num_steps, self.state, force=True)
+        final_step = int(jax.device_get(self.state.step))
+        if final_step > 0:
+            self.ckpt.save(final_step, self.state, force=True)
         self.ckpt.wait()
         return self.state

@@ -61,6 +61,12 @@ class CheckpointManager:
         self._mgr.close()
 
 
+def _npz_path(path: str) -> str:
+    """np.savez appends '.npz' when missing but np.load does not; normalize
+    so save/load round-trip on the same argument."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_projector_only(path: str, params: Params) -> None:
     """Stage-1-style partial checkpoint: compressor/projector weights only
     (the reference's `mm_projector.bin` analog), as a flat npz."""
@@ -70,13 +76,13 @@ def save_projector_only(path: str, params: Params) -> None:
         for path, leaf in flat
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path, **arrays)
+    np.savez(_npz_path(path), **arrays)
 
 
 def load_projector_only(path: str, params: Params) -> Params:
     """Merge a projector-only checkpoint into a full param tree (the
     reference's `pretrain_mm_mlp_adapter` load path, SURVEY.md §3.3)."""
-    data = np.load(path)
+    data = np.load(_npz_path(path))
     comp = params["compressor"]
 
     def fill(path, leaf):

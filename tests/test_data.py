@@ -1,0 +1,171 @@
+"""Data-pipeline tests: template-aware preprocessing, microbatch collation,
+modality-grouped iteration (SURVEY.md §2 "Training entry" / "Trainer
+subclass")."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.constants import IGNORE_INDEX, IMAGE_TOKEN_INDEX
+from oryx_tpu.conversation import conv_templates
+from oryx_tpu.train import data as data_lib
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) for c in text]
+
+
+def _decode(ids):
+    return "".join(chr(i) for i in ids if i >= 0)
+
+
+REC = {
+    "id": "r0",
+    "conversations": [
+        {"from": "human", "value": "<image>\nQ?"},
+        {"from": "gpt", "value": "A!"},
+    ],
+    "image": "x.png",
+}
+
+
+def test_preprocess_chatml_matches_get_prompt():
+    conv = conv_templates["qwen"].copy()
+    ids, labels = data_lib.preprocess_conversation(REC, FakeTokenizer(), conv)
+    ref = conv.copy()
+    ref.append_message("user", "<image>\nQ?")
+    ref.append_message("assistant", "A!")
+    # Token stream (sentinels removed) spells exactly the template prompt.
+    assert _decode(ids) == ref.get_prompt().replace("<image>", "")
+    assert int(np.sum(ids == IMAGE_TOKEN_INDEX)) == 1
+    # Supervised region is exactly the assistant reply + separator.
+    sup = [i for i, l in zip(ids, labels) if l != IGNORE_INDEX]
+    assert _decode(sup) == "A!" + conv.sep
+
+
+def test_preprocess_vicuna_style():
+    conv = conv_templates["v1"].copy()
+    ids, labels = data_lib.preprocess_conversation(REC, FakeTokenizer(), conv)
+    ref = conv.copy()
+    ref.append_message("USER", "<image>\nQ?")
+    ref.append_message("ASSISTANT", "A!")
+    assert _decode(ids) == ref.get_prompt().replace("<image>", "")
+    sup = [i for i, l in zip(ids, labels) if l != IGNORE_INDEX]
+    assert _decode(sup) == "A!" + (conv.sep2 or conv.sep)
+
+
+def test_preprocess_plain_style():
+    conv = conv_templates["plain"].copy()
+    ids, labels = data_lib.preprocess_conversation(REC, FakeTokenizer(), conv)
+    # Plain = bare concatenation, no ChatML markers.
+    assert "<|im_start|>" not in _decode(ids)
+    assert _decode(ids) == "\nQ?\nA!\n"
+    sup = [i for i, l in zip(ids, labels) if l != IGNORE_INDEX]
+    assert _decode(sup) == "A!\n"
+
+
+def _mk_example(seed, n_images=1, modality="image", hw=(28, 28)):
+    rng = np.random.default_rng(seed)
+    images = [rng.standard_normal((*hw, 3)).astype(np.float32)
+              for _ in range(n_images)]
+    ids = np.array(
+        [65, 66] + [IMAGE_TOKEN_INDEX] * n_images + [67, 68], np.int64
+    )
+    labels = np.full(ids.shape, IGNORE_INDEX, np.int64)
+    labels[-2:] = ids[-2:]
+    return data_lib.Example(ids, labels, images, modality)
+
+
+def test_collate_microbatches_independent_buffers():
+    """Each microbatch references ITS OWN packed visual buffer."""
+    exs = [_mk_example(i, hw=(28 * (1 + i % 2), 28)) for i in range(4)]
+    out = data_lib.collate_microbatches(
+        exs, 2, buckets=(16, 64, 256), base_grid=8
+    )
+    single0 = data_lib.collate(exs[:2], buckets=(16, 64, 256), base_grid=8)
+    single1 = data_lib.collate(exs[2:], buckets=(16, 64, 256), base_grid=8)
+    for k in out:
+        assert out[k].shape[0] == 2, k
+        got0 = out[k][0]
+        np.testing.assert_array_equal(
+            got0[tuple(slice(0, s) for s in single0[k].shape)], single0[k]
+        )
+        got1 = out[k][1]
+        np.testing.assert_array_equal(
+            got1[tuple(slice(0, s) for s in single1[k].shape)], single1[k]
+        )
+    # visual_idx never exceeds each micro's own query buffer.
+    q = out["q_region_ids"].shape[1]
+    assert out["visual_idx"].max() < q
+
+
+def test_collate_microbatches_indivisible_raises():
+    exs = [_mk_example(i) for i in range(3)]
+    with pytest.raises(ValueError):
+        data_lib.collate_microbatches(exs, 2, buckets=(64, 256), base_grid=8)
+
+
+class _StubDataset:
+    """Bypasses tokenizer/media: fixed Examples keyed by modality."""
+
+    def __init__(self, modalities):
+        self.records = [
+            {"id": i, "image": "x.png" if m == "image" else None,
+             "video": "v.mp4" if m == "video" else None}
+            for i, m in enumerate(modalities)
+        ]
+        self._mods = modalities
+
+    def __len__(self):
+        return len(self.records)
+
+    def __getitem__(self, i):
+        return _mk_example(i, modality=self._mods[i])
+
+
+def test_grouped_iterator_modality_and_leftover_carry():
+    """Small modality groups are not starved: tails carry across epochs."""
+    mods = ["image"] * 5 + ["video"] * 3
+    ds = _StubDataset(mods)
+    it = data_lib.grouped_batch_iterator(
+        ds, 2, seed=0, num_epochs=2, buckets=(64, 256), base_grid=8
+    )
+    batches = list(it)
+    # 2 epochs x 8 samples = 16 sample slots; leftovers (1 image + 1 video
+    # per epoch) carry: epoch2 sees 5+1 images, 3+1 videos -> 3+2 batches.
+    assert len(batches) == 2 + 1 + 3 + 2
+
+
+def test_grouped_iterator_accum_layout():
+    ds = _StubDataset(["image"] * 8)
+    it = data_lib.grouped_batch_iterator(
+        ds, 4, seed=0, num_epochs=1, grad_accum_steps=2,
+        buckets=(64, 256), base_grid=8,
+    )
+    b = next(it)
+    for k, v in b.items():
+        assert v.shape[0] == 2, (k, v.shape)
+    assert b["token_ids"].shape[1] == 2  # 4 samples / 2 microbatches
+
+
+def test_projector_checkpoint_roundtrip(tmp_path):
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.utils import checkpoint as ckpt_lib
+
+    cfg = cfg_lib.oryx_tiny()
+    p1 = oryx.init_params(cfg, jax.random.key(0))
+    p2 = oryx.init_params(cfg, jax.random.key(1))
+    path = str(tmp_path / "projector")  # no .npz suffix on purpose
+    ckpt_lib.save_projector_only(path, p1)
+    merged = ckpt_lib.load_projector_only(path, p2)
+    np.testing.assert_array_equal(
+        np.asarray(merged["compressor"]["q_proj"]["kernel"]),
+        np.asarray(p1["compressor"]["q_proj"]["kernel"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(merged["llm"]["embed"]["weight"]),
+        np.asarray(p2["llm"]["embed"]["weight"]),
+    )
