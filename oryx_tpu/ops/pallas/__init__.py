@@ -1,0 +1,6 @@
+"""Pallas TPU kernels — drop-in replacements for the XLA reference ops.
+
+Selected by `OryxConfig.attn_impl = "pallas"`. Every kernel here has an
+XLA-path twin in `oryx_tpu/ops/` that defines the semantics; tests compare
+the two in interpret mode on CPU (SURVEY.md §4 "Unit").
+"""

@@ -1,0 +1,325 @@
+"""Pallas TPU flash attention (causal GQA + segments + KV masking).
+
+The TPU-native replacement for the reference's flash-attn CUDA kernels
+(SURVEY.md §2a): one kernel serves the decoder (causal, GQA, KV-cache
+decode) and — via segment ids — the packed arbitrary-resolution ViT
+(`flash_attn_varlen_func`-equivalent; see segment_attention.py).
+
+Design:
+  * Grid (B, Hq, nq, nk); the innermost kv dimension runs sequentially on
+    the core, accumulating online-softmax state (m, l, acc) in VMEM
+    scratch and finalizing the output block at the last kv step.
+  * Logits/softmax in fp32 (matching ops/attention.py's bit-closeness
+    policy); the probs·V matmul in the value dtype so the MXU runs bf16.
+  * Masking is the same model as ops/attention.attention: causal on
+    absolute positions, segment-id equality, explicit kv validity — all
+    folded into one predicate per tile. With arange kv positions (the
+    prefill and KV-cache layouts), causally-dead kv tiles are skipped.
+  * Backward: custom VJP that recomputes attention with the XLA reference
+    path — O(T²) memory in backward but numerically identical; a Pallas
+    backward kernel is a later optimization.
+
+Interpret mode runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from oryx_tpu.ops import attention as xla_attention
+
+NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# Tile sizes. 512×512 keeps the fp32 logits tile at 1 MB of VMEM while
+# amortizing DMA and per-tile softmax state updates; q/k/v/acc tiles add
+# ~0.8 MB — comfortably inside the ~16 MB VMEM budget with double
+# buffering.
+BLOCK_Q = 512
+BLOCK_K = 512
+
+
+def _kernel(
+    qpos_ref, kpos_ref, qseg_ref, kseg_ref, kvalid_ref,
+    q_ref, k_ref, v_ref,
+    o_ref,
+    m_scr, l_scr, acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    has_segments: bool,
+    kv_arange: bool,
+    block_k: int,
+):
+    ik, nk = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # q-side int refs are lane-broadcast [1, bq, LANES]; kv-side are
+    # sublane-broadcast [1, SUBLANES, bk] (TPU tiling wants the last two
+    # dims (8k, 128m)-aligned; a bare [1, bk] block is not lowerable).
+    if causal and kv_arange:
+        # kv positions are arange ⇒ tiles entirely after the largest query
+        # position contribute nothing; skip their compute (data is still
+        # prefetched — grid-level skipping is a later optimization).
+        run = ik * block_k <= jnp.max(qpos_ref[0])
+    else:
+        run = True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]  # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk] fp32
+
+        mask = kvalid_ref[0, :1, :] > 0  # [1, bk]
+        if causal:
+            mask = jnp.logical_and(
+                mask, qpos_ref[0, :, :1] >= kpos_ref[0, :1, :]
+            )
+        if has_segments:
+            mask = jnp.logical_and(
+                mask, qseg_ref[0, :, :1] == kseg_ref[0, :1, :]
+            )
+        s = jnp.where(mask, s, NEG)
+
+        m_prev = m_scr[:, :1]  # [bq, 1] (m/l live lane-broadcast in VMEM)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [bq, bk] fp32
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        out = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _pad_axis(x, axis: int, target: int, fill=0):
+    pad = target - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "has_segments", "kv_arange", "scale",
+                     "interpret"),
+)
+def _mha_forward(
+    q, k, v, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
+    *,
+    causal: bool,
+    has_segments: bool,
+    kv_arange: bool,
+    scale: float,
+    interpret: bool,
+):
+    """Core pallas call. Layouts: q [B, Hq, Tq, D]; k/v [B, Hk, Tk, D];
+    int arrays [B, T*] (already padded to block multiples)."""
+    B, Hq, Tq, D = q.shape
+    _, Hk, Tk, _ = k.shape
+    G = Hq // Hk
+    block_q = min(BLOCK_Q, Tq)
+    block_k = min(BLOCK_K, Tk)
+    nq = Tq // block_q
+    nk = Tk // block_k
+
+    # Lane/sublane broadcast layouts for the per-token int arrays (see
+    # kernel comment): q-side [B, Tq, LANES], kv-side [B, SUBLANES, Tk].
+    LANES, SUB = 128, 8
+    q_pos = jnp.broadcast_to(q_pos[:, :, None], (B, Tq, LANES))
+    q_seg = jnp.broadcast_to(q_seg[:, :, None], (B, Tq, LANES))
+    kv_pos = jnp.broadcast_to(kv_pos[:, None, :], (B, SUB, Tk))
+    kv_seg = jnp.broadcast_to(kv_seg[:, None, :], (B, SUB, Tk))
+    kv_valid = jnp.broadcast_to(kv_valid[:, None, :], (B, SUB, Tk))
+
+    grid = (B, Hq, nq, nk)
+    kern = functools.partial(
+        _kernel, scale=scale, causal=causal, has_segments=has_segments,
+        kv_arange=kv_arange, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_pos, kv_pos, q_seg, kv_seg, kv_valid, q, k, v)
+    return out
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(
+    q, k, v,
+    *,
+    causal: bool = True,
+    q_positions=None,
+    kv_positions=None,
+    q_segment_ids=None,
+    kv_segment_ids=None,
+    kv_mask=None,
+    scale: float | None = None,
+):
+    """Drop-in for ops.attention.attention with identical masking model.
+
+    q: [B, Tq, Hq, D]; k/v: [B, Tk, Hk, D]. Returns [B, Tq, Hq, D].
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_vjp(
+        q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+        kv_mask, causal, float(scale),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def _flash_vjp(
+    q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+    kv_mask, causal, scale,
+):
+    return _flash_attention_impl(
+        q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+        kv_mask, causal, scale,
+    )
+
+
+def _flash_attention_impl(
+    q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+    kv_mask, causal, scale,
+):
+    B, Tq, Hq, D = q.shape
+    _, Tk, Hk, _ = k.shape
+    if scale is None:
+        scale = D**-0.5
+
+    block_q = min(BLOCK_Q, _round_up(Tq, 16))
+    block_k = min(BLOCK_K, _round_up(Tk, 16))
+    Tq_p = _round_up(Tq, block_q)
+    Tk_p = _round_up(Tk, block_k)
+
+    kv_arange = kv_positions is None
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(Tq, dtype=jnp.int32), (B, Tq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(Tk, dtype=jnp.int32), (B, Tk)
+        )
+    has_segments = q_segment_ids is not None
+    if has_segments:
+        assert kv_segment_ids is not None
+        q_seg = jnp.broadcast_to(q_segment_ids, (B, Tq)).astype(jnp.int32)
+        kv_seg = jnp.broadcast_to(kv_segment_ids, (B, Tk)).astype(jnp.int32)
+    else:
+        q_seg = jnp.zeros((B, Tq), jnp.int32)
+        kv_seg = jnp.zeros((B, Tk), jnp.int32)
+    kv_valid = (
+        jnp.broadcast_to(kv_mask, (B, Tk)).astype(jnp.int32)
+        if kv_mask is not None
+        else jnp.ones((B, Tk), jnp.int32)
+    )
+
+    # Pad sequence dims to block multiples. Padded kv is invalid; padded q
+    # rows produce garbage that is sliced off. Padded q positions stay 0 so
+    # the causal-skip bound never extends the loop.
+    qt = _pad_axis(q.swapaxes(1, 2), 2, Tq_p)  # [B, Hq, Tq_p, D]
+    kt = _pad_axis(k.swapaxes(1, 2), 2, Tk_p)
+    vt = _pad_axis(v.swapaxes(1, 2), 2, Tk_p)
+    q_pos = _pad_axis(q_positions.astype(jnp.int32), 1, Tq_p)
+    kv_pos = _pad_axis(kv_positions.astype(jnp.int32), 1, Tk_p)
+    q_seg = _pad_axis(q_seg, 1, Tq_p, fill=-1)
+    kv_seg = _pad_axis(kv_seg, 1, Tk_p, fill=-2)
+    kv_valid = _pad_axis(kv_valid, 1, Tk_p)
+
+    out = _mha_forward(
+        qt, kt, vt, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
+        causal=causal, has_segments=has_segments, kv_arange=kv_arange,
+        scale=float(scale), interpret=_use_interpret(),
+    )
+    return out[:, :, :Tq].swapaxes(1, 2)
+
+
+def _fwd(q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+         kv_mask, causal, scale):
+    out = _flash_attention_impl(
+        q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+        kv_mask, causal, scale,
+    )
+    res = (q, k, v, q_positions, kv_positions, q_segment_ids,
+           kv_segment_ids, kv_mask)
+    return out, res
+
+
+def _bwd(causal, scale, res, g):
+    """Backward via the XLA reference formula (recompute; O(T²) memory).
+    Numerically identical to differentiating ops.attention.attention."""
+    (q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+     kv_mask) = res
+
+    def ref(q, k, v):
+        return xla_attention.attention(
+            q, k, v, causal=causal,
+            q_positions=q_positions, kv_positions=kv_positions,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+            kv_mask=kv_mask, scale=scale,
+        )
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    return (dq, dk, dv, None, None, None, None, None)
+
+
+_flash_vjp.defvjp(_fwd, _bwd)

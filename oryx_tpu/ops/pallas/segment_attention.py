@@ -1,0 +1,24 @@
+"""Packed varlen attention for OryxViT — segment ids over one flat buffer.
+
+The TPU-native replacement for `flash_attn_varlen_func` + cu_seqlens
+(SURVEY.md §2a): many arbitrary-resolution images packed into one bucketed
+sequence, each attending only within its own segment. Thin front-end over
+the unified Pallas flash kernel (flash_attention.py) with causal masking
+off and segment masking on.
+"""
+
+from __future__ import annotations
+
+from oryx_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def segment_attention(q, k, v, q_segment_ids, kv_segment_ids, scale=None):
+    """q/k/v: [B, T, H, D]; segment ids [B, T] (0 = padding, which only
+    attends to itself — outputs on pad rows are discarded by callers)."""
+    return flash_attention(
+        q, k, v,
+        causal=False,
+        q_segment_ids=q_segment_ids,
+        kv_segment_ids=kv_segment_ids,
+        scale=scale,
+    )
