@@ -85,10 +85,14 @@ def forward(
     attn_mask: jnp.ndarray,
     positions: jnp.ndarray,
     remat: bool = False,
+    mesh=None,
     compute_dtype=None,
     logits_dtype=jnp.float32,
 ) -> jnp.ndarray:
-    """Training/prefill forward: visual encode → splice → decoder logits."""
+    """Training/prefill forward: visual encode → splice → decoder logits.
+
+    mesh: only needed for attn_impl='ring' without an ambient mesh
+    (jax.sharding.set_mesh) in scope."""
     vis = encode_visual(
         params, cfg, patches, segment_ids, pos_coords, region_ids,
         q_region_ids, remat=remat, compute_dtype=compute_dtype,
@@ -99,8 +103,8 @@ def forward(
     logits, _ = qwen2.forward(
         params["llm"], cfg.llm,
         inputs_embeds=embeds, positions=positions, kv_mask=attn_mask,
-        remat=remat, attn_impl=cfg.attn_impl, compute_dtype=compute_dtype,
-        logits_dtype=logits_dtype,
+        remat=remat, attn_impl=cfg.attn_impl, mesh=mesh,
+        compute_dtype=compute_dtype, logits_dtype=logits_dtype,
     )
     return logits
 

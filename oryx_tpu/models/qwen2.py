@@ -175,6 +175,8 @@ def forward(
     kv_mask: jnp.ndarray | None = None,
     remat: bool = False,
     attn_impl: str = "xla",
+    mesh=None,
+    sp_axis: str = "sp",
     compute_dtype: jnp.dtype | None = None,
     logits_dtype: jnp.dtype = jnp.float32,
 ) -> tuple[jnp.ndarray, Params | None]:
@@ -209,19 +211,26 @@ def forward(
         write_slots = positions[:, 0]
 
     if attn_impl == "pallas":
-        try:
-            from oryx_tpu.ops.pallas import flash_attention as _fa
-        except ImportError as e:  # pragma: no cover
-            raise NotImplementedError(
-                "attn_impl='pallas' requires oryx_tpu.ops.pallas; "
-                "use attn_impl='xla'"
-            ) from e
+        from oryx_tpu.ops.pallas import flash_attention as _fa
 
         def attn_fn(q, k, v, **kw):
             return _fa.flash_attention(q, k, v, causal=True, **kw)
     elif attn_impl == "xla":
         def attn_fn(q, k, v, **kw):
             return attention(q, k, v, causal=True, **kw)
+    elif attn_impl == "ring":
+        # Sequence parallelism over the `sp` mesh axis (training/prefill;
+        # decode with a KV cache is not sequence-sharded).
+        from oryx_tpu.ops.ring_attention import ring_attention
+
+        if kv_cache is not None:
+            raise ValueError("attn_impl='ring' does not support kv_cache")
+
+        def attn_fn(q, k, v, *, q_positions, kv_positions, kv_mask):
+            return ring_attention(
+                q, k, v, mesh=mesh, axis_name=sp_axis, causal=True,
+                positions=q_positions, kv_mask=kv_mask,
+            )
     else:
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
 

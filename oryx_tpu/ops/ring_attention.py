@@ -1,0 +1,135 @@
+"""Ring attention — sequence/context parallelism over the `sp` mesh axis.
+
+Long-context scaling beyond one chip's HBM (SURVEY.md §5 "Long-context"):
+the sequence is sharded over `sp`; each device keeps its local Q block
+resident and K/V blocks rotate around the ring via `lax.ppermute` (ICI
+neighbor exchange), merging each visiting block into an online-softmax
+accumulator. Peak memory is O(T/sp) per device while computing exact
+(non-approximate) attention over the full sequence — the XLA-collective
+equivalent of Ring Attention (Liu et al., 2023), built with shard_map so
+the collective schedule is explicit.
+
+Masking model matches ops/attention.py: causal on absolute positions
+(positions travel with the K/V blocks), plus explicit kv validity.
+Compute follows the same policy: fp32 logits/softmax state, input-dtype
+probs·V matmuls.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _chunk_logits(q, k, qpos, kpos, kvalid, *, causal, scale):
+    """[B,Tq,Hk,G,D] x [B,Tc,Hk,D] → masked fp32 logits [B,Hk,G,Tq,Tc]."""
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = kvalid[:, None, :].astype(bool)  # [B, 1, Tc]
+    if causal:
+        mask = jnp.logical_and(
+            mask, qpos[:, :, None] >= kpos[:, None, :]
+        )
+    return jnp.where(mask[:, None, None, :, :], logits, NEG)
+
+
+def ring_attention_shard(
+    q, k, v, q_pos, kv_pos, kv_valid,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """Per-shard body (call inside shard_map over `axis_name`).
+
+    q/k/v: local blocks [B, Tl, H*, D] (GQA: Hq % Hk == 0);
+    q_pos/kv_pos: absolute positions [B, Tl]; kv_valid: [B, Tl] int.
+    Returns [B, Tl, Hq, D] in q.dtype — exact attention over the global
+    sequence.
+    """
+    B, Tl, Hq, D = q.shape
+    _, _, Hk, _ = k.shape
+    G = Hq // Hk
+    if scale is None:
+        scale = D**-0.5
+    n = jax.lax.psum(1, axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    qg = q.reshape(B, Tl, Hk, G, D)
+    acc = jnp.zeros((B, Hk, G, Tl, D), jnp.float32)
+    m = jnp.full((B, Hk, G, Tl, 1), NEG, jnp.float32)
+    l = jnp.zeros((B, Hk, G, Tl, 1), jnp.float32)
+
+    def body(_, carry):
+        acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur = carry
+        s = _chunk_logits(
+            qg, k_cur, q_pos, kpos_cur, kvalid_cur, causal=causal,
+            scale=scale,
+        )  # [B, Hk, G, Tl, Tc]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + pv
+        # Rotate the K/V block (and its metadata) one step around the ring.
+        k_cur, v_cur, kpos_cur, kvalid_cur = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm),
+            (k_cur, v_cur, kpos_cur, kvalid_cur),
+        )
+        return acc, m_new, l, k_cur, v_cur, kpos_cur, kvalid_cur
+
+    acc, m, l, *_ = jax.lax.fori_loop(
+        0, n, body, (acc, m, l, k, v, kv_pos, kv_valid)
+    )
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    out = jnp.moveaxis(out, 3, 1).reshape(B, Tl, Hq, D)  # [B,Tl,Hk,G,D]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str = "sp",
+    causal: bool = False,
+    positions=None,
+    kv_mask=None,
+    scale: float | None = None,
+):
+    """Global-array entry: shards the sequence over `axis_name` and runs the
+    ring. q/k/v: [B, T, H*, D] with T divisible by the axis size.
+    mesh=None uses the ambient mesh (jax.sharding.use_mesh / jit context).
+    """
+    B, T, _, _ = q.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    positions = positions.astype(jnp.int32)
+    kv_valid = (
+        jnp.broadcast_to(kv_mask, (B, T)).astype(jnp.int32)
+        if kv_mask is not None
+        else jnp.ones((B, T), jnp.int32)
+    )
+    seq = P(None, axis_name, None, None)
+    tok = P(None, axis_name)
+    fn = shard_map(
+        partial(
+            ring_attention_shard, axis_name=axis_name, causal=causal,
+            scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(seq, seq, seq, tok, tok, tok),
+        out_specs=seq,
+        check_vma=False,
+    )
+    return fn(q, k, v, positions, positions, kv_valid)

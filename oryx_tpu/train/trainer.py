@@ -40,7 +40,7 @@ class Trainer:
         self.logger = MetricLogger(metrics_path, log_every=cfg.train.log_every)
         self.ckpt = CheckpointManager(cfg.train.checkpoint_dir)
 
-        with self.mesh:
+        with jax.sharding.set_mesh(self.mesh):
             if params is None:
                 params = oryx.init_params(cfg, jax.random.key(cfg.train.seed))
             self.tx = make_optimizer(cfg.train, params)
@@ -119,7 +119,7 @@ class Trainer:
         cfg = self.cfg
         num_steps = num_steps or cfg.train.num_train_steps
         start = self.resume_if_available() if resume else 0
-        with self.mesh:
+        with jax.sharding.set_mesh(self.mesh):
             for step_i in range(start, num_steps):
                 try:
                     host_batch = next(batches)
