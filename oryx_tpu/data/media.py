@@ -1,0 +1,74 @@
+"""Host-side media loading: images, and video as frame dirs or decord files.
+
+Reference parity: the reference loads images with PIL and videos with decord
+inside its dataset/inference scripts (SURVEY.md §2 "MM utils", §2a last row:
+video decode stays a host-side CPU dependency). Decord is optional here; a
+directory of frame images always works.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+from oryx_tpu.data import mm_utils
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def _natural_key(name: str) -> tuple:
+    """Sort key treating digit runs numerically, so frame_2 < frame_10."""
+    return tuple(
+        int(part) if part.isdigit() else part
+        for part in re.split(r"(\d+)", name)
+    )
+
+
+def load_image(path: str) -> np.ndarray:
+    from PIL import Image
+
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+def load_video_frames(path: str, num_frames: int) -> list[np.ndarray]:
+    """Uniformly sample `num_frames` from a video file (decord) or a
+    directory of frame images (always available)."""
+    if os.path.isdir(path):
+        names = sorted(
+            (n for n in os.listdir(path) if n.lower().endswith(IMAGE_EXTS)),
+            key=_natural_key,
+        )
+        if not names:
+            raise FileNotFoundError(f"no frame images under {path}")
+        idx = mm_utils.sample_frames(len(names), num_frames)
+        return [load_image(os.path.join(path, names[i])) for i in idx]
+    try:
+        import decord
+    except ImportError as e:
+        raise RuntimeError(
+            f"decoding {path} needs decord; pass a directory of frames "
+            "instead"
+        ) from e
+    vr = decord.VideoReader(path)
+    idx = mm_utils.sample_frames(len(vr), num_frames)
+    return [vr[int(i)].asnumpy() for i in idx]
+
+
+def load_record_media(
+    rec: dict, *, media_root: str = "", num_frames: int = 64
+) -> tuple[list[np.ndarray], bool]:
+    """Load a dataset record's media → (frames/images, is_video).
+
+    Record schema follows the training data (train/data.py): "image" is a
+    path or list of paths, "video" a file or frames dir.
+    """
+    join = lambda p: os.path.join(media_root, p) if media_root else p
+    if rec.get("video") is not None:
+        return load_video_frames(join(rec["video"]), num_frames), True
+    img = rec.get("image")
+    if img is None:
+        return [], False
+    paths = [img] if isinstance(img, str) else list(img)
+    return [load_image(join(p)) for p in paths], False

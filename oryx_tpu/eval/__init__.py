@@ -1,0 +1,1 @@
+from oryx_tpu.eval.harness import evaluate, load_task  # noqa: F401

@@ -1,0 +1,203 @@
+"""Benchmark evaluation harness: multiple-choice / open-ended QA over media.
+
+Reference parity: the reference evaluates through the external lmms-eval
+harness (VideoMME, MLVU, MVBench, NextQA, ...; SURVEY.md §1 L7, §3.5) — an
+adapter wraps the §3.2 inference stack and the harness aggregates accuracy,
+optionally splitting the dataset across ranks with each rank running an
+independent replica. This module is that harness, standalone: a task is a
+JSON/JSONL file of records
+
+    {"id": ..., "question": ..., "options": ["...", ...] | null,
+     "answer": "B" | "<free text>", "image": path|[paths] | "video": path}
+
+multiple-choice records are scored by option-letter match (lmms-eval's MCQ
+protocol: prompt lists lettered options, the reply's first letter in range
+counts); open-ended records by normalized exact match.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import re
+import string
+import time
+from typing import Any, Sequence
+
+from oryx_tpu.data import media
+from oryx_tpu.serve.pipeline import OryxInference
+
+LETTERS = string.ascii_uppercase
+
+MCQ_SUFFIX = "Answer with the option's letter from the given choices directly."
+
+
+def load_task(path: str) -> list[dict[str, Any]]:
+    """Load a task file: .jsonl (one record per line) or .json (list)."""
+    with open(path) as f:
+        if path.endswith(".jsonl"):
+            return [json.loads(line) for line in f if line.strip()]
+        recs = json.load(f)
+    if not isinstance(recs, list):
+        raise ValueError(f"{path}: expected a list of records")
+    return recs
+
+
+def format_question(rec: dict[str, Any]) -> str:
+    opts = rec.get("options")
+    if not opts:
+        return rec["question"]
+    lines = [rec["question"]] + [
+        f"{LETTERS[i]}. {o}" for i, o in enumerate(opts)
+    ]
+    lines.append(MCQ_SUFFIX)
+    return "\n".join(lines)
+
+
+def _norm(s: str) -> str:
+    return re.sub(r"\s+", " ", s.strip().lower().strip(".,!?\"'"))
+
+
+def parse_choice(
+    reply: str, num_options: int, options: Sequence[str] | None = None
+) -> str | None:
+    """Extract the chosen option letter from a model reply.
+
+    Ordered by confidence (the lmms-eval MCQ protocol shape): a bare
+    letter reply; "answer is X" / "(X)" / "X." forms; unique option-text
+    containment; finally a standalone letter — but never the bare English
+    articles "A"/"I" inside prose, which are words, not choices."""
+    up = reply.strip().upper()
+    valid = LETTERS[:num_options]
+    if re.fullmatch(rf"\(?([{valid}])\)?[.,:)]?", up):
+        return re.fullmatch(rf"\(?([{valid}])\)?[.,:)]?", up).group(1)
+    m = re.search(rf"ANSWER\s*(?:IS|:)?\s*\(?([{valid}])\b", up)
+    if m:
+        return m.group(1)
+    m = re.search(rf"\(([{valid}])\)|\b([{valid}])[.,:)]", up)
+    if m:
+        return m.group(1) or m.group(2)
+    if options:
+        nr = _norm(reply)
+        hits = [
+            i for i, o in enumerate(options)
+            if _norm(str(o))
+            and re.search(rf"\b{re.escape(_norm(str(o)))}\b", nr)
+        ]
+        if len(hits) == 1:
+            return LETTERS[hits[0]]
+    # Standalone letter anywhere — excluding the article/pronoun words.
+    for m in re.finditer(rf"\b([{valid}])\b", up):
+        if m.group(1) not in ("A", "I"):
+            return m.group(1)
+    return None
+
+
+def score_record(rec: dict[str, Any], reply: str) -> bool:
+    opts = rec.get("options")
+    ans = rec["answer"]
+    if opts:
+        if isinstance(ans, int):
+            ans = LETTERS[ans]
+        return parse_choice(reply, len(opts), opts) == str(ans).strip().upper()
+    return _norm(reply) == _norm(str(ans))
+
+
+@dataclasses.dataclass
+class EvalResult:
+    accuracy: float
+    num_correct: int
+    num_total: int
+    seconds: float
+    records: list[dict[str, Any]]
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def evaluate(
+    pipe: OryxInference,
+    records: Sequence[dict[str, Any]],
+    *,
+    media_root: str = "",
+    num_frames: int = 64,
+    max_new_tokens: int = 16,
+    process_index: int = 0,
+    process_count: int = 1,
+    log_every: int = 25,
+) -> EvalResult:
+    """Run the inference stack over a record shard and score it.
+
+    Dataset sharding mirrors the reference's accelerate-split eval
+    (SURVEY.md §3.5): record i belongs to process i mod process_count; the
+    caller merges per-process results (accuracy is weighted by num_total).
+    """
+    t0 = time.perf_counter()
+    out: list[dict[str, Any]] = []
+    correct = 0
+    # Fallback ids use the GLOBAL record index so merged per-process
+    # results stay distinguishable.
+    mine = [
+        (i, r) for i, r in enumerate(records)
+        if i % process_count == process_index
+    ]
+    for n, (gi, rec) in enumerate(mine, 1):
+        frames, is_video = media.load_record_media(
+            rec, media_root=media_root, num_frames=num_frames
+        )
+        q = format_question(rec)
+        if is_video:
+            reply = pipe.chat_video(
+                frames, q, max_new_tokens=max_new_tokens
+            )
+        else:
+            reply = pipe.chat(
+                q, images=frames or None, max_new_tokens=max_new_tokens
+            )
+        ok = score_record(rec, reply)
+        correct += ok
+        out.append({"id": rec.get("id", gi), "reply": reply, "correct": ok})
+        if log_every and n % log_every == 0:
+            print(f"[eval] {n}/{len(mine)} acc={correct / n:.4f}", flush=True)
+    dt = time.perf_counter() - t0
+    acc = correct / max(len(mine), 1)
+    return EvalResult(acc, correct, len(mine), dt, out)
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="Oryx-TPU benchmark eval")
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--tokenizer-path", default=None)
+    ap.add_argument("--task", required=True, help="task .json/.jsonl file")
+    ap.add_argument("--media-root", default="")
+    ap.add_argument("--num-frames", type=int, default=64)
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--output", default=None, help="results json path")
+    ap.add_argument("--process-index", type=int, default=0)
+    ap.add_argument("--process-count", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    from oryx_tpu.serve.builder import load_pretrained_model
+
+    tokenizer, params, cfg = load_pretrained_model(
+        args.model_path, tokenizer_path=args.tokenizer_path
+    )
+    pipe = OryxInference(tokenizer, params, cfg)
+    result = evaluate(
+        pipe, load_task(args.task),
+        media_root=args.media_root, num_frames=args.num_frames,
+        max_new_tokens=args.max_new_tokens,
+        process_index=args.process_index, process_count=args.process_count,
+    )
+    print(json.dumps({
+        "accuracy": result.accuracy, "n": result.num_total,
+        "seconds": round(result.seconds, 1),
+    }))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(result.to_dict(), f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,8 +131,12 @@ def _jit_mm_generate(
     )
 
 
-def _dtype(cfg: OryxConfig):
+def compute_dtype(cfg: OryxConfig):
+    """cfg.dtype string → jnp dtype for matmuls/activations."""
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[cfg.dtype]
+
+
+_dtype = compute_dtype
 
 
 def mm_generate(

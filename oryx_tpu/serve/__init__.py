@@ -1,0 +1,2 @@
+from oryx_tpu.serve.builder import load_pretrained_model  # noqa: F401
+from oryx_tpu.serve.pipeline import OryxInference  # noqa: F401

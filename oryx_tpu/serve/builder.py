@@ -1,0 +1,126 @@
+"""Model builder: assemble tokenizer + params + config from a checkpoint dir.
+
+Reference parity: `load_pretrained_model()` in `oryx/model/builder.py`
+(SURVEY.md §2 "Model builder", §3.2) — loads the tokenizer, the causal LM,
+the vision tower and the image processor in one call. Here the checkpoint
+can be either:
+
+  * an oryx_tpu-native directory: `oryx_config.json` + an orbax checkpoint
+    tree (as written by utils/checkpoint.CheckpointManager), or
+  * a pair of HF safetensors directories (LLM + vision tower), imported via
+    models/import_hf with a freshly initialized compressor (the reference's
+    "stage-0" state before projector pretraining), optionally merged with a
+    projector-only npz (`pretrain_mm_mlp_adapter` analog).
+
+There is no separate "image processor" object: native-resolution
+preprocessing is pure host numpy (data/mm_utils.py), configured entirely by
+`cfg.vision` — `OryxInference` applies it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.config import OryxConfig
+from oryx_tpu.models import import_hf, oryx
+from oryx_tpu.utils import checkpoint as ckpt_lib
+
+Params = dict[str, Any]
+
+CONFIG_NAME = "oryx_config.json"
+
+
+def save_pretrained(
+    directory: str, cfg: OryxConfig, state_or_params: Any, *, step: int = 0
+) -> None:
+    """Write a self-contained model directory loadable by
+    `load_pretrained_model`: config json + orbax checkpoint."""
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, CONFIG_NAME), "w") as f:
+        f.write(cfg.to_json())
+    mgr = ckpt_lib.CheckpointManager(os.path.join(directory, "ckpt"))
+    mgr.save(step, state_or_params, force=True)
+    mgr.wait()
+    mgr.close()
+
+
+def load_tokenizer(model_path: str):
+    """HF tokenizer from the checkpoint dir (tokenizer.json et al.)."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(model_path, use_fast=True)
+
+
+def load_pretrained_model(
+    model_path: str,
+    *,
+    tokenizer_path: str | None = None,
+    tokenizer: Any | None = None,
+    cfg: OryxConfig | None = None,
+    dtype=jnp.float32,
+) -> tuple[Any, Params, OryxConfig]:
+    """Load (tokenizer, params, cfg) from an oryx_tpu model directory.
+
+    tokenizer_path defaults to model_path; pass the HF backbone dir when the
+    model dir carries no tokenizer files, or inject `tokenizer` directly.
+    """
+    cfg_file = os.path.join(model_path, CONFIG_NAME)
+    if cfg is None:
+        if not os.path.exists(cfg_file):
+            raise FileNotFoundError(
+                f"{cfg_file} not found; pass cfg= explicitly or use "
+                "load_from_hf() for raw HF checkpoints"
+            )
+        with open(cfg_file) as f:
+            cfg = OryxConfig.from_json(f.read())
+
+    ckpt_dir = os.path.join(model_path, "ckpt")
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir}")
+    mgr = ckpt_lib.CheckpointManager(ckpt_dir)
+    try:
+        # Restore the checkpoint's own structure (orbax rejects a target
+        # tree that is a strict subtree, so a bare-params abstract target
+        # would fail on TrainState-shaped checkpoints), then take params.
+        restored = mgr.restore()
+    finally:
+        mgr.close()
+    # Accept both bare-params and TrainState-shaped checkpoints.
+    if isinstance(restored, dict) and "params" in restored:
+        restored = restored["params"]
+    params = jax.tree.map(lambda x: jnp.asarray(x, dtype), restored)
+
+    if tokenizer is None:
+        tokenizer = load_tokenizer(tokenizer_path or model_path)
+    return tokenizer, params, cfg
+
+
+def load_from_hf(
+    llm_path: str,
+    vision_path: str,
+    cfg: OryxConfig,
+    *,
+    projector_path: str | None = None,
+    dtype=jnp.float32,
+    seed: int = 0,
+) -> tuple[Any, Params, OryxConfig]:
+    """Assemble params from HF safetensors checkpoints (SURVEY.md §3.3
+    `initialize_vision_modules`): Qwen2/Yi LLM + SigLIP-family tower, fresh
+    compressor (or merged from a projector-only npz)."""
+    llm_sd = import_hf.load_safetensors_dir(llm_path)
+    vit_sd = import_hf.load_safetensors_dir(vision_path)
+    params: Params = {
+        "llm": import_hf.import_qwen2(llm_sd, cfg.llm, dtype),
+        "vit": import_hf.import_siglip(vit_sd, cfg.vision, dtype),
+        "compressor": oryx.init_params(cfg, jax.random.key(seed), dtype)[
+            "compressor"
+        ],
+    }
+    if projector_path is not None:
+        params = ckpt_lib.load_projector_only(projector_path, params)
+    tokenizer = load_tokenizer(llm_path)
+    return tokenizer, params, cfg

@@ -1,0 +1,54 @@
+"""Inference CLI: `python -m oryx_tpu.serve.cli --model-path ... --image ...`.
+
+Reference parity: the README inference example / demo CLI (SURVEY.md §2
+"Inference example / demo"). Video input is a directory of frame images or
+any file decodable by PIL per frame; native video decode (decord/ffmpeg)
+stays an optional host-side dependency (SURVEY.md §2a last row).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from oryx_tpu.data import media
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="Oryx-TPU inference")
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--tokenizer-path", default=None)
+    ap.add_argument("--question", required=True)
+    ap.add_argument("--image", action="append", default=[],
+                    help="image path (repeatable)")
+    ap.add_argument("--video", default=None,
+                    help="video file (decord) or directory of frames")
+    ap.add_argument("--num-frames", type=int, default=64)
+    ap.add_argument("--max-new-tokens", type=int, default=None)
+    ap.add_argument("--template", default="qwen")
+    args = ap.parse_args(argv)
+
+    from oryx_tpu.serve.builder import load_pretrained_model
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    tokenizer, params, cfg = load_pretrained_model(
+        args.model_path, tokenizer_path=args.tokenizer_path
+    )
+    pipe = OryxInference(tokenizer, params, cfg, template=args.template)
+
+    if args.video is not None:
+        frames = media.load_video_frames(args.video, args.num_frames)
+        answer = pipe.chat_video(
+            frames, args.question, max_new_tokens=args.max_new_tokens
+        )
+    else:
+        images = [media.load_image(p) for p in args.image]
+        answer = pipe.chat(
+            args.question, images=images or None,
+            max_new_tokens=args.max_new_tokens,
+        )
+    print(answer)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

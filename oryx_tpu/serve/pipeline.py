@@ -1,0 +1,194 @@
+"""End-to-end inference pipeline: media + question → answer text.
+
+Reference parity: the README inference flow (SURVEY.md §3.2) — sample video
+frames, preprocess at native resolution, build the conversation prompt with
+`<image>` placeholders, `tokenizer_image_token()`, then `generate()` with a
+KV cache and EOS stopping. Here the whole device side (ViT → compressor →
+splice → prefill → lax.scan decode) is one compiled program per
+(patch-bucket, seq-bucket, cache-bucket) triple; the host side below is
+plain numpy glue.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.config import OryxConfig
+from oryx_tpu.constants import (
+    COMPRESSOR_RATIO,
+    DEFAULT_IMAGE_TOKEN,
+    IMAGE_TOKEN_INDEX,
+    MODALITY_IMAGE,
+    MODALITY_MULTI_IMAGE,
+    MODALITY_VIDEO,
+)
+from oryx_tpu.conversation import conv_templates
+from oryx_tpu.data import mm_utils
+from oryx_tpu.models import generate as generate_lib
+from oryx_tpu.models import oryx, splice
+from oryx_tpu.ops import packing
+
+Params = dict[str, Any]
+
+
+def infer_modality(num_images: int, is_video: bool) -> str:
+    if is_video:
+        return MODALITY_VIDEO
+    return MODALITY_MULTI_IMAGE if num_images > 1 else MODALITY_IMAGE
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "cache_len")
+)
+def _jit_text_generate(
+    params, cfg: OryxConfig, token_ids, lengths, max_new_tokens: int,
+    cache_len: int, key
+):
+    embeds = params["llm"]["embed"]["weight"][token_ids]
+    return generate_lib.generate(
+        params["llm"], cfg.llm, cfg.generation,
+        inputs_embeds=embeds, lengths=lengths,
+        max_new_tokens=max_new_tokens, cache_len=cache_len, key=key,
+        attn_impl=cfg.attn_impl, compute_dtype=oryx.compute_dtype(cfg),
+    )
+
+
+class OryxInference:
+    """Stateless-per-call chat interface over a loaded model.
+
+    `answer = OryxInference(tokenizer, params, cfg).chat("what is this?",
+    images=[img])`; `chat_video(frames, q)` applies 16x compression and one
+    shared patch budget across frames (matching the training-side policy in
+    train/data.SupervisedDataset).
+    """
+
+    def __init__(
+        self,
+        tokenizer,
+        params: Params,
+        cfg: OryxConfig,
+        *,
+        template: str = "qwen",
+    ) -> None:
+        self.tokenizer = tokenizer
+        self.params = params
+        self.cfg = cfg
+        self.conv = conv_templates[template]
+
+    # ---- host-side prompt/media prep ------------------------------------
+
+    def build_prompt(self, question: str, num_media: int) -> str:
+        """Conversation-templated prompt with one `<image>` placeholder per
+        media item prepended to the user turn (reference README style)."""
+        conv = self.conv.copy()
+        prefix = (DEFAULT_IMAGE_TOKEN + "\n") * num_media
+        conv.append_message(conv.roles[0], prefix + question)
+        conv.append_message(conv.roles[1], None)
+        return conv.get_prompt()
+
+    def _prepare_media(
+        self, images: Sequence[np.ndarray], modality: str
+    ) -> packing.PackedVisual:
+        cfgv = self.cfg.vision
+        per_img_cap = (
+            max(1, cfgv.max_patches_per_image // max(len(images), 1))
+            if modality == MODALITY_VIDEO
+            else cfgv.max_patches_per_image
+        )
+        pre = [
+            mm_utils.preprocess_image(img, cfgv.patch_size, per_img_cap)
+            for img in images
+        ]
+        factor = int(COMPRESSOR_RATIO[modality] ** 0.5)
+        return packing.pack_images(
+            pre,
+            patch_size=cfgv.patch_size,
+            base_grid=cfgv.base_grid,
+            side_factors=[factor] * len(pre),
+        )
+
+    # ---- entry points ----------------------------------------------------
+
+    def chat(
+        self,
+        question: str,
+        *,
+        images: Sequence[np.ndarray] | None = None,
+        is_video: bool = False,
+        max_new_tokens: int | None = None,
+        seed: int = 0,
+    ) -> str:
+        """Single-turn QA over optional images / video frames."""
+        images = list(images or [])
+        max_new = max_new_tokens or self.cfg.generation.max_new_tokens
+        key = jax.random.key(seed)
+        if not images:
+            return self._chat_text(question, max_new, key)
+
+        modality = infer_modality(len(images), is_video)
+        packed = self._prepare_media(images, modality)
+        # Video uses ONE placeholder expanded to contiguous per-frame
+        # sentinels — matching the training-side expansion
+        # (train/data.collate) so no stray newline tokens sit between
+        # frame spans; images keep one placeholder each.
+        prompt = self.build_prompt(question, 1 if is_video else len(images))
+        ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
+        if is_video and len(images) > 1:
+            idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
+            ids = np.concatenate(
+                [ids[:idx],
+                 np.full(len(images), IMAGE_TOKEN_INDEX, ids.dtype),
+                 ids[idx + 1:]]
+            )
+        batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+        toks, num = oryx.mm_generate(
+            self.params, self.cfg, packed, batch,
+            max_new_tokens=max_new, key=key,
+        )
+        return self._decode(toks[0], int(num[0]))
+
+    def chat_video(
+        self,
+        frames: Sequence[np.ndarray],
+        question: str,
+        *,
+        num_frames: int | None = None,
+        **kw,
+    ) -> str:
+        """Video QA: uniform frame sampling then 16x-compressed chat."""
+        frames = list(frames)
+        if num_frames is not None and len(frames) > num_frames:
+            idx = mm_utils.sample_frames(len(frames), num_frames)
+            frames = [frames[i] for i in idx]
+        return self.chat(question, images=frames, is_video=True, **kw)
+
+    def _chat_text(self, question: str, max_new: int, key) -> str:
+        prompt = self.build_prompt(question, 0)
+        ids = np.asarray(
+            self.tokenizer.encode(prompt, add_special_tokens=False), np.int32
+        )
+        T = packing.round_up_bucket(len(ids))
+        row = np.zeros((1, T), np.int32)
+        row[0, : len(ids)] = ids
+        cache_len = packing.round_up_bucket(T + max_new)
+        toks, num = _jit_text_generate(
+            self.params, self.cfg, jnp.asarray(row),
+            jnp.asarray([len(ids)], np.int32), max_new, cache_len, key,
+        )
+        return self._decode(np.asarray(toks)[0], int(np.asarray(num)[0]))
+
+    def _decode(self, tokens: np.ndarray, num: int) -> str:
+        ids = [int(t) for t in tokens[:num]]
+        eos = self.cfg.generation.eos_token_id
+        while ids and ids[-1] == eos:
+            ids.pop()
+        text = self.tokenizer.decode(ids, skip_special_tokens=True)
+        stop = self.conv.stop_str
+        if stop and stop in text:
+            text = text.split(stop)[0]
+        return text.strip()

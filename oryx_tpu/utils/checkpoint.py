@@ -39,13 +39,16 @@ class CheckpointManager:
             step, args=ocp.args.StandardSave(state), force=force
         )
 
-    def restore(self, state_like: Any, step: int | None = None) -> Any:
+    def restore(self, state_like: Any = None, step: int | None = None) -> Any:
         """Restore into the structure/shardings of `state_like` (an
-        abstract or concrete pytree of the same shape)."""
+        abstract or concrete pytree of the same shape). With state_like=None,
+        restores the checkpoint's own saved structure (host numpy)."""
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if state_like is None:
+            return self._mgr.restore(step)
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(state_like)
         )

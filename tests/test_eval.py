@@ -1,0 +1,128 @@
+"""Eval-harness tests: MCQ formatting/parsing/scoring, sharding, and an
+end-to-end run over the tiny model (SURVEY.md §1 L7, §3.5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.eval import harness
+from oryx_tpu.models import oryx
+from oryx_tpu.serve.pipeline import OryxInference
+
+
+def test_format_question_mcq():
+    rec = {"question": "What?", "options": ["cat", "dog"], "answer": "B"}
+    q = harness.format_question(rec)
+    assert "A. cat" in q and "B. dog" in q
+    assert harness.MCQ_SUFFIX in q
+
+
+def test_format_question_open():
+    rec = {"question": "Describe.", "answer": "a cat"}
+    assert harness.format_question(rec) == "Describe."
+
+
+@pytest.mark.parametrize("reply,expect", [
+    ("B", "B"),
+    ("B.", "B"),
+    ("(A)", "A"),
+    ("The answer is C", "C"),
+    ("Zebra", None),       # Z out of range for 4 options
+    ("", None),
+])
+def test_parse_choice(reply, expect):
+    assert harness.parse_choice(reply, 4) == expect
+
+
+def test_parse_choice_prose_article_not_a_choice():
+    # "A" as English article must not be read as option A; unique option
+    # content wins instead.
+    opts = ["dog on a rug", "cat on a mat", "bird", "fish"]
+    got = harness.parse_choice("A cat on a mat is shown", 4, opts)
+    assert got == "B"
+    # No option content, article only -> unparseable, not "A".
+    assert harness.parse_choice("A dog maybe", 2, ["x", "y"]) is None
+
+
+def test_natural_frame_sort(tmp_path):
+    from PIL import Image
+
+    from oryx_tpu.data import media
+
+    for i in (1, 2, 10, 11):
+        Image.fromarray(
+            np.full((4, 4, 3), i, dtype=np.uint8)
+        ).save(tmp_path / f"frame_{i}.png")
+    frames = media.load_video_frames(str(tmp_path), 4)
+    assert [int(f[0, 0, 0]) for f in frames] == [1, 2, 10, 11]
+
+
+def test_score_record_mcq_and_open():
+    mcq = {"question": "?", "options": ["x", "y"], "answer": "B"}
+    assert harness.score_record(mcq, "B. y")
+    assert not harness.score_record(mcq, "A")
+    mcq_int = {"question": "?", "options": ["x", "y"], "answer": 1}
+    assert harness.score_record(mcq_int, "the answer is B")
+    opened = {"question": "?", "answer": "A Cat."}
+    assert harness.score_record(opened, " a cat")
+    assert not harness.score_record(opened, "a dog")
+
+
+def test_load_task_json_and_jsonl(tmp_path):
+    recs = [{"id": 1, "question": "q", "answer": "a"}]
+    pj = tmp_path / "t.json"
+    pj.write_text(json.dumps(recs))
+    assert harness.load_task(str(pj)) == recs
+    pl = tmp_path / "t.jsonl"
+    pl.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert harness.load_task(str(pl)) == recs
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def test_evaluate_end_to_end(tmp_path):
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+
+    from PIL import Image
+
+    img_path = tmp_path / "img.png"
+    Image.fromarray(
+        np.random.default_rng(0).integers(
+            0, 255, size=(32, 40, 3), dtype=np.uint8
+        )
+    ).save(img_path)
+    records = [
+        {"id": i, "question": "What?", "options": ["cat", "dog"],
+         "answer": "A", "image": img_path.name}
+        for i in range(2)
+    ]
+    res = harness.evaluate(
+        pipe, records, media_root=str(tmp_path), max_new_tokens=2,
+        log_every=0,
+    )
+    assert res.num_total == 2
+    assert 0.0 <= res.accuracy <= 1.0
+    assert len(res.records) == 2
+
+    # Sharding covers the dataset exactly once across processes.
+    shard0 = harness.evaluate(
+        pipe, records, media_root=str(tmp_path), max_new_tokens=2,
+        process_index=0, process_count=2, log_every=0,
+    )
+    shard1 = harness.evaluate(
+        pipe, records, media_root=str(tmp_path), max_new_tokens=2,
+        process_index=1, process_count=2, log_every=0,
+    )
+    assert shard0.num_total + shard1.num_total == 2

@@ -1,0 +1,123 @@
+"""Serving-layer tests: builder save/load round-trip and the end-to-end
+inference pipeline (SURVEY.md §2 "Inference example / demo", §3.2)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve import builder
+from oryx_tpu.serve.pipeline import OryxInference
+
+
+class FakeTokenizer:
+    """Char-level tokenizer with ids offset past the sentinel range."""
+
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def test_save_load_round_trip(tmp_path, tiny_model):
+    cfg, params = tiny_model
+    d = str(tmp_path / "model")
+    builder.save_pretrained(d, cfg, params)
+    tok, loaded, cfg2 = builder.load_pretrained_model(
+        d, tokenizer=FakeTokenizer()
+    )
+    assert cfg2 == cfg
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chat_image_runs(tiny_model):
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    img = np.random.default_rng(0).integers(
+        0, 255, size=(40, 56, 3), dtype=np.uint8
+    )
+    out = pipe.chat("what is this?", images=[img], max_new_tokens=4)
+    assert isinstance(out, str)
+
+
+def test_save_load_trainstate_checkpoint(tmp_path, tiny_model):
+    """Model dirs holding a TrainState (not bare params) load too."""
+    import jax.numpy as jnp
+
+    from oryx_tpu.train import step as step_lib
+    from oryx_tpu.train.optimizer import make_optimizer
+
+    cfg, params = tiny_model
+    tx = make_optimizer(cfg.train, params)
+    state = step_lib.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params),
+    )
+    d = str(tmp_path / "model")
+    builder.save_pretrained(d, cfg, state)
+    _, loaded, _ = builder.load_pretrained_model(d, tokenizer=FakeTokenizer())
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(loaded)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_video_prompt_contiguous_sentinels(tiny_model):
+    """Video chat expands ONE placeholder to contiguous per-frame
+    sentinels (training-side collate layout) — no text between frames."""
+    from oryx_tpu.constants import IMAGE_TOKEN_INDEX
+    from oryx_tpu.data import mm_utils
+
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    prompt = pipe.build_prompt("q", 1)
+    ids = mm_utils.tokenizer_image_token(prompt, FakeTokenizer())
+    idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
+    n = 3
+    expanded = np.concatenate(
+        [ids[:idx], np.full(n, IMAGE_TOKEN_INDEX, ids.dtype), ids[idx + 1:]]
+    )
+    sent = np.where(expanded == IMAGE_TOKEN_INDEX)[0]
+    assert len(sent) == n
+    assert np.all(np.diff(sent) == 1)
+
+
+def test_chat_video_samples_frames(tiny_model):
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    rng = np.random.default_rng(1)
+    frames = [
+        rng.integers(0, 255, size=(30, 30, 3), dtype=np.uint8)
+        for _ in range(7)
+    ]
+    out = pipe.chat_video(frames, "describe", num_frames=3, max_new_tokens=4)
+    assert isinstance(out, str)
+
+
+def test_chat_text_only(tiny_model):
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    out = pipe.chat("hello there", max_new_tokens=4)
+    assert isinstance(out, str)
+
+
+def test_build_prompt_has_placeholders(tiny_model):
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    p = pipe.build_prompt("q", 3)
+    assert p.count("<image>") == 3
+    assert p.rstrip().endswith("<|im_start|>assistant")
