@@ -1,0 +1,162 @@
+// Native data-loader kernels: fused image preprocessing for the host
+// pipeline.
+//
+// Reference parity: the reference's data loader leans on native code for
+// its host-side hot path — torchvision/PIL-SIMD resize, torch tensor ops,
+// DataLoader worker processes (SURVEY.md §3.1 "DataLoader worker procs
+// decode images/video frames"). This library is the TPU-framework
+// equivalent: one pass over the source image produces the normalized,
+// patchified float32 patch rows that ops/packing.py lays out for the
+// device, fanned out over a std::thread pool (no GIL, no per-image Python
+// overhead, no intermediate resized image buffer).
+//
+// Semantics contract (tested against the numpy path in
+// oryx_tpu/data/mm_utils.py):
+//   * bilinear resize, align_corners=False:   src = (dst + 0.5)*S - 0.5,
+//     edge-clamped taps, matching torch F.interpolate / mm_utils.
+//   * normalize: (x/255 - mean) / std  for uint8 inputs.
+//   * patchify: output row r = (gy*gw + gx) holds patch pixels in
+//     (py, px, c) order — the order import_hf.import_siglip flattens the
+//     HF conv kernel to (ops/packing.py patchify).
+//
+// C ABI only (ctypes-consumed; no pybind11 in the image).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Taps {
+  std::vector<int> lo, hi;
+  std::vector<float> frac;
+};
+
+// Source taps for every destination index along one axis.
+Taps make_taps(int dst, int src) {
+  Taps t;
+  t.lo.resize(dst);
+  t.hi.resize(dst);
+  t.frac.resize(dst);
+  const float scale = static_cast<float>(src) / static_cast<float>(dst);
+  for (int i = 0; i < dst; ++i) {
+    float s = (static_cast<float>(i) + 0.5f) * scale - 0.5f;
+    float f = std::floor(s);
+    int lo = static_cast<int>(f);
+    t.frac[i] = s - f;
+    t.lo[i] = std::min(std::max(lo, 0), src - 1);
+    t.hi[i] = std::min(std::max(lo + 1, 0), src - 1);
+  }
+  return t;
+}
+
+template <typename T>
+inline float load_norm(const T* img, long idx, float scale, float mean,
+                       float inv_std) {
+  return (static_cast<float>(img[idx]) * scale - mean) * inv_std;
+}
+
+// One image: resize to (out_h, out_w), normalize, write patch rows.
+template <typename T>
+void preprocess_one(const T* img, int H, int W, int C, int out_h, int out_w,
+                    int patch, float mean, float inv_std, float px_scale,
+                    float* out) {
+  const Taps ty = make_taps(out_h, H);
+  const Taps tx = make_taps(out_w, W);
+  const int gw = out_w / patch;
+  const int patch_dim = patch * patch * C;
+  const long rowW = static_cast<long>(W) * C;
+  for (int y = 0; y < out_h; ++y) {
+    const long y0 = ty.lo[y] * rowW, y1 = ty.hi[y] * rowW;
+    const float fy = ty.frac[y];
+    const int gy = y / patch, py = y % patch;
+    for (int x = 0; x < out_w; ++x) {
+      const long x0 = static_cast<long>(tx.lo[x]) * C;
+      const long x1 = static_cast<long>(tx.hi[x]) * C;
+      const float fx = tx.frac[x];
+      const int gx = x / patch, pxi = x % patch;
+      float* dst = out + static_cast<long>(gy * gw + gx) * patch_dim +
+                   (static_cast<long>(py) * patch + pxi) * C;
+      for (int c = 0; c < C; ++c) {
+        const float tl = load_norm(img, y0 + x0 + c, px_scale, mean, inv_std);
+        const float tr = load_norm(img, y0 + x1 + c, px_scale, mean, inv_std);
+        const float bl = load_norm(img, y1 + x0 + c, px_scale, mean, inv_std);
+        const float br = load_norm(img, y1 + x1 + c, px_scale, mean, inv_std);
+        const float top = tl + (tr - tl) * fx;
+        const float bot = bl + (br - bl) * fx;
+        dst[c] = top + (bot - top) * fy;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Preprocess one image. dtype: 0 = uint8 (scaled by 1/255), 1 = float32
+// (used as-is). out must hold (out_h/patch)*(out_w/patch)*patch*patch*C
+// floats. Returns 0 on success, negative on bad arguments.
+int oryx_preprocess_image(const void* img, int dtype, int H, int W, int C,
+                          int out_h, int out_w, int patch, float mean,
+                          float std, float* out) {
+  if (!img || !out || H <= 0 || W <= 0 || C <= 0 || patch <= 0) return -1;
+  if (out_h % patch != 0 || out_w % patch != 0) return -2;
+  const float inv_std = 1.0f / std;
+  if (dtype == 0) {
+    preprocess_one(static_cast<const uint8_t*>(img), H, W, C, out_h, out_w,
+                   patch, mean, inv_std, 1.0f / 255.0f, out);
+  } else if (dtype == 1) {
+    preprocess_one(static_cast<const float*>(img), H, W, C, out_h, out_w,
+                   patch, mean, inv_std, 1.0f, out);
+  } else {
+    return -3;
+  }
+  return 0;
+}
+
+// Batch preprocess over a thread pool. Arrays are length n; outs[i] points
+// at image i's patch-row destination (may alias disjoint slices of one
+// packed buffer — ops/packing.py writes each image's rows contiguously).
+// num_threads <= 0 uses the hardware concurrency. Returns 0 on success,
+// else the first nonzero per-image status.
+int oryx_batch_preprocess(int n, const void** imgs, const int* dtypes,
+                          const int* Hs, const int* Ws, const int* Cs,
+                          const int* out_hs, const int* out_ws, int patch,
+                          float mean, float std, float** outs,
+                          int num_threads) {
+  if (n <= 0) return 0;
+  if (num_threads <= 0) {
+    num_threads = static_cast<int>(std::thread::hardware_concurrency());
+    if (num_threads <= 0) num_threads = 1;
+  }
+  num_threads = std::min(num_threads, n);
+  std::atomic<int> next(0), status(0);
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      int rc = oryx_preprocess_image(imgs[i], dtypes[i], Hs[i], Ws[i], Cs[i],
+                                     out_hs[i], out_ws[i], patch, mean, std,
+                                     outs[i]);
+      if (rc != 0) {
+        int expected = 0;
+        status.compare_exchange_strong(expected, rc);
+      }
+    }
+  };
+  if (num_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(num_threads);
+    for (int t = 0; t < num_threads; ++t) threads.emplace_back(worker);
+    for (auto& th : threads) th.join();
+  }
+  return status.load();
+}
+
+int oryx_loader_abi_version() { return 1; }
+
+}  // extern "C"

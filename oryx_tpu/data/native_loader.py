@@ -1,0 +1,174 @@
+"""ctypes binding for the native host-pipeline kernels (native/loader.cpp).
+
+The C++ library fuses resize+normalize+patchify in one pass and fans a
+batch out over a std::thread pool — the framework's equivalent of the
+reference's native data-loader floor (PIL-SIMD/torchvision resize + torch
+DataLoader worker processes, SURVEY.md §3.1). Falls back cleanly when the
+shared library hasn't been built: callers gate on `is_available()`.
+
+Build: `make -C native/` (or `build()` below drives it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+LIB_NAME = "liboryx_loader.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _lib_path() -> str:
+    return os.environ.get(
+        "ORYX_NATIVE_LIB", os.path.join(_NATIVE_DIR, LIB_NAME)
+    )
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the shared library in-tree. Returns success."""
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        r = subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=quiet, text=True, timeout=120,
+        )
+        return r.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path):
+            if os.environ.get("ORYX_NATIVE_AUTOBUILD", "1") != "1" or not build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.oryx_preprocess_image.restype = ctypes.c_int
+        lib.oryx_preprocess_image.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_void_p,
+        ]
+        lib.oryx_batch_preprocess.restype = ctypes.c_int
+        lib.oryx_batch_preprocess.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.c_float, ctypes.c_float,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)), ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def _img_meta(img: np.ndarray) -> tuple[np.ndarray, int]:
+    """Contiguous array + dtype code (0=uint8, 1=float32)."""
+    if img.dtype == np.uint8:
+        return np.ascontiguousarray(img), 0
+    return np.ascontiguousarray(img, dtype=np.float32), 1
+
+
+def preprocess_image(
+    img: np.ndarray,
+    out_hw: tuple[int, int],
+    patch: int,
+    mean: float,
+    std: float,
+    out: np.ndarray | None = None,
+) -> np.ndarray:
+    """Fused resize(align_corners=False) + normalize + patchify.
+
+    Returns float32 [gh*gw, patch*patch*C] patch rows (written into `out`
+    when given — e.g. a row slice of the packed patches buffer).
+    """
+    lib = _load()
+    assert lib is not None, "native loader unavailable; gate on is_available()"
+    img, dtype = _img_meta(img)
+    H, W, C = img.shape
+    oh, ow = out_hw
+    rows = (oh // patch) * (ow // patch)
+    if out is None:
+        out = np.empty((rows, patch * patch * C), np.float32)
+    assert out.dtype == np.float32 and out.flags.c_contiguous
+    assert out.shape == (rows, patch * patch * C), (out.shape, rows)
+    rc = lib.oryx_preprocess_image(
+        img.ctypes.data_as(ctypes.c_void_p), dtype, H, W, C, oh, ow, patch,
+        mean, std, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise RuntimeError(f"oryx_preprocess_image failed: {rc}")
+    return out
+
+
+def batch_preprocess(
+    images: list[np.ndarray],
+    out_hws: list[tuple[int, int]],
+    patch: int,
+    mean: float,
+    std: float,
+    outs: list[np.ndarray] | None = None,
+    num_threads: int = 0,
+) -> list[np.ndarray]:
+    """Thread-pool batch version of `preprocess_image`.
+
+    outs may alias disjoint row slices of one packed buffer, so the pool
+    writes the final device layout directly.
+    """
+    lib = _load()
+    assert lib is not None, "native loader unavailable; gate on is_available()"
+    n = len(images)
+    if n == 0:
+        return []
+    metas = [_img_meta(img) for img in images]
+    C = metas[0][0].shape[2]
+    if outs is None:
+        outs = [
+            np.empty(((oh // patch) * (ow // patch), patch * patch * C),
+                     np.float32)
+            for oh, ow in out_hws
+        ]
+    arr_i = lambda vals: (ctypes.c_int * n)(*vals)
+    img_ptrs = (ctypes.c_void_p * n)(
+        *[m[0].ctypes.data_as(ctypes.c_void_p).value for m in metas]
+    )
+    out_ptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+        *[o.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for o in outs]
+    )
+    rc = lib.oryx_batch_preprocess(
+        n, img_ptrs, arr_i([m[1] for m in metas]),
+        arr_i([m[0].shape[0] for m in metas]),
+        arr_i([m[0].shape[1] for m in metas]),
+        arr_i([m[0].shape[2] for m in metas]),
+        arr_i([hw[0] for hw in out_hws]), arr_i([hw[1] for hw in out_hws]),
+        patch, mean, std, out_ptrs, num_threads,
+    )
+    if rc != 0:
+        raise RuntimeError(f"oryx_batch_preprocess failed: {rc}")
+    return outs

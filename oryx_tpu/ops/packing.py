@@ -101,35 +101,41 @@ class PackedVisual:
     num_queries: int
 
 
-def pack_images(
-    images: list[np.ndarray],
-    *,
-    patch_size: int,
+def _pack_metadata(
+    grids: list[tuple[int, int]],
+    side_factors: list[int],
     base_grid: int,
-    side_factors: list[int] | int = 1,
-    buckets: tuple[int, ...] = DEFAULT_BUCKETS,
-) -> PackedVisual:
-    """Pack preprocessed images (pixel arrays, dims multiples of patch_size)
-    into one static-shape buffer.
+    patch_dim: int,
+    buckets: tuple[int, ...],
+) -> tuple[PackedVisual, list[int]]:
+    """Build all bookkeeping arrays for given per-image patch grids, with a
+    zeroed patches buffer. Returns (packed, per-image patch-row offsets) —
+    the caller fills packed.patches[off : off + h*w] per image."""
+    if not grids:
+        # Text-only batch: a minimal all-padding buffer (segment/region id 0
+        # everywhere) — the ViT/compressor run over it and every consumer
+        # masks it out; splice never points at it (is_visual all False).
+        P = buckets[0]
+        return PackedVisual(
+            patches=np.zeros((P, patch_dim), np.float32),
+            segment_ids=np.zeros(P, np.int32),
+            region_ids=np.zeros(P, np.int32),
+            pos_coords=np.zeros((P, 2), np.float32),
+            q_segment_ids=np.zeros(P, np.int32),
+            q_region_ids=np.zeros(P, np.int32),
+            grids=[], q_grids=[], side_factors=[],
+            num_patches=0, num_queries=0,
+        ), []
 
-    side_factors: compressor downsample factor per spatial side for each
-    image (scalar broadcast). Area compression is the square: 1→1x, 2→4x,
-    4→16x (constants.COMPRESSOR_RATIO).
-    """
-    n = len(images)
-    if isinstance(side_factors, int):
-        side_factors = [side_factors] * n
-    assert len(side_factors) == n
-
-    patch_list, seg_list, reg_list, coord_list = [], [], [], []
+    seg_list, reg_list, coord_list = [], [], []
     qseg_list, qreg_list = [], []
-    grids: list[tuple[int, int]] = []
     q_grids: list[tuple[int, int]] = []
+    offsets: list[int] = []
     next_region = 1
-    for i, (img, s) in enumerate(zip(images, side_factors), start=1):
-        patches, (h, w) = patchify(img, patch_size)
-        grids.append((h, w))
-        patch_list.append(patches)
+    off = 0
+    for i, ((h, w), s) in enumerate(zip(grids, side_factors), start=1):
+        offsets.append(off)
+        off += h * w
         seg_list.append(np.full(h * w, i, np.int32))
         coord_list.append(posemb_source_coords(h, w, base_grid))
 
@@ -145,10 +151,8 @@ def pack_images(
         )
         next_region += hq * wq
 
-    patches = np.concatenate(patch_list, axis=0)
-    P_real = patches.shape[0]
+    P_real = off
     P = round_up_bucket(P_real, buckets)
-    patch_dim = patches.shape[1]
 
     def pad_to(arr, length, fill=0):
         out = np.full((length, *arr.shape[1:]), fill, arr.dtype)
@@ -159,16 +163,110 @@ def pack_images(
     Q_real = q_seg.shape[0]
     Q = round_up_bucket(Q_real, buckets)
 
-    return PackedVisual(
-        patches=pad_to(patches.astype(np.float32), P),
+    packed = PackedVisual(
+        patches=np.zeros((P, patch_dim), np.float32),
         segment_ids=pad_to(np.concatenate(seg_list), P),
         region_ids=pad_to(np.concatenate(reg_list), P),
         pos_coords=pad_to(np.concatenate(coord_list), P),
         q_segment_ids=pad_to(q_seg, Q),
         q_region_ids=pad_to(np.concatenate(qreg_list), Q),
-        grids=grids,
+        grids=list(grids),
         q_grids=q_grids,
         side_factors=list(side_factors),
         num_patches=P_real,
         num_queries=Q_real,
     )
+    return packed, offsets
+
+
+def _broadcast_factors(side_factors: list[int] | int, n: int) -> list[int]:
+    if isinstance(side_factors, int):
+        return [side_factors] * n
+    assert len(side_factors) == n
+    return list(side_factors)
+
+
+def pack_images(
+    images: list[np.ndarray],
+    *,
+    patch_size: int,
+    base_grid: int,
+    side_factors: list[int] | int = 1,
+    buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+) -> PackedVisual:
+    """Pack preprocessed images (pixel arrays, dims multiples of patch_size)
+    into one static-shape buffer.
+
+    side_factors: compressor downsample factor per spatial side for each
+    image (scalar broadcast). Area compression is the square: 1→1x, 2→4x,
+    4→16x (constants.COMPRESSOR_RATIO).
+    """
+    side_factors = _broadcast_factors(side_factors, len(images))
+    rows_grids = [patchify(img, patch_size) for img in images]
+    grids = [g for _, g in rows_grids]
+    patch_dim = (
+        rows_grids[0][0].shape[1] if rows_grids else patch_size * patch_size * 3
+    )
+    packed, offsets = _pack_metadata(
+        grids, side_factors, base_grid, patch_dim, buckets
+    )
+    for (rows, (h, w)), off in zip(rows_grids, offsets):
+        packed.patches[off : off + h * w] = rows
+    return packed
+
+
+def pack_raw_images(
+    images: list[np.ndarray],
+    *,
+    patch_size: int,
+    base_grid: int,
+    side_factors: list[int] | int = 1,
+    max_patches: list[int] | int = 4096,
+    buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+) -> PackedVisual:
+    """Pack RAW images (uint8/float HWC, any resolution): fused
+    resize+normalize+patchify straight into the packed buffer.
+
+    Uses the native thread-pool kernels (native/loader.cpp via
+    data/native_loader.py) when built — each image's patch rows are written
+    by a C++ worker directly into its slice of the packed buffer — with a
+    numpy fallback (data/mm_utils.preprocess_image + patchify) otherwise.
+    """
+    from oryx_tpu.data import mm_utils, native_loader
+
+    n = len(images)
+    side_factors = _broadcast_factors(side_factors, n)
+    caps = max_patches if isinstance(max_patches, list) else [max_patches] * n
+    assert len(caps) == n
+
+    out_hws = [
+        mm_utils.resize_to_patch_grid(img.shape[:2], patch_size, cap)
+        for img, cap in zip(images, caps)
+    ]
+    grids = [(oh // patch_size, ow // patch_size) for oh, ow in out_hws]
+    C = images[0].shape[2] if n else 3
+    # All images must share a channel count: patch_dim (and every slice
+    # width below) is sized from it, and the native kernel writes each
+    # image's own C floats per pixel — a mismatch would corrupt the buffer.
+    for i, img in enumerate(images):
+        if img.shape[2] != C:
+            raise ValueError(
+                f"image {i} has {img.shape[2]} channels, expected {C}; "
+                "convert inputs to RGB first"
+            )
+    packed, offsets = _pack_metadata(
+        grids, side_factors, base_grid, patch_size * patch_size * C, buckets
+    )
+    slices = [
+        packed.patches[off : off + h * w] for (h, w), off in zip(grids, offsets)
+    ]
+    if native_loader.is_available():
+        native_loader.batch_preprocess(
+            images, out_hws, patch_size,
+            mm_utils.IMAGE_MEAN, mm_utils.IMAGE_STD, outs=slices,
+        )
+    else:
+        for img, cap, dst in zip(images, caps, slices):
+            pre = mm_utils.preprocess_image(img, patch_size, cap)
+            dst[:] = patchify(pre, patch_size)[0]
+    return packed

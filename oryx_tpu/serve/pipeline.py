@@ -100,16 +100,13 @@ class OryxInference:
             if modality == MODALITY_VIDEO
             else cfgv.max_patches_per_image
         )
-        pre = [
-            mm_utils.preprocess_image(img, cfgv.patch_size, per_img_cap)
-            for img in images
-        ]
         factor = int(COMPRESSOR_RATIO[modality] ** 0.5)
-        return packing.pack_images(
-            pre,
+        return packing.pack_raw_images(
+            list(images),
             patch_size=cfgv.patch_size,
             base_grid=cfgv.base_grid,
-            side_factors=[factor] * len(pre),
+            side_factors=[factor] * len(images),
+            max_patches=[per_img_cap] * len(images),
         )
 
     # ---- entry points ----------------------------------------------------

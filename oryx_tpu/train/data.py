@@ -115,12 +115,17 @@ def _conversation_parts(
 
 @dataclass
 class Example:
-    """One preprocessed sample (host-side, pre-batching)."""
+    """One preprocessed sample (host-side, pre-batching).
+
+    images stay RAW (any resolution, uint8 or float); the collator runs
+    the fused resize+normalize+patchify over the whole batch (native
+    thread pool when built — ops/packing.pack_raw_images)."""
 
     input_ids: np.ndarray  # with sentinels
     labels: np.ndarray
-    images: list[np.ndarray]  # preprocessed pixel arrays (patch-multiple)
+    images: list[np.ndarray]  # raw pixel arrays
     modality: str
+    max_patches: int = 4096  # per-image patch cap for this sample
 
 
 class SupervisedDataset:
@@ -172,18 +177,14 @@ class SupervisedDataset:
     def __getitem__(self, i: int) -> Example:
         rec = self.records[i]
         modality = record_modality(rec)
-        raw = self.media_loader(rec) if (
+        images = self.media_loader(rec) if (
             rec.get("image") is not None or rec.get("video") is not None
         ) else []
         # Video frames share one budget; images each get the full cap.
         per_img_cap = (
-            max(1, self.max_patches // max(len(raw), 1))
+            max(1, self.max_patches // max(len(images), 1))
             if modality == MODALITY_VIDEO else self.max_patches
         )
-        images = [
-            mm_utils.preprocess_image(img, self.patch_size, per_img_cap)
-            for img in raw
-        ]
         ids, labels = preprocess_conversation(rec, self.tokenizer, self.conv)
         n_sentinels = int(np.sum(ids == IMAGE_TOKEN_INDEX))
         if n_sentinels != len(images):
@@ -196,7 +197,7 @@ class SupervisedDataset:
                     f"record {rec.get('id')}: {n_sentinels} image tokens vs "
                     f"{len(images)} images"
                 )
-        return Example(ids, labels, images, modality)
+        return Example(ids, labels, images, modality, per_img_cap)
 
 
 def collate(
@@ -211,6 +212,7 @@ def collate(
     (all BATCH_FIELDS of train.step, numpy)."""
     all_images: list[np.ndarray] = []
     factors: list[int] = []
+    caps: list[int] = []
     per_sample_ids: list[np.ndarray] = []
     per_sample_labels: list[np.ndarray] = []
     image_counts: list[int] = []
@@ -234,11 +236,12 @@ def collate(
         per_sample_labels.append(labels)
         all_images.extend(ex.images)
         factors.extend([side_factor(ex.modality)] * len(ex.images))
+        caps.extend([ex.max_patches] * len(ex.images))
         image_counts.append(len(ex.images))
 
-    packed = packing.pack_images(
+    packed = packing.pack_raw_images(
         all_images, patch_size=patch_size, base_grid=base_grid,
-        side_factors=factors, buckets=buckets,
+        side_factors=factors, max_patches=caps, buckets=buckets,
     )
     slots = splice.query_slots(packed)
     batch = splice.build_mm_batch(
@@ -299,6 +302,79 @@ def collate_microbatches(
         )
         out[key] = np.stack([_pad_to_shape(m[key], shape, fill) for m in micro])
     return out
+
+
+class PrefetchIterator:
+    """Background-thread prefetch over any batch iterator.
+
+    The reference overlaps host data work with device steps via DataLoader
+    worker processes (SURVEY.md §3.1 "DataLoader worker procs ⊗"); here one
+    thread runs the (GIL-releasing: native preprocess, numpy, file IO)
+    collation pipeline `depth` batches ahead while the jitted step runs.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator[Any], depth: int = 2) -> None:
+        import queue
+        import threading
+
+        self._q: Any = queue.Queue(maxsize=max(depth, 1))
+        self._err: BaseException | None = None
+        self._stop = threading.Event()
+
+        def put_or_stop(item) -> bool:
+            """Blocking put that aborts when close() is called. Returns
+            False on abort."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def run() -> None:
+            try:
+                for item in it:
+                    if not put_or_stop(item):
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                self._err = e
+            finally:
+                put_or_stop(self._DONE)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release prefetched batches. Safe to call
+        more than once; the underlying iterator is abandoned (infinite
+        epoch streams would otherwise keep collating forever)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def grouped_batch_iterator(

@@ -115,26 +115,36 @@ class Trainer:
         *,
         num_steps: int | None = None,
         resume: bool = True,
+        prefetch: int = 2,
     ) -> step_lib.TrainState:
         cfg = self.cfg
         num_steps = num_steps or cfg.train.num_train_steps
         start = self.resume_if_available() if resume else 0
-        with jax.sharding.set_mesh(self.mesh):
-            for step_i in range(start, num_steps):
-                try:
-                    host_batch = next(batches)
-                except StopIteration:
-                    rank0_print("data exhausted; stopping")
-                    break
-                batch = self._device_batch(host_batch)
-                self.state, metrics = step_lib.train_step(
-                    self.state, batch, cfg, self.tx
-                )
-                self.logger.log_step(step_i + 1, jax.device_get(metrics))
-                if (step_i + 1) % cfg.train.checkpoint_every == 0:
-                    self.ckpt.save(step_i + 1, self.state)
+        prefetcher = None
+        if prefetch > 0 and start < num_steps:
+            from oryx_tpu.train.data import PrefetchIterator
+
+            batches = prefetcher = PrefetchIterator(batches, depth=prefetch)
+        try:
+            with jax.sharding.set_mesh(self.mesh):
+                for step_i in range(start, num_steps):
+                    try:
+                        host_batch = next(batches)
+                    except StopIteration:
+                        rank0_print("data exhausted; stopping")
+                        break
+                    batch = self._device_batch(host_batch)
+                    self.state, metrics = step_lib.train_step(
+                        self.state, batch, cfg, self.tx
+                    )
+                    self.logger.log_step(step_i + 1, jax.device_get(metrics))
+                    if (step_i + 1) % cfg.train.checkpoint_every == 0:
+                        self.ckpt.save(step_i + 1, self.state)
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
         final_step = int(jax.device_get(self.state.step))
-        if final_step > 0:
+        if final_step > 0 and self.ckpt.latest_step() != final_step:
             self.ckpt.save(final_step, self.state, force=True)
         self.ckpt.wait()
         return self.state

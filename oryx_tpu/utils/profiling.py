@@ -1,0 +1,79 @@
+"""Tracing / profiling: jax.profiler glue + per-step timing.
+
+Reference parity: the reference has no first-class tracing — ad-hoc torch
+profiler + DeepSpeed wall-clock timers / flops_profiler toggles
+(SURVEY.md §5 "Tracing / profiling"). Here profiling is first-class:
+Perfetto/TensorBoard traces via jax.profiler, named annotations around the
+ViT / compressor / decoder phases, and a step timer that reports the
+north-star metric (tokens/sec/chip) continuously.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+def start_server(port: int = 9999) -> None:
+    """Start the profiler RPC server (connect TensorBoard / xprof to it)."""
+    jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a trace viewable in TensorBoard/Perfetto."""
+    opts = jax.profiler.ProfileOptions()
+    opts.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=opts)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Context manager naming a region in the profiler timeline. Wrap host
+    dispatch of model phases (vit / compressor / decoder / data)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Rolling wall-clock step stats: step time and tokens/sec/chip.
+
+    Call `tick(num_tokens)` once per optimizer step AFTER the host has
+    synchronized on the step's results (e.g. after device_get of metrics —
+    under async dispatch an unsynced tick measures only dispatch time).
+    """
+
+    def __init__(self, window: int = 20, n_chips: int | None = None) -> None:
+        self.window = window
+        self.n_chips = n_chips or jax.device_count()
+        self._times: list[float] = []
+        self._tokens: list[int] = []
+        self._last: float | None = None
+
+    def tick(self, num_tokens: int) -> dict[str, float] | None:
+        """Record a step boundary; returns rolling stats (None on the first
+        tick, which only arms the timer)."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return None
+        dt = now - self._last
+        self._last = now
+        self._times.append(dt)
+        self._tokens.append(num_tokens)
+        if len(self._times) > self.window:
+            self._times.pop(0)
+            self._tokens.pop(0)
+        total_t = sum(self._times)
+        total_tok = sum(self._tokens)
+        return {
+            "step_time_s": dt,
+            "step_time_avg_s": total_t / len(self._times),
+            "tokens_per_sec": total_tok / total_t,
+            "tokens_per_sec_per_chip": total_tok / total_t / self.n_chips,
+        }

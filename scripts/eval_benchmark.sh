@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Benchmark evaluation (SURVEY.md §3.5): VideoMME-style MCQ tasks in the
+# harness's task-json format. Multi-host: run on every host with
+# PROCESS_INDEX/PROCESS_COUNT; merge per-process result jsons after.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODEL=${MODEL:?path to oryx_tpu model dir}
+TASK=${TASK:?task .json/.jsonl file}
+
+python -m oryx_tpu.eval.harness \
+  --model-path "$MODEL" \
+  --task "$TASK" \
+  --process-index "${PROCESS_INDEX:-0}" \
+  --process-count "${PROCESS_COUNT:-1}" \
+  --output "results/$(basename "$TASK" .jsonl)_${PROCESS_INDEX:-0}.json" \
+  "$@"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Single-query inference (SURVEY.md §3.2): image or video QA.
+#   MODEL=models/oryx7b-sft ./scripts/infer_example.sh --image cat.jpg \
+#     --question "What is in this image?"
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODEL=${MODEL:?path to oryx_tpu model dir}
+
+python -m oryx_tpu.serve.cli --model-path "$MODEL" "$@"

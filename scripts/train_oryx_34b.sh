@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Oryx-34B (Yi-34B backbone) SFT on a v5e-64 pod: fsdp=64 + grad accum.
+# The reference's 34B path is the same train_mem.py under zero3.json
+# (SURVEY.md §2b "ZeRO-3 for 34B/long-video").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:?path to conversation-records json}
+TOKENIZER=${TOKENIZER:?path to Yi tokenizer dir}
+HF_LLM=${HF_LLM:-}
+HF_VISION=${HF_VISION:-}
+
+python -m oryx_tpu.train.cli \
+  --config scripts/configs/oryx_34b_sft.json \
+  --data "$DATA" \
+  --tokenizer-path "$TOKENIZER" \
+  ${HF_LLM:+--hf-llm "$HF_LLM"} \
+  ${HF_VISION:+--hf-vision "$HF_VISION"} \
+  --sharding fsdp \
+  --metrics-path logs/oryx34b_metrics.jsonl \
+  --output-dir models/oryx34b-sft \
+  "$@"

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Oryx-7B SFT on a v5e-16 slice (fsdp=16, ZeRO-3-equivalent).
+# Reference-equivalent launch: `deepspeed --num_gpus 8 oryx/train/train_mem.py
+#   --deepspeed scripts/zero3.json --model_name_or_path Qwen/Qwen2-7B-Instruct
+#   --vision_tower <oryx-vit> ...` (SURVEY.md §1 L6). One process per HOST;
+# on a pod each host runs this same command (jax.distributed auto-rendezvous).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:?path to conversation-records json}
+TOKENIZER=${TOKENIZER:?path to Qwen2 tokenizer dir}
+HF_LLM=${HF_LLM:-}          # HF safetensors dir (Qwen2-7B-Instruct)
+HF_VISION=${HF_VISION:-}    # HF safetensors dir (SigLIP-family tower)
+
+python -m oryx_tpu.train.cli \
+  --config scripts/configs/oryx_7b_sft.json \
+  --data "$DATA" \
+  --tokenizer-path "$TOKENIZER" \
+  ${HF_LLM:+--hf-llm "$HF_LLM"} \
+  ${HF_VISION:+--hf-vision "$HF_VISION"} \
+  --sharding fsdp \
+  --metrics-path logs/oryx7b_metrics.jsonl \
+  --output-dir models/oryx7b-sft \
+  "$@"

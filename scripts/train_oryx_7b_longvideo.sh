@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Long-video SFT: 256-frame records, ring attention over sp=4
+# (sequence/context parallelism; ops/ring_attention.py). The reference has
+# no SP — it relies on 16x compression alone (SURVEY.md §5 "Long-context");
+# this config adds the TPU-idiomatic headroom path for low-compression runs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:?path to conversation-records json}
+TOKENIZER=${TOKENIZER:?path to Qwen2 tokenizer dir}
+
+python -m oryx_tpu.train.cli \
+  --config scripts/configs/oryx_7b_longvideo.json \
+  --data "$DATA" \
+  --tokenizer-path "$TOKENIZER" \
+  --video-frames 256 \
+  --sharding fsdp \
+  --metrics-path logs/oryx7b_video_metrics.jsonl \
+  --output-dir models/oryx7b-longvideo \
+  "$@"

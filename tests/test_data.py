@@ -99,6 +99,20 @@ def test_collate_microbatches_independent_buffers():
     assert out["visual_idx"].max() < q
 
 
+def test_collate_text_only_batch():
+    """Text-only records (no media) collate to an all-padding visual
+    buffer; the token stream and labels are intact."""
+    ids = np.array([65, 66, 67, 68], np.int64)
+    labels = np.full(ids.shape, IGNORE_INDEX, np.int64)
+    labels[-2:] = ids[-2:]
+    exs = [data_lib.Example(ids, labels, [], "image") for _ in range(2)]
+    out = data_lib.collate(exs, buckets=(16, 64, 256), base_grid=8)
+    assert not out["is_visual"].any()
+    assert out["segment_ids"].shape == (16,)
+    assert np.all(out["segment_ids"] == 0)
+    np.testing.assert_array_equal(out["token_ids"][0, :4], ids)
+
+
 def test_collate_microbatches_indivisible_raises():
     exs = [_mk_example(i) for i in range(3)]
     with pytest.raises(ValueError):

@@ -1,0 +1,144 @@
+"""Native loader tests: C++ fused preprocess parity vs the numpy reference
+path, batch thread-pool writes into the packed buffer, and the
+pack_raw_images native/fallback equivalence (SURVEY.md §2a: the reference's
+native data-loader floor; native/loader.cpp is our equivalent)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.data import mm_utils, native_loader
+from oryx_tpu.ops import packing
+
+pytestmark = pytest.mark.skipif(
+    not native_loader.is_available(),
+    reason="native loader not built (g++ unavailable?)",
+)
+
+
+def _numpy_reference(img, patch, max_patches):
+    pre = mm_utils.preprocess_image(img, patch, max_patches)
+    return packing.patchify(pre, patch)
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "float32"])
+@pytest.mark.parametrize("hw", [(28, 28), (37, 51), (100, 40)])
+def test_preprocess_parity_vs_numpy(dtype, hw):
+    rng = np.random.default_rng(0)
+    if dtype == "uint8":
+        img = rng.integers(0, 255, size=(*hw, 3), dtype=np.uint8)
+    else:
+        img = rng.standard_normal((*hw, 3)).astype(np.float32)
+    patch = 14
+    ref, (h, w) = _numpy_reference(img, patch, 4096)
+    oh, ow = mm_utils.resize_to_patch_grid(hw, patch, 4096)
+    got = native_loader.preprocess_image(
+        img, (oh, ow), patch, mm_utils.IMAGE_MEAN, mm_utils.IMAGE_STD
+    )
+    assert got.shape == ref.shape == (h * w, patch * patch * 3)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_preprocess_with_downscale_cap():
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 255, size=(300, 200, 3), dtype=np.uint8)
+    patch, cap = 14, 64
+    ref, grid = _numpy_reference(img, patch, cap)
+    oh, ow = mm_utils.resize_to_patch_grid((300, 200), patch, cap)
+    got = native_loader.preprocess_image(
+        img, (oh, ow), patch, mm_utils.IMAGE_MEAN, mm_utils.IMAGE_STD
+    )
+    assert grid[0] * grid[1] <= cap
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_preprocess_into_shared_buffer():
+    rng = np.random.default_rng(2)
+    patch = 14
+    imgs = [
+        rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+        for h, w in [(28, 28), (42, 28), (28, 56)]
+    ]
+    hws = [mm_utils.resize_to_patch_grid(i.shape[:2], patch, 4096)
+           for i in imgs]
+    rows = [(oh // patch) * (ow // patch) for oh, ow in hws]
+    buf = np.zeros((sum(rows) + 5, patch * patch * 3), np.float32)
+    offs = np.cumsum([0] + rows[:-1]).tolist()
+    outs = [buf[o : o + r] for o, r in zip(offs, rows)]
+    native_loader.batch_preprocess(
+        imgs, hws, patch, mm_utils.IMAGE_MEAN, mm_utils.IMAGE_STD,
+        outs=outs, num_threads=3,
+    )
+    for img, o, r in zip(imgs, offs, rows):
+        ref, _ = _numpy_reference(img, patch, 4096)
+        np.testing.assert_allclose(buf[o : o + r], ref, rtol=1e-4, atol=1e-4)
+    assert np.all(buf[sum(rows):] == 0)  # no overrun
+
+
+def test_pack_raw_images_matches_fallback(monkeypatch):
+    rng = np.random.default_rng(3)
+    imgs = [
+        rng.integers(0, 255, size=(60, 45, 3), dtype=np.uint8),
+        rng.integers(0, 255, size=(28, 90, 3), dtype=np.uint8),
+    ]
+    kw = dict(patch_size=14, base_grid=8, side_factors=[1, 2],
+              max_patches=[16, 16], buckets=(64, 256))
+    native = packing.pack_raw_images(imgs, **kw)
+    monkeypatch.setattr(native_loader, "is_available", lambda: False)
+    fallback = packing.pack_raw_images(imgs, **kw)
+    np.testing.assert_allclose(
+        native.patches, fallback.patches, rtol=1e-4, atol=1e-4
+    )
+    for field in ("segment_ids", "region_ids", "pos_coords",
+                  "q_segment_ids", "q_region_ids"):
+        np.testing.assert_array_equal(
+            getattr(native, field), getattr(fallback, field)
+        )
+    assert native.grids == fallback.grids
+
+
+def test_prefetch_iterator_order_and_errors():
+    from oryx_tpu.train.data import PrefetchIterator
+
+    assert list(PrefetchIterator(iter(range(7)), depth=2)) == list(range(7))
+
+    def boom():
+        yield 1
+        raise RuntimeError("decode failed")
+
+    it = PrefetchIterator(boom(), depth=1)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_prefetch_close_stops_infinite_producer():
+    import itertools
+
+    from oryx_tpu.train.data import PrefetchIterator
+
+    it = PrefetchIterator(itertools.count(), depth=1)
+    assert next(it) == 0
+    it.close()
+    assert not it._thread.is_alive()
+
+
+def test_pack_raw_images_mixed_channels_raises():
+    rng = np.random.default_rng(4)
+    imgs = [
+        rng.integers(0, 255, size=(28, 28, 3), dtype=np.uint8),
+        rng.integers(0, 255, size=(28, 28, 4), dtype=np.uint8),
+    ]
+    with pytest.raises(ValueError, match="channels"):
+        packing.pack_raw_images(
+            imgs, patch_size=14, base_grid=8, buckets=(64, 256)
+        )
+
+
+def test_pack_raw_images_text_only_batch():
+    packed = packing.pack_raw_images(
+        [], patch_size=14, base_grid=8, buckets=(64, 256)
+    )
+    assert packed.num_patches == 0 and packed.num_queries == 0
+    assert packed.patches.shape == (64, 14 * 14 * 3)
+    assert np.all(packed.segment_ids == 0)
+    assert packed.grids == []
