@@ -69,6 +69,8 @@ def _bench_cfg(backend: str):
         vision=vision,
         compressor=cfg_lib.CompressorConfig(num_heads=comp_heads),
         dtype="bfloat16",
+        # Pallas flash attention on the real chip; portable XLA path on CPU.
+        attn_impl="pallas" if backend == "tpu" else "xla",
     )
     return cfg, batch_size, seq_bucket, img_patches_side
 

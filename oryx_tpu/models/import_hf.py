@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Callable, Iterable, Mapping
 
 import jax.numpy as jnp
@@ -209,3 +210,186 @@ def import_siglip(
         },
     }
     return params
+
+
+def export_siglip(params: Params, cfg: VisionConfig) -> dict[str, np.ndarray]:
+    """OryxViT pytree → HF SiglipVisionModel-layout state dict (fp32,
+    `vision_model.`-prefixed) — inverse of import_siglip."""
+    out: dict[str, np.ndarray] = {}
+    f32 = lambda x: np.asarray(jnp.asarray(x, jnp.float32))
+    p = "vision_model."
+    # [ph*pw*C, H] → Conv2d [H, C, ph, pw] (inverse of the import flatten).
+    kern = f32(params["patch_embed"]["kernel"])
+    ph = pw = cfg.patch_size
+    C = cfg.num_channels
+    out[p + "embeddings.patch_embedding.weight"] = np.ascontiguousarray(
+        kern.reshape(ph, pw, C, -1).transpose(3, 2, 0, 1)
+    )
+    out[p + "embeddings.patch_embedding.bias"] = f32(
+        params["patch_embed"]["bias"]
+    )
+    out[p + "embeddings.position_embedding.weight"] = f32(
+        params["pos_embed"]["weight"]
+    )
+    out[p + "post_layernorm.weight"] = f32(params["post_norm"]["weight"])
+    out[p + "post_layernorm.bias"] = f32(params["post_norm"]["bias"])
+    lp = params["layers"]
+    names = {
+        "layer_norm1": ("norm1", _I), "layer_norm2": ("norm2", _I),
+        "self_attn.q_proj": ("q_proj", _T), "self_attn.k_proj": ("k_proj", _T),
+        "self_attn.v_proj": ("v_proj", _T),
+        "self_attn.out_proj": ("o_proj", _T),
+        "mlp.fc1": ("fc1", _T), "mlp.fc2": ("fc2", _T),
+    }
+    for hf_name, (key, post_kernel) in names.items():
+        mod = lp[key]
+        for leaf, arr in mod.items():
+            post = post_kernel if leaf == "kernel" else _I
+            suffix = "weight" if leaf in ("kernel", "weight") else "bias"
+            stacked = f32(arr)
+            for i in range(cfg.num_layers):
+                out[f"{p}encoder.layers.{i}.{hf_name}.{suffix}"] = post(
+                    stacked[i]
+                )
+    return out
+
+
+def llm_hf_config(cfg: LLMConfig) -> dict[str, Any]:
+    """HF Qwen2-style config.json dict for an exported checkpoint."""
+    return {
+        "architectures": ["Qwen2ForCausalLM"],
+        "model_type": "qwen2",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "hidden_act": "silu",
+        "torch_dtype": "float32",
+    }
+
+
+def save_hf_checkpoint(params: Params, llm_cfg: LLMConfig,
+                       vision_cfg: VisionConfig, directory: str) -> None:
+    """Write a reference-layout checkpoint directory: LLM safetensors +
+    config.json (HF Qwen2/Llama names), vision-tower safetensors (SigLIP
+    names), and the compressor as a projector npz (the reference's
+    `mm_projector.bin` analog) — the exporter half of SURVEY.md §5
+    "Checkpoint / resume". Tokenizer files are NOT written (they belong to
+    the source checkpoint; copy them alongside for HF `from_pretrained`).
+    """
+    from safetensors.numpy import save_file
+
+    from oryx_tpu.utils import checkpoint as ckpt_lib
+
+    os.makedirs(directory, exist_ok=True)
+    save_file(
+        export_qwen2(params["llm"], llm_cfg),
+        os.path.join(directory, "model.safetensors"),
+    )
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(llm_hf_config(llm_cfg), f, indent=2)
+    save_file(
+        export_siglip(params["vit"], vision_cfg),
+        os.path.join(directory, "vision_tower.safetensors"),
+    )
+    ckpt_lib.save_projector_only(
+        os.path.join(directory, "mm_projector"), params
+    )
+
+
+# ---------------------------------------------------------------------------
+# LoRA adapter merge (PEFT layout)
+# ---------------------------------------------------------------------------
+
+# PEFT target-module name → our stacked-layer param key.
+_LORA_TARGETS = {
+    "q_proj": "q_proj", "k_proj": "k_proj", "v_proj": "v_proj",
+    "o_proj": "o_proj", "gate_proj": "gate_proj", "up_proj": "up_proj",
+    "down_proj": "down_proj",
+}
+
+
+def merge_lora(
+    params: Params,
+    adapter_sd: StateDict,
+    cfg: LLMConfig,
+    *,
+    scaling: float,
+) -> Params:
+    """Merge a PEFT LoRA adapter into full LLM weights: W += s·(B@A).
+
+    The reference's builder merges `model_base` + LoRA checkpoints into one
+    model (`load_pretrained_model(model_path, model_base, ...)`; SURVEY.md
+    §2 "Model builder" LoRA-base merge path). Adapter keys look like
+    `base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight`
+    (A: [r, in], B: [out, r], torch layout). Our kernels are [in, out], so
+    the delta is A.T @ B.T. Returns a new params tree (llm subtree copied).
+    """
+    # Group adapter keys by (proj, layer).
+    pat = re.compile(
+        r"layers\.(\d+)\.(?:self_attn|mlp)\.(\w+)\.lora_(A|B)\.weight$"
+    )
+    found: dict[tuple[str, int], dict[str, np.ndarray]] = {}
+    unhandled: list[str] = []
+    for key in adapter_sd:
+        m = pat.search(key)
+        if not m:
+            # Refuse rather than silently skip: modules_to_save full-weight
+            # replacements, embedding/lm_head LoRA, DoRA magnitudes etc.
+            # would otherwise merge to a model that quietly differs from
+            # the reference merged model.
+            unhandled.append(key)
+            continue
+        layer, proj, ab = int(m.group(1)), m.group(2), m.group(3)
+        if proj not in _LORA_TARGETS:
+            raise ValueError(f"unsupported LoRA target {proj!r} in {key}")
+        found.setdefault((proj, layer), {})[ab] = _get(adapter_sd, key)
+    if unhandled:
+        raise ValueError(
+            "unsupported adapter weights (only decoder-proj lora_A/B "
+            f"supported): {sorted(unhandled)[:5]}"
+            f"{'...' if len(unhandled) > 5 else ''}"
+        )
+    if not found:
+        raise ValueError("no LoRA weights found in adapter state dict")
+
+    layers = dict(params["layers"])
+    by_proj: dict[str, list[int]] = {}
+    for proj, layer in found:
+        by_proj.setdefault(proj, []).append(layer)
+    for proj, idxs in by_proj.items():
+        key = _LORA_TARGETS[proj]
+        # np.array (copy): device-array views are read-only.
+        kernel = np.array(jnp.asarray(layers[key]["kernel"], jnp.float32))
+        for i in idxs:
+            pair = found[(proj, i)]
+            if set(pair) != {"A", "B"}:
+                raise ValueError(f"layer {i} {proj}: incomplete LoRA pair")
+            delta = (pair["A"].astype(np.float32).T
+                     @ pair["B"].astype(np.float32).T) * scaling
+            kernel[i] = kernel[i] + delta
+        dtype = jnp.asarray(layers[key]["kernel"]).dtype
+        layers[key] = {**layers[key], "kernel": jnp.asarray(kernel, dtype)}
+    return {**params, "layers": layers}
+
+
+def merge_lora_dir(params: Params, adapter_dir: str, cfg: LLMConfig) -> Params:
+    """Merge a PEFT adapter directory (adapter_config.json +
+    adapter_model.safetensors) into full LLM weights."""
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    r = int(acfg["r"])
+    alpha = float(acfg.get("lora_alpha", r))
+    # rsLoRA scales by alpha/sqrt(r) instead of alpha/r.
+    scaling = alpha / (r**0.5 if acfg.get("use_rslora") else r)
+    sd_path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    return merge_lora(params, load_file(sd_path), cfg, scaling=scaling)

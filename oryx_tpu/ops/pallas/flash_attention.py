@@ -15,9 +15,12 @@ Design:
     absolute positions, segment-id equality, explicit kv validity — all
     folded into one predicate per tile. With arange kv positions (the
     prefill and KV-cache layouts), causally-dead kv tiles are skipped.
-  * Backward: custom VJP that recomputes attention with the XLA reference
-    path — O(T²) memory in backward but numerically identical; a Pallas
-    backward kernel is a later optimization.
+  * Backward: Pallas flash backward (custom VJP). The forward saves the
+    per-row logsumexp; `_dq_kernel` accumulates dq over kv tiles and
+    `_dkv_kernel` accumulates dk/dv over (group-head, q-tile) steps with
+    the GQA reduction in VMEM scratch — O(T) memory, no O(T²) recompute.
+    Per-row lse/Δ scalars ride in an 8-sublane layout and are broadcast
+    against logit tiles via a rank-1 MXU outer product (no relayouts).
 
 Interpret mode runs the same kernel on CPU for tests.
 """
@@ -30,8 +33,6 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-
-from oryx_tpu.ops import attention as xla_attention
 
 NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -46,7 +47,7 @@ BLOCK_K = 512
 def _kernel(
     qpos_ref, kpos_ref, qseg_ref, kseg_ref, kvalid_ref,
     q_ref, k_ref, v_ref,
-    o_ref,
+    o_ref, lse_ref,  # lse_ref is None when with_lse=False (inference)
     m_scr, l_scr, acc_scr,
     *,
     scale: float,
@@ -113,6 +114,15 @@ def _kernel(
         l = l_scr[:, :1]
         out = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = out.astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp for the backward pass. Fully-masked rows (l == 0,
+            # e.g. padding) get +inf so exp(s - lse) underflows to 0 there.
+            lse = jnp.where(
+                l == 0.0,
+                jnp.float32(jnp.finfo(jnp.float32).max),
+                m_scr[:, :1] + jnp.log(jnp.where(l == 0.0, 1.0, l)),
+            )
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _round_up(n: int, m: int) -> int:
@@ -131,7 +141,7 @@ def _pad_axis(x, axis: int, target: int, fill=0):
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "has_segments", "kv_arange", "scale",
-                     "interpret"),
+                     "interpret", "with_lse"),
 )
 def _mha_forward(
     q, k, v, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
@@ -141,9 +151,13 @@ def _mha_forward(
     kv_arange: bool,
     scale: float,
     interpret: bool,
+    with_lse: bool = False,
 ):
     """Core pallas call. Layouts: q [B, Hq, Tq, D]; k/v [B, Hk, Tk, D];
-    int arrays [B, T*] (already padded to block multiples)."""
+    int arrays [B, T*] (already padded to block multiples). with_lse emits
+    the logsumexp residual for the backward pass (skipped at inference —
+    its lane-broadcast output buffer is the price of the grad path only).
+    """
     B, Hq, Tq, D = q.shape
     _, Hk, Tk, _ = k.shape
     G = Hq // Hk
@@ -162,11 +176,26 @@ def _mha_forward(
     kv_valid = jnp.broadcast_to(kv_valid[:, None, :], (B, SUB, Tk))
 
     grid = (B, Hq, nq, nk)
-    kern = functools.partial(
+    kern_full = functools.partial(
         _kernel, scale=scale, causal=causal, has_segments=has_segments,
         kv_arange=kv_arange, block_k=block_k,
     )
-    out = pl.pallas_call(
+    if with_lse:
+        kern = kern_full
+    else:
+        def kern(qp, kp, qs, ks, kvd, q_, k_, v_, o_, m_, l_, a_):
+            kern_full(qp, kp, qs, ks, kvd, q_, k_, v_, o_, None, m_, l_, a_)
+
+    o_spec = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+    )
+    o_shape = jax.ShapeDtypeStruct((B, Hq, Tq, D), q.dtype)
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_q, LANES), lambda b, h, iq, ik: (b, h, iq, 0)
+    )
+    lse_shape = jax.ShapeDtypeStruct((B, Hq, Tq, LANES), jnp.float32)
+
+    res = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -185,10 +214,8 @@ def _mha_forward(
                 (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, Tq, D), q.dtype),
+        out_specs=[o_spec, lse_spec] if with_lse else [o_spec],
+        out_shape=[o_shape, lse_shape] if with_lse else [o_shape],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -196,7 +223,306 @@ def _mha_forward(
         ],
         interpret=interpret,
     )(q_pos, kv_pos, q_seg, kv_seg, kv_valid, q, k, v)
-    return out
+    if with_lse:
+        return res[0], res[1][..., 0]
+    return res[0], None
+
+
+def _row_outer(row, n: int):
+    """[1, bq] per-q-row scalars → [bq, n] tile with the scalar repeated
+    along lanes: rank-1 outer product rowᵀ·1 on the MXU. Avoids a
+    sublane↔lane relayout of the scalar vector."""
+    ones = jnp.ones((1, n), jnp.float32)
+    return jax.lax.dot_general(
+        row, ones, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dq_kernel(
+    qpos_ref, kpos_ref, qseg_ref, kseg_ref, kvalid_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    scale: float,
+    causal: bool,
+    has_segments: bool,
+    kv_arange: bool,
+    block_k: int,
+):
+    """dq = (p ∘ (do·vᵀ − Δ)) · k · scale, accumulated over kv tiles.
+    Same grid/masking layout as the forward kernel."""
+    ik, nk = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    if causal and kv_arange:
+        run = ik * block_k <= jnp.max(qpos_ref[0])
+    else:
+        run = True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        bk = k.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+        mask = kvalid_ref[0, :1, :] > 0
+        if causal:
+            mask = jnp.logical_and(
+                mask, qpos_ref[0, :, :1] >= kpos_ref[0, :1, :]
+            )
+        if has_segments:
+            mask = jnp.logical_and(
+                mask, qseg_ref[0, :, :1] == kseg_ref[0, :1, :]
+            )
+        s = jnp.where(mask, s, NEG)
+        lse_mat = _row_outer(lse_ref[0, 0, :1, :], bk)  # [bq, bk]
+        p = jnp.exp(s - lse_mat)  # [bq, bk] fp32
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - _row_outer(delta_ref[0, 0, :1, :], bk)) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    qpos_ref, kpos_ref, qseg_ref, kseg_ref, kvalid_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *,
+    scale: float,
+    causal: bool,
+    has_segments: bool,
+    kv_arange: bool,
+    block_q: int,
+    block_k: int,
+):
+    """dk/dv for one kv tile, accumulated over all (group-head, q-tile)
+    steps. Grid (B, Hk, nk, G, nq): the two innermost dims revisit the same
+    kv/output blocks, so GQA head-group reduction happens in VMEM scratch.
+    """
+    g, iq = pl.program_id(3), pl.program_id(4)
+    nG, nq = pl.num_programs(3), pl.num_programs(4)
+
+    @pl.when(jnp.logical_and(g == 0, iq == 0))
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    ik = pl.program_id(2)
+    if causal and kv_arange:
+        # q tiles whose max position is before this kv tile contribute
+        # nothing (qpos is arange in this mode too).
+        run = ik * block_k <= jnp.max(qpos_ref[0])
+    else:
+        run = True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]    # [bq, D]
+        k = k_ref[0, 0]    # [bk, D]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]  # [bq, D]
+        bk = k.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+
+        mask = kvalid_ref[0, :1, :] > 0
+        if causal:
+            mask = jnp.logical_and(
+                mask, qpos_ref[0, :, :1] >= kpos_ref[0, :1, :]
+            )
+        if has_segments:
+            mask = jnp.logical_and(
+                mask, qseg_ref[0, :, :1] == kseg_ref[0, :1, :]
+            )
+        s = jnp.where(mask, s, NEG)
+        p = jnp.exp(s - _row_outer(lse_ref[0, 0, :1, :], bk))  # [bq, bk]
+
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - _row_outer(delta_ref[0, 0, :1, :], bk)) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+
+    @pl.when(jnp.logical_and(g == nG - 1, iq == nq - 1))
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "has_segments", "kv_arange", "scale",
+                     "interpret"),
+)
+def _mha_backward(
+    q, k, v, do, lse, delta, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
+    *,
+    causal: bool,
+    has_segments: bool,
+    kv_arange: bool,
+    scale: float,
+    interpret: bool,
+):
+    """Layouts as _mha_forward, plus do [B, Hq, Tq, D] and lse/delta
+    [B, Hq, Tq] (all padded to block multiples)."""
+    B, Hq, Tq, D = q.shape
+    _, Hk, Tk, _ = k.shape
+    G = Hq // Hk
+    block_q = min(BLOCK_Q, Tq)
+    block_k = min(BLOCK_K, Tk)
+    nq = Tq // block_q
+    nk = Tk // block_k
+
+    LANES, SUB = 128, 8
+    q_pos_l = jnp.broadcast_to(q_pos[:, :, None], (B, Tq, LANES))
+    q_seg_l = jnp.broadcast_to(q_seg[:, :, None], (B, Tq, LANES))
+    kv_pos_s = jnp.broadcast_to(kv_pos[:, None, :], (B, SUB, Tk))
+    kv_seg_s = jnp.broadcast_to(kv_seg[:, None, :], (B, SUB, Tk))
+    kv_valid_s = jnp.broadcast_to(kv_valid[:, None, :], (B, SUB, Tk))
+    # Per-q-row scalars in the compact 8-sublane layout ([B, Hq, 8, Tq],
+    # 16x smaller than lane-broadcast); kernels re-expand per tile with a
+    # rank-1 outer product (_row_outer).
+    lse_s = jnp.broadcast_to(lse[:, :, None, :], (B, Hq, SUB, Tq))
+    delta_s = jnp.broadcast_to(delta[:, :, None, :], (B, Hq, SUB, Tq))
+
+    common = dict(
+        scale=scale, causal=causal, has_segments=has_segments,
+        kv_arange=kv_arange,
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, **common),
+        grid=(B, Hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, SUB, block_q), lambda b, h, iq, ik: (b, h, 0, iq)
+            ),
+            pl.BlockSpec(
+                (1, 1, SUB, block_q), lambda b, h, iq, ik: (b, h, 0, iq)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Tq, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q_pos_l, kv_pos_s, q_seg_l, kv_seg_s, kv_valid_s,
+      q, k, v, do, lse_s, delta_s)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, block_k=block_k, **common
+        ),
+        grid=(B, Hk, nk, G, nq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, LANES), lambda b, hk, ik, g, iq: (b, iq, 0)
+            ),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, hk, ik, g, iq: (b, 0, ik)
+            ),
+            pl.BlockSpec(
+                (1, block_q, LANES), lambda b, hk, ik, g, iq: (b, iq, 0)
+            ),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, hk, ik, g, iq: (b, 0, ik)
+            ),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, hk, ik, g, iq: (b, 0, ik)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, D),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, D),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, SUB, block_q),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, 0, iq),
+            ),
+            pl.BlockSpec(
+                (1, 1, SUB, block_q),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, 0, iq),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hk, Tk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hk, Tk, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_pos_l, kv_pos_s, q_seg_l, kv_seg_s, kv_valid_s,
+      q, k, v, do, lse_s, delta_s)
+    return dq, dk, dv
 
 
 def _use_interpret() -> bool:
@@ -234,13 +560,13 @@ def _flash_vjp(
     return _flash_attention_impl(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
         kv_mask, causal, scale,
-    )
+    )[0]
 
 
-def _flash_attention_impl(
-    q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-    kv_mask, causal, scale,
-):
+def _prepare(q, k, v, q_positions, kv_positions, q_segment_ids,
+             kv_segment_ids, kv_mask, causal, scale):
+    """Normalize/pad every operand to the kernel layouts. Returns the
+    padded tensors plus the static flags shared by forward and backward."""
     B, Tq, Hq, D = q.shape
     _, Tk, Hk, _ = k.shape
     if scale is None:
@@ -283,42 +609,65 @@ def _flash_attention_impl(
     q_seg = _pad_axis(q_seg, 1, Tq_p, fill=-1)
     kv_seg = _pad_axis(kv_seg, 1, Tk_p, fill=-2)
     kv_valid = _pad_axis(kv_valid, 1, Tk_p)
-
-    out = _mha_forward(
-        qt, kt, vt, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
+    flags = dict(
         causal=causal, has_segments=has_segments, kv_arange=kv_arange,
         scale=float(scale), interpret=_use_interpret(),
     )
-    return out[:, :, :Tq].swapaxes(1, 2)
+    return (qt, kt, vt, q_pos, kv_pos, q_seg, kv_seg, kv_valid), flags, Tq
+
+
+def _flash_attention_impl(
+    q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+    kv_mask, causal, scale, with_lse=False,
+):
+    padded, flags, Tq = _prepare(
+        q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+        kv_mask, causal, scale,
+    )
+    out, lse = _mha_forward(*padded, with_lse=with_lse, **flags)
+    return out[:, :, :Tq].swapaxes(1, 2), lse
 
 
 def _fwd(q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
          kv_mask, causal, scale):
-    out = _flash_attention_impl(
+    out, lse = _flash_attention_impl(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        kv_mask, causal, scale,
+        kv_mask, causal, scale, with_lse=True,
     )
-    res = (q, k, v, q_positions, kv_positions, q_segment_ids,
+    res = (q, k, v, out, lse, q_positions, kv_positions, q_segment_ids,
            kv_segment_ids, kv_mask)
     return out, res
 
 
 def _bwd(causal, scale, res, g):
-    """Backward via the XLA reference formula (recompute; O(T²) memory).
-    Numerically identical to differentiating ops.attention.attention."""
-    (q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-     kv_mask) = res
+    """Flash backward: Pallas dq and dk/dv kernels using the saved
+    logsumexp — O(T) memory (vs the O(T²) recompute fallback)."""
+    (q, k, v, out, lse, q_positions, kv_positions, q_segment_ids,
+     kv_segment_ids, kv_mask) = res
+    B, Tq, Hq, D = q.shape
 
-    def ref(q, k, v):
-        return xla_attention.attention(
-            q, k, v, causal=causal,
-            q_positions=q_positions, kv_positions=kv_positions,
-            q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-            kv_mask=kv_mask, scale=scale,
-        )
+    padded, flags, _ = _prepare(
+        q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+        kv_mask, causal, scale,
+    )
+    qt = padded[0]
+    Tq_p = qt.shape[2]
+    # Δ_i = Σ_d dOᵢ·Oᵢ in fp32, padded like q (zeros: padded do is zero).
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", g.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    delta = _pad_axis(delta, 2, Tq_p)
+    do = _pad_axis(g.swapaxes(1, 2), 2, Tq_p)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
+    dq, dk, dv = _mha_backward(
+        padded[0], padded[1], padded[2], do, lse, delta,
+        padded[3], padded[4], padded[5], padded[6], padded[7],
+        **flags,
+    )
+    Tk = k.shape[1]
+    dq = dq[:, :, :Tq].swapaxes(1, 2).astype(q.dtype)
+    dk = dk[:, :, :Tk].swapaxes(1, 2).astype(k.dtype)
+    dv = dv[:, :, :Tk].swapaxes(1, 2).astype(v.dtype)
     return (dq, dk, dv, None, None, None, None, None)
 
 

@@ -105,16 +105,22 @@ def load_from_hf(
     cfg: OryxConfig,
     *,
     projector_path: str | None = None,
+    lora_path: str | None = None,
     dtype=jnp.float32,
     seed: int = 0,
 ) -> tuple[Any, Params, OryxConfig]:
     """Assemble params from HF safetensors checkpoints (SURVEY.md §3.3
     `initialize_vision_modules`): Qwen2/Yi LLM + SigLIP-family tower, fresh
-    compressor (or merged from a projector-only npz)."""
+    compressor (or merged from a projector-only npz). lora_path merges a
+    PEFT adapter into the LLM (the reference builder's model_base+LoRA
+    path)."""
     llm_sd = import_hf.load_safetensors_dir(llm_path)
     vit_sd = import_hf.load_safetensors_dir(vision_path)
+    llm = import_hf.import_qwen2(llm_sd, cfg.llm, dtype)
+    if lora_path is not None:
+        llm = import_hf.merge_lora_dir(llm, lora_path, cfg.llm)
     params: Params = {
-        "llm": import_hf.import_qwen2(llm_sd, cfg.llm, dtype),
+        "llm": llm,
         "vit": import_hf.import_siglip(vit_sd, cfg.vision, dtype),
         "compressor": oryx.init_params(cfg, jax.random.key(seed), dtype)[
             "compressor"
@@ -124,3 +130,9 @@ def load_from_hf(
         params = ckpt_lib.load_projector_only(projector_path, params)
     tokenizer = load_tokenizer(llm_path)
     return tokenizer, params, cfg
+
+
+def export_hf(directory: str, cfg: OryxConfig, params: Params) -> None:
+    """Write a reference-layout checkpoint (LLM + vision safetensors +
+    projector npz) for interop with reference-stack users."""
+    import_hf.save_hf_checkpoint(params, cfg.llm, cfg.vision, directory)

@@ -1,0 +1,172 @@
+"""HF-interop tests: SigLIP export round-trip, full reference-layout
+checkpoint save, and PEFT LoRA adapter merge (SURVEY.md §2 "Model builder"
+LoRA-base merge path, §5 checkpoint exporter)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import import_hf, oryx
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _tree_allclose(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
+def test_export_import_siglip_round_trip(tiny):
+    cfg, params = tiny
+    sd = import_hf.export_siglip(params["vit"], cfg.vision)
+    assert "vision_model.embeddings.patch_embedding.weight" in sd
+    conv = sd["vision_model.embeddings.patch_embedding.weight"]
+    assert conv.shape == (
+        cfg.vision.hidden_size, 3, cfg.vision.patch_size, cfg.vision.patch_size
+    )
+    back = import_hf.import_siglip(sd, cfg.vision)
+    _tree_allclose(params["vit"], back)
+
+
+def test_save_hf_checkpoint_loads_back(tmp_path, tiny):
+    cfg, params = tiny
+    d = str(tmp_path / "hf")
+    import_hf.save_hf_checkpoint(params, cfg.llm, cfg.vision, d)
+    assert os.path.exists(os.path.join(d, "model.safetensors"))
+    llm_sd = import_hf.load_safetensors_dir(d)
+    # One dir holds both; the importers pick their keys by prefix.
+    back_llm = import_hf.import_qwen2(llm_sd, cfg.llm)
+    _tree_allclose(params["llm"], back_llm)
+    back_vit = import_hf.import_siglip(llm_sd, cfg.vision)
+    _tree_allclose(params["vit"], back_vit)
+
+
+def test_merge_lora(tiny):
+    cfg, params = tiny
+    L = cfg.llm.num_layers
+    rng = np.random.default_rng(0)
+    r, alpha = 4, 8.0
+    hidden = cfg.llm.hidden_size
+    qdim = cfg.llm.num_heads * cfg.llm.head_dim
+    sd = {}
+    As, Bs = [], []
+    for i in range(L):
+        A = rng.standard_normal((r, hidden)).astype(np.float32) * 0.1
+        B = rng.standard_normal((qdim, r)).astype(np.float32) * 0.1
+        As.append(A)
+        Bs.append(B)
+        pre = f"base_model.model.model.layers.{i}.self_attn.q_proj"
+        sd[f"{pre}.lora_A.weight"] = A
+        sd[f"{pre}.lora_B.weight"] = B
+    merged = import_hf.merge_lora(
+        params["llm"], sd, cfg.llm, scaling=alpha / r
+    )
+    for i in range(L):
+        want = (
+            np.asarray(params["llm"]["layers"]["q_proj"]["kernel"][i])
+            + (As[i].T @ Bs[i].T) * (alpha / r)
+        )
+        np.testing.assert_allclose(
+            np.asarray(merged["layers"]["q_proj"]["kernel"][i]), want,
+            rtol=1e-5, atol=1e-5,
+        )
+    # Untouched projections stay identical.
+    np.testing.assert_array_equal(
+        np.asarray(merged["layers"]["k_proj"]["kernel"]),
+        np.asarray(params["llm"]["layers"]["k_proj"]["kernel"]),
+    )
+
+
+def test_merge_lora_dir(tmp_path, tiny):
+    from safetensors.numpy import save_file
+
+    cfg, params = tiny
+    rng = np.random.default_rng(1)
+    hidden = cfg.llm.hidden_size
+    sd = {}
+    for i in range(cfg.llm.num_layers):
+        pre = f"base_model.model.model.layers.{i}.mlp.gate_proj"
+        sd[f"{pre}.lora_A.weight"] = (
+            rng.standard_normal((2, hidden)).astype(np.float32)
+        )
+        sd[f"{pre}.lora_B.weight"] = (
+            rng.standard_normal(
+                (cfg.llm.intermediate_size, 2)
+            ).astype(np.float32)
+        )
+    d = tmp_path / "adapter"
+    d.mkdir()
+    save_file(sd, str(d / "adapter_model.safetensors"))
+    (d / "adapter_config.json").write_text(
+        json.dumps({"r": 2, "lora_alpha": 4})
+    )
+    merged = import_hf.merge_lora_dir(params["llm"], str(d), cfg.llm)
+    assert not np.allclose(
+        np.asarray(merged["layers"]["gate_proj"]["kernel"]),
+        np.asarray(params["llm"]["layers"]["gate_proj"]["kernel"]),
+    )
+
+
+def test_merge_lora_rslora_scaling(tmp_path, tiny):
+    """use_rslora scales by alpha/sqrt(r), not alpha/r."""
+    from safetensors.numpy import save_file
+
+    cfg, params = tiny
+    rng = np.random.default_rng(2)
+    r = 4
+    sd = {}
+    for i in range(cfg.llm.num_layers):
+        pre = f"base_model.model.model.layers.{i}.self_attn.o_proj"
+        sd[f"{pre}.lora_A.weight"] = rng.standard_normal(
+            (r, cfg.llm.num_heads * cfg.llm.head_dim)
+        ).astype(np.float32)
+        sd[f"{pre}.lora_B.weight"] = rng.standard_normal(
+            (cfg.llm.hidden_size, r)
+        ).astype(np.float32)
+    d = tmp_path / "ad"
+    d.mkdir()
+    save_file(sd, str(d / "adapter_model.safetensors"))
+    (d / "adapter_config.json").write_text(
+        json.dumps({"r": r, "lora_alpha": 8, "use_rslora": True})
+    )
+    merged = import_hf.merge_lora_dir(params["llm"], str(d), cfg.llm)
+    want = import_hf.merge_lora(
+        params["llm"], sd, cfg.llm, scaling=8 / r**0.5
+    )
+    np.testing.assert_allclose(
+        np.asarray(merged["layers"]["o_proj"]["kernel"]),
+        np.asarray(want["layers"]["o_proj"]["kernel"]),
+    )
+
+
+def test_merge_lora_rejects_modules_to_save(tiny):
+    cfg, params = tiny
+    sd = {
+        "base_model.model.lm_head.modules_to_save.weight":
+            np.zeros((4, 4), np.float32),
+    }
+    with pytest.raises(ValueError, match="unsupported adapter weights"):
+        import_hf.merge_lora(params["llm"], sd, cfg.llm, scaling=1.0)
+
+
+def test_merge_lora_rejects_incomplete(tiny):
+    cfg, params = tiny
+    sd = {
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight":
+            np.zeros((2, cfg.llm.hidden_size), np.float32),
+    }
+    with pytest.raises(ValueError, match="incomplete"):
+        import_hf.merge_lora(params["llm"], sd, cfg.llm, scaling=1.0)
