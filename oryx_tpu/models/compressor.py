@@ -75,6 +75,7 @@ def forward(
     region_ids: jnp.ndarray,
     q_region_ids: jnp.ndarray,
     *,
+    attn_impl: str = "xla",
     eps: float = 1e-6,
 ) -> jnp.ndarray:
     """Compress packed ViT features into packed LLM-space visual embeddings.
@@ -113,11 +114,18 @@ def forward(
     q = _linear(nq, params["q_proj"]).reshape(1, Q, nh, hd)
     k = _linear(nkv, params["k_proj"]).reshape(1, P, nh, hd)
     v = _linear(nkv, params["v_proj"]).reshape(1, P, nh, hd)
-    o = attention(
-        q, k, v,
-        q_segment_ids=q_region_ids[None],
-        kv_segment_ids=region_ids[None],
-    ).reshape(Q, Hv)
+    if attn_impl == "pallas":
+        from oryx_tpu.ops.pallas import segment_attention as _sa
+
+        o = _sa.segment_attention(
+            q, k, v, q_region_ids[None], region_ids[None]
+        ).reshape(Q, Hv)
+    else:
+        o = attention(
+            q, k, v,
+            q_segment_ids=q_region_ids[None],
+            kv_segment_ids=region_ids[None],
+        ).reshape(Q, Hv)
     x = pooled + _linear(o, params["o_proj"])
 
     # MLP projector into LLM embedding space (mlp2x_gelu-equivalent).

@@ -255,10 +255,27 @@ def export_siglip(params: Params, cfg: VisionConfig) -> dict[str, np.ndarray]:
 
 
 def llm_hf_config(cfg: LLMConfig) -> dict[str, Any]:
-    """HF Qwen2-style config.json dict for an exported checkpoint."""
+    """HF config.json dict for an exported checkpoint.
+
+    Qwen2 geometry (qkv biases) exports as Qwen2ForCausalLM; bias-free
+    (Yi/Llama-class) geometry as LlamaForCausalLM with attention_bias
+    false — HF's Qwen2 arch always expects qkv biases, so declaring it for
+    a bias-free model would make from_pretrained fabricate random biases.
+    """
+    if cfg.attention_bias:
+        arch: dict[str, Any] = {
+            "architectures": ["Qwen2ForCausalLM"],
+            "model_type": "qwen2",
+        }
+    else:
+        arch = {
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "attention_bias": False,
+            "mlp_bias": False,
+        }
     return {
-        "architectures": ["Qwen2ForCausalLM"],
-        "model_type": "qwen2",
+        **arch,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -323,6 +340,8 @@ def merge_lora(
     *,
     scaling: float,
 ) -> Params:
+    # cfg validates adapter layer indices against the stacked param depth
+    # (an out-of-range index would otherwise be an opaque numpy error).
     """Merge a PEFT LoRA adapter into full LLM weights: W += s·(B@A).
 
     The reference's builder merges `model_base` + LoRA checkpoints into one
@@ -350,6 +369,11 @@ def merge_lora(
         layer, proj, ab = int(m.group(1)), m.group(2), m.group(3)
         if proj not in _LORA_TARGETS:
             raise ValueError(f"unsupported LoRA target {proj!r} in {key}")
+        if not 0 <= layer < cfg.num_layers:
+            raise ValueError(
+                f"adapter layer {layer} out of range for a "
+                f"{cfg.num_layers}-layer model ({key})"
+            )
         found.setdefault((proj, layer), {})[ab] = _get(adapter_sd, key)
     if unhandled:
         raise ValueError(

@@ -65,6 +65,7 @@ def encode_visual(
     return compressor_lib.forward(
         params["compressor"], cfg.compressor, cfg.vision,
         feats, region_ids, q_region_ids,
+        attn_impl="pallas" if cfg.attn_impl == "pallas" else "xla",
     )
 
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from oryx_tpu.config import VisionConfig
 from oryx_tpu.ops.attention import attention
 from oryx_tpu.ops.norms import layer_norm
+from oryx_tpu.parallel.sharding import constrain
 
 Params = dict[str, Any]
 
@@ -130,8 +131,11 @@ def forward(
     else:
         emb = emb.astype(patches.dtype)
 
-    # Batch dim of 1: the packed buffer IS the batch (SPMD shards it later).
-    h = emb[None]  # [1, P, H]
+    # Batch dim of 1: the packed buffer IS the batch; the packing axis
+    # shards over the data width (Trainer._device_batch) — pin it so GSPMD
+    # doesn't guess intermediates.
+    pk_spec = (None, ("dp", "fsdp"), None)
+    h = constrain(emb[None], *pk_spec)  # [1, P, H]
     seg = segment_ids[None]  # [1, P]
 
     if attn_impl == "pallas":
@@ -164,7 +168,7 @@ def forward(
         )
         x = jax.nn.gelu(_linear(x, lp["fc1"]), approximate=True)
         h = h + _linear(x, lp["fc2"])
-        return h, None
+        return constrain(h, *pk_spec), None
 
     if remat:
         body = jax.checkpoint(body, prevent_cse=False)

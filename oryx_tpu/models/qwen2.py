@@ -29,6 +29,7 @@ from oryx_tpu.config import LLMConfig
 from oryx_tpu.ops.attention import attention
 from oryx_tpu.ops.norms import rms_norm
 from oryx_tpu.ops.rope import apply_rope, rope_cos_sin
+from oryx_tpu.parallel.sharding import constrain
 
 Params = dict[str, Any]
 
@@ -200,7 +201,11 @@ def forward(
         inputs_embeds = params["embed"]["weight"][input_ids]
     if compute_dtype is not None:
         inputs_embeds = inputs_embeds.astype(compute_dtype)
-    h = inputs_embeds
+    # Pin the hidden-state sharding so GSPMD doesn't guess intermediates:
+    # batch over the data axes, sequence over sp only in ring mode.
+    seq_axis = "sp" if attn_impl == "ring" else None
+    hs_spec = (("dp", "fsdp"), seq_axis, None)
+    h = constrain(inputs_embeds, *hs_spec)
     B, T, _ = h.shape
 
     if positions is None:
@@ -228,7 +233,8 @@ def forward(
 
         def attn_fn(q, k, v, *, q_positions, kv_positions, kv_mask):
             return ring_attention(
-                q, k, v, mesh=mesh, axis_name=sp_axis, causal=True,
+                q, k, v, mesh=mesh, axis_name=sp_axis,
+                batch_axes=("dp", "fsdp"), causal=True,
                 positions=q_positions, kv_mask=kv_mask,
             )
     else:
@@ -248,6 +254,7 @@ def forward(
             kv_mask=kv_mask,
             attn_fn=attn_fn,
         )
+        h = constrain(h, *hs_spec)
         return h, (ck, cv) if kv_cache is not None else None
 
     if remat:

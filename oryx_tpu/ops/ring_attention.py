@@ -67,8 +67,7 @@ def ring_attention_shard(
     m = jnp.full((B, Hk, G, Tl, 1), NEG, jnp.float32)
     l = jnp.zeros((B, Hk, G, Tl, 1), jnp.float32)
 
-    def body(_, carry):
-        acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur = carry
+    def merge(acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur):
         s = _chunk_logits(
             qg, k_cur, q_pos, kpos_cur, kvalid_cur, causal=causal,
             scale=scale,
@@ -81,13 +80,28 @@ def ring_attention_shard(
             "bhgqk,bkhd->bhgqd", p.astype(v_cur.dtype), v_cur,
             preferred_element_type=jnp.float32,
         )
-        acc = acc * alpha + pv
+        return acc * alpha + pv, m_new, l
+
+    def body(_, carry):
+        acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur = carry
+        if causal:
+            # Skip blocks that are entirely in this shard's causal future
+            # (every kv position > every local q position): with causal
+            # sharding, about half the ring steps merge nothing — cond
+            # saves the logits+softmax compute (the ppermute still runs).
+            live = jnp.min(kpos_cur) <= jnp.max(q_pos)
+            acc, m, l = jax.lax.cond(
+                live, merge, lambda a, mm, ll, *_: (a, mm, ll),
+                acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur,
+            )
+        else:
+            acc, m, l = merge(acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur)
         # Rotate the K/V block (and its metadata) one step around the ring.
         k_cur, v_cur, kpos_cur, kvalid_cur = jax.tree.map(
             lambda x: jax.lax.ppermute(x, axis_name, perm),
             (k_cur, v_cur, kpos_cur, kvalid_cur),
         )
-        return acc, m_new, l, k_cur, v_cur, kpos_cur, kvalid_cur
+        return acc, m, l, k_cur, v_cur, kpos_cur, kvalid_cur
 
     acc, m, l, *_ = jax.lax.fori_loop(
         0, n, body, (acc, m, l, k, v, kv_pos, kv_valid)
@@ -102,6 +116,7 @@ def ring_attention(
     *,
     mesh: Mesh | None = None,
     axis_name: str = "sp",
+    batch_axes: tuple[str, ...] = (),
     causal: bool = False,
     positions=None,
     kv_mask=None,
@@ -110,6 +125,12 @@ def ring_attention(
     """Global-array entry: shards the sequence over `axis_name` and runs the
     ring. q/k/v: [B, T, H*, D] with T divisible by the axis size.
     mesh=None uses the ambient mesh (jax.sharding.use_mesh / jit context).
+
+    batch_axes: mesh axes the batch dim is sharded over (e.g.
+    ("dp", "fsdp") in the trainer) — carried through the shard_map so the
+    surrounding layers' batch sharding survives instead of forcing an
+    all-gather/re-scatter at the shard_map boundary. Axes not present on
+    the mesh are dropped.
     """
     B, T, _, _ = q.shape
     if positions is None:
@@ -120,8 +141,11 @@ def ring_attention(
         if kv_mask is not None
         else jnp.ones((B, T), jnp.int32)
     )
-    seq = P(None, axis_name, None, None)
-    tok = P(None, axis_name)
+    resolved = mesh or jax.sharding.get_abstract_mesh()
+    names = getattr(resolved, "axis_names", ()) or ()
+    batch = tuple(a for a in batch_axes if a in names) or None
+    seq = P(batch, axis_name, None, None)
+    tok = P(batch, axis_name)
     fn = shard_map(
         partial(
             ring_attention_shard, axis_name=axis_name, causal=causal,

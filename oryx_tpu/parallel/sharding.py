@@ -130,6 +130,32 @@ def batch_spec() -> P:
     return P(("dp", "fsdp"))
 
 
+def constrain(x, *axes):
+    """`with_sharding_constraint` iff a named mesh is ambient, else no-op.
+
+    Model code annotates its main activations with this so GSPMD stops
+    guessing intermediate shardings (guessing shows up as "[SPMD]
+    Involuntary full rematerialization" resharding warnings). Single-device
+    jit (bench, tests without a mesh) passes through untouched. Axis names
+    absent from the ambient mesh are dropped (e.g. calling with "sp" on a
+    dp/fsdp-only mesh).
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or not mesh.axis_names:
+        return x
+
+    def keep(a):
+        if a is None:
+            return None
+        if isinstance(a, tuple):
+            kept = tuple(x_ for x_ in a if x_ in mesh.axis_names)
+            return kept or None
+        return a if a in mesh.axis_names else None
+
+    spec = P(*(keep(a) for a in axes))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
 def opt_state_specs(opt_state, params: Params, mode: str = "fsdp"):
     """Shardings for optax state: leaves with a param-shaped counterpart
     inherit that param's spec; scalars/steps replicate.

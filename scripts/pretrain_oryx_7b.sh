@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Stage-1 projector pretraining: only the Dynamic Compressor / projector
+# trains (tune="projector_only"), LLM + vision tower frozen, plain
+# template — the reference's `tune_mm_mlp_adapter` stage producing
+# `mm_projector.bin` (SURVEY.md §2 "Training entry", §3.3). The resulting
+# projector npz feeds --projector in the SFT stage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:?path to caption-records json}
+TOKENIZER=${TOKENIZER:?path to Qwen2 tokenizer dir}
+HF_LLM=${HF_LLM:?HF safetensors dir (Qwen2-7B-Instruct)}
+HF_VISION=${HF_VISION:?HF safetensors dir (SigLIP-family tower)}
+
+python -m oryx_tpu.train.cli \
+  --config scripts/configs/oryx_7b_pretrain.json \
+  --data "$DATA" \
+  --tokenizer-path "$TOKENIZER" \
+  --hf-llm "$HF_LLM" \
+  --hf-vision "$HF_VISION" \
+  --template plain \
+  --sharding fsdp \
+  --metrics-path logs/oryx7b_pretrain_metrics.jsonl \
+  --output-dir models/oryx7b-pretrain \
+  "$@"

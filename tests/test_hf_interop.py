@@ -170,3 +170,27 @@ def test_merge_lora_rejects_incomplete(tiny):
     }
     with pytest.raises(ValueError, match="incomplete"):
         import_hf.merge_lora(params["llm"], sd, cfg.llm, scaling=1.0)
+
+
+def test_merge_lora_rejects_out_of_range_layer(tiny):
+    cfg, params = tiny
+    i = cfg.llm.num_layers  # one past the end
+    sd = {
+        f"base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight":
+            np.zeros((2, cfg.llm.hidden_size), np.float32),
+        f"base_model.model.model.layers.{i}.self_attn.q_proj.lora_B.weight":
+            np.zeros((cfg.llm.num_heads * cfg.llm.head_dim, 2), np.float32),
+    }
+    with pytest.raises(ValueError, match="out of range"):
+        import_hf.merge_lora(params["llm"], sd, cfg.llm, scaling=1.0)
+
+
+def test_llm_hf_config_arch_matches_bias():
+    qwen = cfg_lib.tiny_llm()  # attention_bias=True default
+    assert import_hf.llm_hf_config(qwen)["model_type"] == "qwen2"
+    yi = cfg_lib.LLMConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, num_kv_heads=1, head_dim=16, attention_bias=False,
+    )
+    c = import_hf.llm_hf_config(yi)
+    assert c["model_type"] == "llama" and c["attention_bias"] is False

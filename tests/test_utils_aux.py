@@ -48,7 +48,7 @@ def test_metric_logger_writes_jsonl(tmp_path):
 
 
 @pytest.mark.parametrize("name", [
-    "oryx_7b_sft", "oryx_34b_sft", "oryx_7b_longvideo",
+    "oryx_7b_sft", "oryx_34b_sft", "oryx_7b_longvideo", "oryx_7b_pretrain",
 ])
 def test_launch_configs_load(name):
     from oryx_tpu.config import OryxConfig
