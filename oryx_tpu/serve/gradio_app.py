@@ -1,0 +1,70 @@
+"""Gradio web demo (optional dependency).
+
+Reference parity: the reference family ships a CLI + Gradio demo
+(SURVEY.md §2 "Inference example / demo"). Gradio is not a core
+dependency; this module gates on its presence and the CLI
+(serve/cli.py) remains the first-class path.
+
+    python -m oryx_tpu.serve.gradio_app --model-path models/oryx7b-sft
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_app(pipe, *, num_frames: int = 64):
+    """Build the Gradio Blocks app around an OryxInference pipeline."""
+    try:
+        import gradio as gr
+    except ImportError as e:
+        raise RuntimeError(
+            "gradio is not installed; use the CLI (oryx_tpu.serve.cli) "
+            "or `pip install gradio` in your serving environment"
+        ) from e
+
+    import numpy as np
+
+    def answer(image, video, question):
+        if not question:
+            return "Please enter a question."
+        if video is not None:
+            from oryx_tpu.data import media
+
+            frames = media.load_video_frames(video, num_frames)
+            return pipe.chat_video(frames, question)
+        images = [np.asarray(image)] if image is not None else None
+        return pipe.chat(question, images=images)
+
+    with gr.Blocks(title="Oryx-TPU") as app:
+        gr.Markdown("# Oryx-TPU — image / video QA")
+        with gr.Row():
+            image = gr.Image(label="Image", type="numpy")
+            video = gr.Video(label="Video (or frames dir)")
+        question = gr.Textbox(label="Question")
+        out = gr.Textbox(label="Answer")
+        gr.Button("Ask").click(answer, [image, video, question], out)
+    return app
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="Oryx-TPU Gradio demo")
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--tokenizer-path", default=None)
+    ap.add_argument("--num-frames", type=int, default=64)
+    ap.add_argument("--port", type=int, default=7860)
+    args = ap.parse_args(argv)
+
+    from oryx_tpu.serve.builder import load_pretrained_model
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    tokenizer, params, cfg = load_pretrained_model(
+        args.model_path, tokenizer_path=args.tokenizer_path
+    )
+    pipe = OryxInference(tokenizer, params, cfg)
+    app = build_app(pipe, num_frames=args.num_frames)
+    app.launch(server_port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,17 +73,21 @@ def microbatch_loss(
     return causal_lm_loss(logits, mb["labels"])
 
 
-@partial(jax.jit, static_argnames=("cfg", "tx"), donate_argnames=("state",))
-def train_step(
+def train_step_fn(
     state: TrainState,
     batch: dict[str, jnp.ndarray],
     cfg: OryxConfig,
     tx: optax.GradientTransformation,
 ) -> tuple[TrainState, dict[str, jnp.ndarray]]:
-    """One optimizer step over `accum` microbatches.
+    """One optimizer step over `accum` microbatches (unjitted body).
 
     batch: each leaf has leading [accum, ...] microbatch axis (accum == 1
     for plain steps); visual buffers are packed per-microbatch.
+
+    Callers with explicit state shardings (Trainer) jit this with
+    out_shardings pinned to the input state's shardings — otherwise GSPMD
+    may re-shard updated params to the optimizer-state sharding (e.g.
+    ZeRO-2's replicated params silently become fsdp-sharded after step 1).
     """
     grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
 
@@ -118,3 +122,8 @@ def train_step(
         TrainState(step=state.step + 1, params=params, opt_state=opt_state),
         metrics,
     )
+
+
+train_step = partial(
+    jax.jit, static_argnames=("cfg", "tx"), donate_argnames=("state",)
+)(train_step_fn)

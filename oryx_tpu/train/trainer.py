@@ -59,6 +59,27 @@ class Trainer:
                 step=jnp.zeros((), jnp.int32), params=params,
                 opt_state=opt_state,
             )
+            # Jit the step with state out_shardings pinned, so updated
+            # params keep THIS mode's placement (zero2 keeps params
+            # replicated instead of inheriting the optimizer's fsdp spec).
+            oshard = jax.tree.map(
+                lambda s: jax.sharding.NamedSharding(self.mesh, s),
+                ospecs,
+                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+            )
+            state_shardings = step_lib.TrainState(
+                step=jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()
+                ),
+                params=pspecs,
+                opt_state=oshard,
+            )
+            self._step = jax.jit(
+                step_lib.train_step_fn,
+                static_argnames=("cfg", "tx"),
+                donate_argnames=("state",),
+                out_shardings=(state_shardings, None),
+            )
 
     def resume_if_available(self) -> int:
         """Restore latest checkpoint if present; returns start step."""

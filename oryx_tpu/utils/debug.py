@@ -1,0 +1,53 @@
+"""Debug / sanitizer posture: NaN checks and numeric assertions.
+
+Reference parity: the reference has no sanitizers (Python-level; trusts
+NCCL/CUDA — SURVEY.md §5 "Race detection / sanitizers"). XLA programs are
+data-race-free by construction, so the TPU equivalent is numeric
+debugging: `jax_debug_nans` to fault on the first non-finite value,
+`jax_disable_jit` to step through op-by-op, and chex assertions used by
+the test suite.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Fault (with a host traceback) on the first NaN/Inf produced inside
+    any jitted computation. Costs a device sync per op — debug runs only."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+@contextlib.contextmanager
+def debug_mode(*, nan_checks: bool = True, disable_jit: bool = False
+               ) -> Iterator[None]:
+    """Scoped debug posture: NaN faulting and optional op-by-op eager
+    execution (jit disabled) for bisecting a bad op."""
+    prev_nans = jax.config.jax_debug_nans
+    prev_jit = jax.config.jax_disable_jit
+    jax.config.update("jax_debug_nans", nan_checks)
+    jax.config.update("jax_disable_jit", disable_jit)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_disable_jit", prev_jit)
+
+
+def assert_finite_tree(tree, name: str = "tree") -> None:
+    """Host-side check that every leaf of a pytree is finite (grads/params
+    after a suspect step). Raises with the offending leaf paths."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(
+            jnp.all(jnp.isfinite(arr))
+        ):
+            bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(f"non-finite leaves in {name}: {bad}")

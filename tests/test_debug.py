@@ -1,0 +1,33 @@
+"""Debug/sanitizer utility tests (SURVEY.md §5 "Race detection /
+sanitizers" — the rebuild's numeric-debug posture)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.utils import debug
+
+
+def test_debug_mode_restores_flags():
+    before = jax.config.jax_debug_nans
+    with debug.debug_mode(nan_checks=True):
+        assert jax.config.jax_debug_nans
+    assert jax.config.jax_debug_nans == before
+
+
+def test_nan_check_faults_inside_jit():
+    with debug.debug_mode(nan_checks=True):
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+
+
+def test_assert_finite_tree():
+    ok = {"a": jnp.ones((2,)), "b": {"c": jnp.zeros((3,))}}
+    debug.assert_finite_tree(ok)
+    bad = {"a": jnp.ones((2,)), "b": {"c": jnp.asarray([1.0, np.nan])}}
+    with pytest.raises(FloatingPointError, match="b.*c"):
+        debug.assert_finite_tree(bad, "grads")
+    ints = {"ids": jnp.arange(3)}
+    debug.assert_finite_tree(ints)  # non-float leaves are skipped

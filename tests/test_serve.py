@@ -108,6 +108,21 @@ def test_chat_video_samples_frames(tiny_model):
     assert isinstance(out, str)
 
 
+def test_chat_video_256_frames(tiny_model):
+    """North-star scenario (BASELINE): 256-frame video inference runs
+    end-to-end — 16x compression packs all frames into one static buffer,
+    one contiguous visual span in the prompt, jitted prefill + decode."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    rng = np.random.default_rng(3)
+    frames = [
+        rng.integers(0, 255, size=(20, 20, 3), dtype=np.uint8)
+        for _ in range(256)
+    ]
+    out = pipe.chat_video(frames, "what happens?", max_new_tokens=3)
+    assert isinstance(out, str)
+
+
 def test_chat_text_only(tiny_model):
     cfg, params = tiny_model
     pipe = OryxInference(FakeTokenizer(), params, cfg)
