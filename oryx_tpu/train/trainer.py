@@ -155,8 +155,12 @@ class Trainer:
                         rank0_print("data exhausted; stopping")
                         break
                     batch = self._device_batch(host_batch)
-                    self.state, metrics = step_lib.train_step(
-                        self.state, batch, cfg, self.tx
+                    # Must use self._step (out_shardings pinned): the plain
+                    # step_lib.train_step jit lets GSPMD reshard zero2's
+                    # replicated params to the fsdp opt-state spec after
+                    # step 1 (see train_step_fn docstring).
+                    self.state, metrics = self._step(
+                        self.state, batch, cfg=cfg, tx=self.tx
                     )
                     self.logger.log_step(step_i + 1, jax.device_get(metrics))
                     if (step_i + 1) % cfg.train.checkpoint_every == 0:
