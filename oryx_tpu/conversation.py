@@ -46,7 +46,11 @@ class Conversation:
             out = self.system + seps[0] if self.system else ""
             for i, (role, msg) in enumerate(self.messages):
                 if msg is None:
-                    out += role + ":"
+                    # Trailing space matches the training-side prefix
+                    # tokenization (train/data._template_parts emits
+                    # "{role}: " unsupervised) — "ASSISTANT:" vs
+                    # "ASSISTANT: " tokenize differently.
+                    out += f"{role}: "
                 else:
                     out += f"{role}: {msg}{seps[i % 2]}"
             return out

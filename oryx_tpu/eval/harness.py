@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import re
 import string
 import time
@@ -126,12 +127,17 @@ def evaluate(
     process_index: int = 0,
     process_count: int = 1,
     log_every: int = 25,
+    batch_size: int = 8,
 ) -> EvalResult:
     """Run the inference stack over a record shard and score it.
 
     Dataset sharding mirrors the reference's accelerate-split eval
     (SURVEY.md §3.5): record i belongs to process i mod process_count; the
     caller merges per-process results (accuracy is weighted by num_total).
+    Records are batched `batch_size` at a time through `pipe.chat_batch`
+    (one ViT/compressor/decode program per batch). Host memory holds the
+    whole batch's raw frames at once (batch_size × num_frames ×
+    native-resolution); lower batch_size for high-res long-video tasks.
     """
     t0 = time.perf_counter()
     out: list[dict[str, Any]] = []
@@ -142,23 +148,28 @@ def evaluate(
         (i, r) for i, r in enumerate(records)
         if i % process_count == process_index
     ]
-    for n, (gi, rec) in enumerate(mine, 1):
-        frames, is_video = media.load_record_media(
-            rec, media_root=media_root, num_frames=num_frames
-        )
-        q = format_question(rec)
-        if is_video:
-            reply = pipe.chat_video(
-                frames, q, max_new_tokens=max_new_tokens
+    batch_size = max(1, batch_size)
+    for b0 in range(0, len(mine), batch_size):
+        group = mine[b0 : b0 + batch_size]
+        requests = []
+        for gi, rec in group:
+            frames, is_video = media.load_record_media(
+                rec, media_root=media_root, num_frames=num_frames
             )
-        else:
-            reply = pipe.chat(
-                q, images=frames or None, max_new_tokens=max_new_tokens
+            requests.append({
+                "question": format_question(rec),
+                "images": frames,
+                "is_video": is_video,
+            })
+        replies = pipe.chat_batch(requests, max_new_tokens=max_new_tokens)
+        for (gi, rec), reply in zip(group, replies):
+            ok = score_record(rec, reply)
+            correct += ok
+            out.append(
+                {"id": rec.get("id", gi), "reply": reply, "correct": ok}
             )
-        ok = score_record(rec, reply)
-        correct += ok
-        out.append({"id": rec.get("id", gi), "reply": reply, "correct": ok})
-        if log_every and n % log_every == 0:
+        n = len(out)
+        if log_every and (n % log_every < len(group) or n == len(mine)):
             print(f"[eval] {n}/{len(mine)} acc={correct / n:.4f}", flush=True)
     dt = time.perf_counter() - t0
     acc = correct / max(len(mine), 1)
@@ -170,10 +181,15 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--model-path", required=True)
     ap.add_argument("--tokenizer-path", default=None)
     ap.add_argument("--task", required=True, help="task .json/.jsonl file")
+    ap.add_argument(
+        "--format", default="native",
+        help="task record format: native|videomme|mlvu|mvbench",
+    )
     ap.add_argument("--media-root", default="")
     ap.add_argument("--num-frames", type=int, default=64)
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--output", default=None, help="results json path")
+    ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--process-index", type=int, default=0)
     ap.add_argument("--process-count", type=int, default=1)
     args = ap.parse_args(argv)
@@ -183,11 +199,14 @@ def main(argv: list[str] | None = None) -> None:
     tokenizer, params, cfg = load_pretrained_model(
         args.model_path, tokenizer_path=args.tokenizer_path
     )
+    from oryx_tpu.eval.adapters import adapt
+
     pipe = OryxInference(tokenizer, params, cfg)
+    records = adapt(args.format, load_task(args.task))
     result = evaluate(
-        pipe, load_task(args.task),
+        pipe, records,
         media_root=args.media_root, num_frames=args.num_frames,
-        max_new_tokens=args.max_new_tokens,
+        max_new_tokens=args.max_new_tokens, batch_size=args.batch_size,
         process_index=args.process_index, process_count=args.process_count,
     )
     print(json.dumps({
@@ -195,6 +214,8 @@ def main(argv: list[str] | None = None) -> None:
         "seconds": round(result.seconds, 1),
     }))
     if args.output:
+        outdir = os.path.dirname(os.path.abspath(args.output))
+        os.makedirs(outdir, exist_ok=True)
         with open(args.output, "w") as f:
             json.dump(result.to_dict(), f, indent=2)
 

@@ -14,6 +14,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from oryx_tpu.config import GenerationConfig, LLMConfig
 from oryx_tpu.models import qwen2
@@ -46,6 +47,32 @@ def sample_token(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def make_stop_sequences(
+    stop_strs: list[str], tokenizer
+) -> jnp.ndarray | None:
+    """Encode stop strings to a [S, L] int32 array, left-padded with -1.
+
+    Reference parity: `KeywordsStoppingCriteria` in `oryx/mm_utils.py`
+    (SURVEY.md §2 "MM utils") encodes each keyword once and compares the
+    trailing generated ids — here the comparison happens inside the jitted
+    decode scan so multi-token stops end rows without burning decode steps.
+    """
+    seqs = []
+    for s in stop_strs:
+        if not s:
+            continue
+        ids = tokenizer.encode(s, add_special_tokens=False)
+        if ids:
+            seqs.append(np.asarray(ids, np.int32))
+    if not seqs:
+        return None
+    L = max(len(s) for s in seqs)
+    out = np.full((len(seqs), L), -1, np.int32)
+    for i, s in enumerate(seqs):
+        out[i, L - len(s):] = s
+    return jnp.asarray(out)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -65,11 +92,14 @@ def generate(
     key: jax.Array | None = None,
     attn_impl: str = "xla",
     compute_dtype=None,
+    stop_sequences: jnp.ndarray | None = None,  # [S, L], left-pad -1
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (tokens [B, max_new_tokens] int32, num_generated [B] int32).
 
     Slots after EOS are filled with eos_token_id. cache_len must be a bucket
-    >= T + max_new_tokens.
+    >= T + max_new_tokens. A row also finishes when its trailing tokens
+    match any stop sequence (num_generated then includes the stop tokens;
+    the caller trims the decoded text).
     """
     B, T, _ = inputs_embeds.shape
     assert cache_len >= T + max_new_tokens, (cache_len, T, max_new_tokens)
@@ -101,8 +131,22 @@ def generate(
         top_k=gen_cfg.top_k,
     )
 
+    # Rolling last-L-token window per row for stop-sequence matching; -2
+    # init can match neither real ids nor the -1 stop padding.
+    stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
+    recent0 = jnp.full((B, stop_L), -2, jnp.int32)
+
+    def stop_hit(recent):
+        if stop_sequences is None:
+            return jnp.zeros((recent.shape[0],), bool)
+        # [B, S, L]: pad positions (-1) match anything.
+        m = (stop_sequences[None] == -1) | (
+            recent[:, None, :] == stop_sequences[None]
+        )
+        return jnp.any(jnp.all(m, axis=-1), axis=-1)
+
     def step(carry, step_key):
-        cache, tok, cur_len, finished = carry
+        cache, tok, cur_len, finished, recent = carry
         pos = cur_len[:, None]  # [B, 1] absolute position of tok
         kv_mask = (slot_ar <= cur_len[:, None]).astype(jnp.int32)
         logits, cache = qwen2.forward(
@@ -116,17 +160,22 @@ def generate(
             logits[:, 0], step_key, temperature=gen_cfg.temperature,
             top_p=gen_cfg.top_p, top_k=gen_cfg.top_k,
         )
-        finished = jnp.logical_or(finished, tok == gen_cfg.eos_token_id)
+        if stop_L:
+            recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
+        finished = (
+            finished | (tok == gen_cfg.eos_token_id) | stop_hit(recent)
+        )
         nxt = jnp.where(finished, gen_cfg.eos_token_id, nxt)
-        return (cache, nxt, cur_len + 1, finished), tok
+        return (cache, nxt, cur_len + 1, finished, recent), (tok, finished)
 
-    init = (cache, tok0, lengths, jnp.zeros((B,), bool))
+    init = (cache, tok0, lengths, jnp.zeros((B,), bool), recent0)
     step_keys = jax.random.split(key, max_new_tokens)
-    (_, _, _, finished), toks = jax.lax.scan(init=init, f=step, xs=step_keys)
+    _, (toks, fin) = jax.lax.scan(init=init, f=step, xs=step_keys)
     toks = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
-    # num generated = tokens up to and including first EOS.
-    is_eos = toks == gen_cfg.eos_token_id
-    first_eos = jnp.argmax(is_eos, axis=1)
-    any_eos = jnp.any(is_eos, axis=1)
-    num = jnp.where(any_eos, first_eos + 1, max_new_tokens)
+    fin = jnp.moveaxis(fin, 0, 1)  # fin[b, t]: row b ended at/before tok t
+    # num generated = tokens up to and including the finishing token (EOS
+    # or the last token of a stop sequence).
+    num = jnp.where(
+        jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
+    )
     return toks, num.astype(jnp.int32)

@@ -112,7 +112,8 @@ def forward(
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "cache_len"))
 def _jit_mm_generate(
-    params, cfg: OryxConfig, arrays, max_new_tokens: int, cache_len: int, key
+    params, cfg: OryxConfig, arrays, max_new_tokens: int, cache_len: int,
+    key, stop_sequences=None,
 ):
     vis = encode_visual(
         params, cfg,
@@ -129,6 +130,7 @@ def _jit_mm_generate(
         inputs_embeds=embeds, lengths=arrays["lengths"],
         max_new_tokens=max_new_tokens, cache_len=cache_len, key=key,
         attn_impl=cfg.attn_impl, compute_dtype=_dtype(cfg),
+        stop_sequences=stop_sequences,
     )
 
 
@@ -148,12 +150,13 @@ def mm_generate(
     *,
     max_new_tokens: int | None = None,
     key: jax.Array | None = None,
+    stop_sequences: jnp.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """End-to-end multimodal generation from host-side packed inputs.
 
     Returns (tokens [B, max_new_tokens], num_generated [B]) as numpy.
     The reference equivalent is `model.generate(input_ids, images=...)`
-    (SURVEY.md §3.2).
+    (SURVEY.md §3.2). stop_sequences: see generate.make_stop_sequences.
     """
     if max_new_tokens is None:
         max_new_tokens = cfg.generation.max_new_tokens
@@ -173,6 +176,6 @@ def mm_generate(
         "lengths": jnp.asarray(batch.lengths),
     }
     toks, num = _jit_mm_generate(
-        params, cfg, arrays, max_new_tokens, cache_len, key
+        params, cfg, arrays, max_new_tokens, cache_len, key, stop_sequences
     )
     return np.asarray(toks), np.asarray(num)

@@ -198,7 +198,13 @@ def forward(
     """
     assert (input_ids is None) != (inputs_embeds is None)
     if inputs_embeds is None:
-        inputs_embeds = params["embed"]["weight"][input_ids]
+        # All-gather the (fsdp-sharded) table before the lookup so the
+        # gather output doesn't inherit the table layout and force an
+        # involuntary full rematerialization to hs_spec (see
+        # splice.embed_spliced).
+        inputs_embeds = constrain(
+            params["embed"]["weight"], None, None
+        )[input_ids]
     if compute_dtype is not None:
         inputs_embeds = inputs_embeds.astype(compute_dtype)
     # Pin the hidden-state sharding so GSPMD doesn't guess intermediates:

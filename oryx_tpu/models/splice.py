@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from oryx_tpu.constants import IGNORE_INDEX, IMAGE_TOKEN_INDEX
 from oryx_tpu.ops.packing import DEFAULT_BUCKETS, PackedVisual, round_up_bucket
+from oryx_tpu.parallel.sharding import constrain
 
 
 def query_slots(packed: PackedVisual) -> list[tuple[int, int]]:
@@ -147,7 +148,15 @@ def embed_spliced(
     """Device-side: build [B, T, H] inputs_embeds with one select-gather.
 
     embed_table: [V, H]; visual_buffer: [Q, H] (compressor output).
+
+    The gathers read from replicated tables: without the constraints GSPMD
+    lets the gather output inherit the fsdp/tp-sharded table layout and
+    then full-rematerializes it to the batch-sharded activation spec
+    ("[SPMD] Involuntary full rematerialization"). All-gathering the
+    tables first (standard FSDP use-site gather) makes the downstream
+    reshard a local slice.
     """
-    text = embed_table[token_ids]
-    vis = visual_buffer[visual_idx].astype(text.dtype)
-    return jnp.where(is_visual[..., None], vis, text)
+    text = constrain(embed_table, None, None)[token_ids]
+    vis = constrain(visual_buffer, None, None)[visual_idx].astype(text.dtype)
+    out = jnp.where(is_visual[..., None], vis, text)
+    return constrain(out, ("dp", "fsdp"), None, None)

@@ -21,13 +21,81 @@ Masking model (all optional, combined by logical AND):
                     (still self-consistent; pad outputs are discarded).
   * kv_mask       — explicit boolean key validity [B, Tk] (KV-cache length
                     masking during decode, padding masks).
+
+Memory: the dense path materializes [B, Hq, Tq, Tk] fp32 logits. Above
+MAX_LOGITS_ELEMS (256 MB fp32) the wrapper switches to a sequential
+`lax.map` over query chunks so the largest packed-video buckets (e.g.
+P=65536, which would need ~16 GB per head group dense) stay serviceable on
+this path; the Pallas kernel is the fast path for those shapes.
 """
 
 from __future__ import annotations
 
+import math
+
+import jax
 import jax.numpy as jnp
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+# Cap on materialized fp32 logits elements (B * Hq * Tq_chunk * Tk).
+MAX_LOGITS_ELEMS = 2**26  # 64M elems = 256 MB fp32
+
+
+def _attention_dense(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool,
+    q_positions: jnp.ndarray | None,
+    kv_positions: jnp.ndarray | None,
+    q_segment_ids: jnp.ndarray | None,
+    kv_segment_ids: jnp.ndarray | None,
+    kv_mask: jnp.ndarray | None,
+    scale: float,
+) -> jnp.ndarray:
+    B, Tq, Hq, D = q.shape
+    _, Tk, Hk, _ = k.shape
+    G = Hq // Hk
+
+    # [B, Tk, Hk, G, ...] grouped layout so k/v are never materialized
+    # repeated (XLA keeps the broadcast virtual on TPU).
+    qg = q.reshape(B, Tq, Hk, G, D)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale  # [B, Hk, G, Tq, Tk] fp32
+
+    mask = None  # [B, Tq, Tk] broadcastable
+
+    def _and(m, new):
+        return new if m is None else jnp.logical_and(m, new)
+
+    if causal:
+        mask = _and(
+            mask, q_positions[:, :, None] >= kv_positions[:, None, :]
+        )
+    if q_segment_ids is not None:
+        assert kv_segment_ids is not None
+        mask = _and(
+            mask, q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+        )
+    if kv_mask is not None:
+        mask = _and(mask, kv_mask[:, None, :].astype(bool))
+
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    # fp32 softmax; rows that are fully masked (e.g. cache slots past the
+    # current length for padded queries) produce uniform probs over masked
+    # slots — harmless because those outputs are themselves discarded.
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs.astype(v.dtype)
+
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Tq, Hq, D).astype(q.dtype)
 
 
 def attention(
@@ -52,48 +120,48 @@ def attention(
     B, Tq, Hq, D = q.shape
     _, Tk, Hk, _ = k.shape
     assert Hq % Hk == 0, f"GQA requires Hq % Hk == 0, got {Hq=} {Hk=}"
-    G = Hq // Hk
     if scale is None:
         scale = D**-0.5
-
-    # [B, Tk, Hk, G, ...] grouped layout so k/v are never materialized
-    # repeated (XLA keeps the broadcast virtual on TPU).
-    qg = q.reshape(B, Tq, Hk, G, D)
-    logits = jnp.einsum(
-        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
-    )
-    logits = logits * scale  # [B, Hk, G, Tq, Tk] fp32
-
-    mask = None  # [B, 1, 1, Tq, Tk] broadcastable
-
-    def _and(m, new):
-        return new if m is None else jnp.logical_and(m, new)
-
     if causal:
         if q_positions is None:
             q_positions = jnp.arange(Tq, dtype=jnp.int32)[None, :]
         if kv_positions is None:
             kv_positions = jnp.arange(Tk, dtype=jnp.int32)[None, :]
-        mask = _and(
-            mask, q_positions[:, :, None] >= kv_positions[:, None, :]
+
+    kwargs = dict(
+        causal=causal, kv_positions=kv_positions,
+        kv_segment_ids=kv_segment_ids, kv_mask=kv_mask, scale=scale,
+    )
+
+    # Pick the largest power-of-two query chunk that keeps the logits
+    # buffer under MAX_LOGITS_ELEMS and divides Tq (buckets are powers of
+    # two); chunk == Tq means one dense call.
+    chunk = max(1, MAX_LOGITS_ELEMS // max(1, B * Hq * Tk))
+    chunk = 2 ** int(math.floor(math.log2(chunk)))
+    while Tq % chunk:
+        chunk //= 2
+    if chunk >= Tq:
+        return _attention_dense(
+            q, k, v, q_positions=q_positions,
+            q_segment_ids=q_segment_ids, **kwargs,
         )
-    if q_segment_ids is not None:
-        assert kv_segment_ids is not None
-        mask = _and(
-            mask, q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+
+    nc = Tq // chunk
+
+    def split_q(x):  # [Bx, Tq, ...] → [nc, Bx, chunk, ...]
+        if x is None:
+            return None
+        xs = x.reshape(x.shape[0], nc, chunk, *x.shape[2:])
+        return jnp.moveaxis(xs, 1, 0)
+
+    def body(args):
+        qc, qp, qs = args
+        return _attention_dense(
+            qc, k, v, q_positions=qp, q_segment_ids=qs, **kwargs
         )
-    if kv_mask is not None:
-        mask = _and(mask, kv_mask[:, None, :].astype(bool))
 
-    if mask is not None:
-        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
-
-    # fp32 softmax; rows that are fully masked (e.g. cache slots past the
-    # current length for padded queries) produce uniform probs over masked
-    # slots — harmless because those outputs are themselves discarded.
-    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
-    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
-    probs = probs.astype(v.dtype)
-
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
-    return out.reshape(B, Tq, Hq, D).astype(q.dtype)
+    # Sequential over chunks: peak memory = one chunk's logits.
+    outs = jax.lax.map(
+        body, (split_q(q), split_q(q_positions), split_q(q_segment_ids))
+    )  # [nc, B, chunk, Hq, D]
+    return jnp.moveaxis(outs, 0, 1).reshape(B, Tq, Hq, D)

@@ -38,10 +38,17 @@ def save_pretrained(
     directory: str, cfg: OryxConfig, state_or_params: Any, *, step: int = 0
 ) -> None:
     """Write a self-contained model directory loadable by
-    `load_pretrained_model`: config json + orbax checkpoint."""
+    `load_pretrained_model`: config json + orbax checkpoint.
+
+    Multi-host: must be called on ALL processes — orbax coordinates the
+    sharded write (each host persists the shards it owns). Saving from a
+    single process would device_get remote shards and deadlock a pod
+    (SURVEY.md §5 "Checkpoint / resume").
+    """
     os.makedirs(directory, exist_ok=True)
-    with open(os.path.join(directory, CONFIG_NAME), "w") as f:
-        f.write(cfg.to_json())
+    if jax.process_index() == 0:
+        with open(os.path.join(directory, CONFIG_NAME), "w") as f:
+            f.write(cfg.to_json())
     mgr = ckpt_lib.CheckpointManager(os.path.join(directory, "ckpt"))
     mgr.save(step, state_or_params, force=True)
     mgr.wait()

@@ -47,7 +47,7 @@ def infer_modality(num_images: int, is_video: bool) -> str:
 )
 def _jit_text_generate(
     params, cfg: OryxConfig, token_ids, lengths, max_new_tokens: int,
-    cache_len: int, key
+    cache_len: int, key, stop_sequences=None,
 ):
     embeds = params["llm"]["embed"]["weight"][token_ids]
     return generate_lib.generate(
@@ -55,6 +55,7 @@ def _jit_text_generate(
         inputs_embeds=embeds, lengths=lengths,
         max_new_tokens=max_new_tokens, cache_len=cache_len, key=key,
         attn_impl=cfg.attn_impl, compute_dtype=oryx.compute_dtype(cfg),
+        stop_sequences=stop_sequences,
     )
 
 
@@ -79,6 +80,12 @@ class OryxInference:
         self.params = params
         self.cfg = cfg
         self.conv = conv_templates[template]
+        # In-loop stop matching (KeywordsStoppingCriteria parity): rows end
+        # as soon as the template's stop string is emitted instead of
+        # burning the rest of max_new_tokens.
+        self.stop_sequences = generate_lib.make_stop_sequences(
+            [self.conv.stop_str] if self.conv.stop_str else [], tokenizer
+        )
 
     # ---- host-side prompt/media prep ------------------------------------
 
@@ -90,24 +97,6 @@ class OryxInference:
         conv.append_message(conv.roles[0], prefix + question)
         conv.append_message(conv.roles[1], None)
         return conv.get_prompt()
-
-    def _prepare_media(
-        self, images: Sequence[np.ndarray], modality: str
-    ) -> packing.PackedVisual:
-        cfgv = self.cfg.vision
-        per_img_cap = (
-            max(1, cfgv.max_patches_per_image // max(len(images), 1))
-            if modality == MODALITY_VIDEO
-            else cfgv.max_patches_per_image
-        )
-        factor = int(COMPRESSOR_RATIO[modality] ** 0.5)
-        return packing.pack_raw_images(
-            list(images),
-            patch_size=cfgv.patch_size,
-            base_grid=cfgv.base_grid,
-            side_factors=[factor] * len(images),
-            max_patches=[per_img_cap] * len(images),
-        )
 
     # ---- entry points ----------------------------------------------------
 
@@ -121,33 +110,99 @@ class OryxInference:
         seed: int = 0,
     ) -> str:
         """Single-turn QA over optional images / video frames."""
-        images = list(images or [])
+        return self.chat_batch(
+            [{
+                "question": question,
+                "images": list(images or []),
+                "is_video": is_video,
+            }],
+            max_new_tokens=max_new_tokens,
+            seed=seed,
+        )[0]
+
+    def chat_batch(
+        self,
+        requests: Sequence[dict[str, Any]],
+        *,
+        max_new_tokens: int | None = None,
+        seed: int = 0,
+    ) -> list[str]:
+        """Batched single-turn QA: one ViT + compressor + decode scan for
+        the whole batch (the batching win the reference gets from varlen
+        flash-attn plus HF batched generate; SURVEY.md §3.5).
+
+        requests: dicts with "question" (str), optional "images"
+        (list of np arrays, pre-sampled for video), optional "is_video".
+        Mixed text-only / image / multi-image / video rows are fine.
+        """
         max_new = max_new_tokens or self.cfg.generation.max_new_tokens
         key = jax.random.key(seed)
-        if not images:
-            return self._chat_text(question, max_new, key)
-
-        modality = infer_modality(len(images), is_video)
-        packed = self._prepare_media(images, modality)
-        # Video uses ONE placeholder expanded to contiguous per-frame
-        # sentinels — matching the training-side expansion
-        # (train/data.collate) so no stray newline tokens sit between
-        # frame spans; images keep one placeholder each.
-        prompt = self.build_prompt(question, 1 if is_video else len(images))
-        ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
-        if is_video and len(images) > 1:
-            idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
-            ids = np.concatenate(
-                [ids[:idx],
-                 np.full(len(images), IMAGE_TOKEN_INDEX, ids.dtype),
-                 ids[idx + 1:]]
+        cfgv = self.cfg.vision
+        all_images: list[np.ndarray] = []
+        side_factors: list[int] = []
+        max_patches: list[int] = []
+        ids_rows: list[np.ndarray] = []
+        for req in requests:
+            images = list(req.get("images") or [])
+            is_video = bool(req.get("is_video")) and len(images) > 0
+            modality = infer_modality(len(images), is_video)
+            prompt = self.build_prompt(
+                req["question"],
+                (1 if is_video else len(images)) if images else 0,
             )
-        batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+            ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
+            if is_video and len(images) > 1:
+                idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
+                ids = np.concatenate(
+                    [ids[:idx],
+                     np.full(len(images), IMAGE_TOKEN_INDEX, ids.dtype),
+                     ids[idx + 1:]]
+                )
+            ids_rows.append(ids)
+            if images:
+                per_img_cap = (
+                    max(1, cfgv.max_patches_per_image // len(images))
+                    if modality == MODALITY_VIDEO
+                    else cfgv.max_patches_per_image
+                )
+                factor = int(COMPRESSOR_RATIO[modality] ** 0.5)
+                all_images.extend(images)
+                side_factors.extend([factor] * len(images))
+                max_patches.extend([per_img_cap] * len(images))
+
+        if not all_images:
+            return self._text_batch(ids_rows, max_new, key)
+
+        packed = packing.pack_raw_images(
+            all_images,
+            patch_size=cfgv.patch_size,
+            base_grid=cfgv.base_grid,
+            side_factors=side_factors,
+            max_patches=max_patches,
+        )
+        batch = splice.build_mm_batch(ids_rows, splice.query_slots(packed))
         toks, num = oryx.mm_generate(
             self.params, self.cfg, packed, batch,
             max_new_tokens=max_new, key=key,
+            stop_sequences=self.stop_sequences,
         )
-        return self._decode(toks[0], int(num[0]))
+        return [self._decode(toks[b], int(num[b])) for b in range(len(toks))]
+
+    def _text_batch(self, ids_rows, max_new: int, key) -> list[str]:
+        B = len(ids_rows)
+        T = packing.round_up_bucket(max(len(r) for r in ids_rows))
+        rows = np.zeros((B, T), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        for b, ids in enumerate(ids_rows):
+            rows[b, : len(ids)] = ids
+            lengths[b] = len(ids)
+        cache_len = packing.round_up_bucket(T + max_new)
+        toks, num = _jit_text_generate(
+            self.params, self.cfg, jnp.asarray(rows), jnp.asarray(lengths),
+            max_new, cache_len, key, self.stop_sequences,
+        )
+        toks, num = np.asarray(toks), np.asarray(num)
+        return [self._decode(toks[b], int(num[b])) for b in range(B)]
 
     def chat_video(
         self,
@@ -163,21 +218,6 @@ class OryxInference:
             idx = mm_utils.sample_frames(len(frames), num_frames)
             frames = [frames[i] for i in idx]
         return self.chat(question, images=frames, is_video=True, **kw)
-
-    def _chat_text(self, question: str, max_new: int, key) -> str:
-        prompt = self.build_prompt(question, 0)
-        ids = np.asarray(
-            self.tokenizer.encode(prompt, add_special_tokens=False), np.int32
-        )
-        T = packing.round_up_bucket(len(ids))
-        row = np.zeros((1, T), np.int32)
-        row[0, : len(ids)] = ids
-        cache_len = packing.round_up_bucket(T + max_new)
-        toks, num = _jit_text_generate(
-            self.params, self.cfg, jnp.asarray(row),
-            jnp.asarray([len(ids)], np.int32), max_new, cache_len, key,
-        )
-        return self._decode(np.asarray(toks)[0], int(np.asarray(num)[0]))
 
     def _decode(self, tokens: np.ndarray, num: int) -> str:
         ids = [int(t) for t in tokens[:num]]

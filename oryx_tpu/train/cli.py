@@ -134,9 +134,12 @@ def main(argv: list[str] | None = None) -> None:
     )
     state = trainer.fit(batches)
 
-    if args.output_dir and jax.process_index() == 0:
+    if args.output_dir:
         from oryx_tpu.serve import builder
 
+        # All processes participate: orbax coordinates the multi-host
+        # sharded write (a proc-0-only save would deadlock on remote
+        # shards).
         builder.save_pretrained(
             args.output_dir, cfg, state, step=int(jax.device_get(state.step))
         )

@@ -33,3 +33,27 @@ def test_plain():
     conv.append_message("", "<image>")
     conv.append_message("", "a photo of a cat")
     assert conv.get_prompt() == "<image>\na photo of a cat\n"
+
+
+def test_v1_generation_prompt_matches_training_prefix():
+    """The open assistant turn must tokenize identically to the training
+    prefix: train/data emits "ASSISTANT: " (trailing space), so
+    get_prompt's generation prompt must too."""
+    from oryx_tpu.conversation import conv_templates
+    from oryx_tpu.train.data import _conversation_parts
+
+    conv = conv_templates["v1"].copy()
+    conv.append_message(conv.roles[0], "hi")
+    conv.append_message(conv.roles[1], None)
+    prompt = conv.get_prompt()
+    assert prompt.endswith("ASSISTANT: ")
+
+    rec = {"conversations": [
+        {"from": "human", "value": "hi"},
+        {"from": "gpt", "value": "hello"},
+    ]}
+    parts = _conversation_parts(rec, conv_templates["v1"])
+    # Concatenating the unsupervised prefix parts reproduces the
+    # generation prompt exactly.
+    prefix = "".join(t for t, sup in parts if not sup)
+    assert prompt == prefix

@@ -126,3 +126,45 @@ def test_evaluate_end_to_end(tmp_path):
         process_index=1, process_count=2, log_every=0,
     )
     assert shard0.num_total + shard1.num_total == 2
+
+
+def test_adapters_videomme():
+    from oryx_tpu.eval import adapters
+
+    recs = [{
+        "question_id": "q1", "videoID": "vid001", "question": "What?",
+        "options": ["A. cat", "B. dog", "C. bird", "D. fish"],
+        "answer": "B", "duration": "short", "domain": "x",
+    }]
+    out = adapters.adapt("videomme", recs, video_root="/data/videos")
+    r = out[0]
+    assert r["video"] == "/data/videos/vid001.mp4"
+    assert r["options"] == ["cat", "dog", "bird", "fish"]
+    assert r["answer"] == "B"
+    assert r["meta"]["duration"] == "short"
+
+
+def test_adapters_mlvu_text_answer():
+    from oryx_tpu.eval import adapters
+
+    recs = [{
+        "question": "Pick.", "candidates": ["red", "green", "blue"],
+        "answer": "green", "video": "clips/v.mp4", "question_type": "topic",
+    }]
+    r = adapters.adapt("mlvu", recs)[0]
+    assert r["answer"] == "B"
+    assert r["options"] == ["red", "green", "blue"]
+    assert r["video"] == "clips/v.mp4"
+
+
+def test_adapters_mvbench_and_unknown():
+    from oryx_tpu.eval import adapters
+
+    recs = [{
+        "question": "?", "candidates": ["x", "y"], "answer": "x",
+        "video": "v.mp4",
+    }]
+    assert adapters.adapt("mvbench", recs)[0]["answer"] == "A"
+    assert adapters.adapt("native", recs) == recs
+    with pytest.raises(ValueError):
+        adapters.adapt("nope", recs)

@@ -136,3 +136,68 @@ def test_build_prompt_has_placeholders(tiny_model):
     p = pipe.build_prompt("q", 3)
     assert p.count("<image>") == 3
     assert p.rstrip().endswith("<|im_start|>assistant")
+
+
+def test_generate_stop_sequences(tiny_model):
+    """A stop sequence built from the unstopped output's own tokens ends
+    the row exactly at the stop (KeywordsStoppingCriteria parity), and
+    num_generated includes the stop tokens."""
+    import jax.numpy as jnp
+
+    from oryx_tpu.models import generate as generate_lib
+
+    cfg, params = tiny_model
+    B, T, H = 1, 8, cfg.llm.hidden_size
+    rng = np.random.default_rng(0)
+    embeds = jnp.asarray(rng.standard_normal((B, T, H)), jnp.float32)
+    lengths = jnp.asarray([T], jnp.int32)
+    kw = dict(
+        inputs_embeds=embeds, lengths=lengths, max_new_tokens=8,
+        cache_len=32, key=jax.random.key(1),
+    )
+    toks, num = generate_lib.generate(
+        params["llm"], cfg.llm, cfg.generation, **kw
+    )
+    toks, num = np.asarray(toks), np.asarray(num)
+    assert num[0] >= 4, "need a few tokens for the stop test"
+    # Stop on the exact 2-token sequence at positions 1..2.
+    stop = jnp.asarray(toks[0, 1:3][None], jnp.int32)
+    toks2, num2 = generate_lib.generate(
+        params["llm"], cfg.llm, cfg.generation, stop_sequences=stop, **kw
+    )
+    toks2, num2 = np.asarray(toks2), np.asarray(num2)
+    np.testing.assert_array_equal(toks2[0, :3], toks[0, :3])
+    assert num2[0] == 3  # tokens 0..2, ending at the stop sequence
+
+
+def test_chat_batch_matches_single(tiny_model):
+    """Batched chat == per-sample chat (greedy, fp32 CPU): same replies for
+    a mixed text / image / multi-image batch."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    rng = np.random.default_rng(7)
+    img1 = rng.integers(0, 255, size=(40, 56, 3), dtype=np.uint8)
+    img2 = rng.integers(0, 255, size=(28, 28, 3), dtype=np.uint8)
+    requests = [
+        {"question": "what is this?", "images": [img1]},
+        {"question": "hello there"},
+        {"question": "compare these", "images": [img1, img2]},
+    ]
+    batched = pipe.chat_batch(requests, max_new_tokens=4)
+    assert len(batched) == 3
+    singles = [
+        pipe.chat("what is this?", images=[img1], max_new_tokens=4),
+        pipe.chat("hello there", max_new_tokens=4),
+        pipe.chat("compare these", images=[img1, img2], max_new_tokens=4),
+    ]
+    assert batched == singles
+
+
+def test_chat_batch_all_text(tiny_model):
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    replies = pipe.chat_batch(
+        [{"question": "hi"}, {"question": "yo"}], max_new_tokens=3
+    )
+    assert len(replies) == 2
+    assert all(isinstance(r, str) for r in replies)
