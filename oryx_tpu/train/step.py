@@ -90,26 +90,36 @@ def train_step_fn(
     ZeRO-2's replicated params silently become fsdp-sharded after step 1).
     """
     grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
-
-    def one_micro(carry, mb):
-        grads_acc, loss_acc, ntok_acc = carry
-        (loss, metrics), grads = grad_fn(state.params, cfg, mb)
-        grads_acc = jax.tree.map(
-            lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
-        )
-        return (
-            grads_acc, loss_acc + loss, ntok_acc + metrics["num_tokens"]
-        ), metrics
-
     accum = jax.tree.leaves(batch)[0].shape[0]
-    zeros = jax.tree.map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-    )
-    (grads, loss_sum, ntok), _ = jax.lax.scan(
-        one_micro, (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        batch,
-    )
-    grads = jax.tree.map(lambda g: g / accum, grads)
+
+    if accum == 1:
+        # No accumulation: skip the scan and its fp32 zeros buffer (a full
+        # param-sized temp — ~17 GB/device for 34B on an 8-way mesh).
+        (loss_sum, metrics), grads = grad_fn(
+            state.params, cfg, jax.tree.map(lambda x: x[0], batch)
+        )
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        ntok = metrics["num_tokens"]
+    else:
+        def one_micro(carry, mb):
+            grads_acc, loss_acc, ntok_acc = carry
+            (loss, metrics), grads = grad_fn(state.params, cfg, mb)
+            grads_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+            )
+            return (
+                grads_acc, loss_acc + loss, ntok_acc + metrics["num_tokens"]
+            ), metrics
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (grads, loss_sum, ntok), _ = jax.lax.scan(
+            one_micro,
+            (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            batch,
+        )
+        grads = jax.tree.map(lambda g: g / accum, grads)
 
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
