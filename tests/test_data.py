@@ -183,3 +183,90 @@ def test_projector_checkpoint_roundtrip(tmp_path):
         np.asarray(merged["llm"]["embed"]["weight"]),
         np.asarray(p2["llm"]["embed"]["weight"]),
     )
+
+
+def _ords(s):
+    return [ord(c) for c in s]
+
+
+def test_golden_v1_ids_and_label_mask():
+    """Byte-exact golden for SeparatorStyle.TWO: every token id and every
+    label position pinned (SURVEY.md §4 'Golden-file')."""
+    from oryx_tpu.conversation import Conversation, SeparatorStyle
+
+    conv = Conversation(
+        system="S", roles=("USER", "ASSISTANT"), messages=[],
+        sep_style=SeparatorStyle.TWO, sep=" ", sep2="</s>", version="v1",
+    )
+    rec = {"conversations": [
+        {"from": "human", "value": "<image>\nQ?"},
+        {"from": "gpt", "value": "A!"},
+    ]}
+    ids, labels = data_lib.preprocess_conversation(rec, FakeTokenizer(), conv)
+    expected_ids = (
+        _ords("S ")                       # system + sep
+        + _ords("USER: ")                 # role prefix (trailing space!)
+        + [IMAGE_TOKEN_INDEX]             # <image> sentinel
+        + _ords("\nQ? ")                  # user text + sep
+        + _ords("ASSISTANT: ")            # open role prefix
+        + _ords("A!</s>")                 # supervised reply + sep2
+    )
+    assert list(ids) == expected_ids
+    n_sup = len("A!</s>")
+    expected_labels = [IGNORE_INDEX] * (len(expected_ids) - n_sup) + _ords(
+        "A!</s>"
+    )
+    assert list(labels) == expected_labels
+
+
+def test_golden_chatml_ids_and_label_mask():
+    from oryx_tpu.conversation import Conversation, SeparatorStyle
+
+    conv = Conversation(
+        system="S", roles=("user", "assistant"), messages=[],
+        sep_style=SeparatorStyle.CHATML, sep="<|im_end|>\n", version="qwen",
+    )
+    rec = {"conversations": [
+        {"from": "human", "value": "Q"},
+        {"from": "gpt", "value": "A"},
+    ]}
+    ids, labels = data_lib.preprocess_conversation(rec, FakeTokenizer(), conv)
+    expected_ids = (
+        _ords("<|im_start|>system\nS<|im_end|>\n")
+        + _ords("<|im_start|>user\n")
+        + _ords("Q<|im_end|>\n")
+        + _ords("<|im_start|>assistant\n")
+        + _ords("A<|im_end|>\n")
+    )
+    assert list(ids) == expected_ids
+    n_sup = len("A<|im_end|>\n")
+    assert list(labels) == [IGNORE_INDEX] * (
+        len(expected_ids) - n_sup
+    ) + _ords("A<|im_end|>\n")
+
+
+def test_golden_prompt_prefix_agreement_all_templates():
+    """For every registered template: the unsupervised prefix of the
+    training tokenization equals the tokenized generation prompt — the
+    train/infer agreement that the v1 trailing-space bug broke."""
+    from oryx_tpu.data import mm_utils
+
+    for name, conv in conv_templates.items():
+        rec = {"conversations": [
+            {"from": "human", "value": "Q?"},
+            {"from": "gpt", "value": "A!"},
+        ]}
+        ids, labels = data_lib.preprocess_conversation(
+            rec, FakeTokenizer(), conv
+        )
+        prefix = [
+            int(i) for i, l in zip(ids, labels) if l == IGNORE_INDEX
+        ]
+        gen = conv.copy()
+        gen.append_message(gen.roles[0], "Q?")
+        gen.append_message(gen.roles[1], None)
+        prompt_ids = [
+            int(t) for t in
+            mm_utils.tokenizer_image_token(gen.get_prompt(), FakeTokenizer())
+        ]
+        assert prefix == prompt_ids, f"template {name!r} train/infer mismatch"
