@@ -1,21 +1,31 @@
-"""Benchmark: Oryx SFT training throughput (tokens/sec/chip).
+"""Benchmark: Oryx SFT training throughput + 64-frame video-QA latency.
 
-Runs the full multimodal SFT step — OryxViT → Dynamic Compressor → splice →
-decoder forward, masked CE, backward, AdamW — under jit on whatever backend
-is available, and prints ONE JSON line:
+Prints ONE JSON line with the north-star metric (BASELINE.md rows 1-2):
 
     {"metric": "sft_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
-     "vs_baseline": R}
+     "vs_baseline": R, "chip": ..., "hbm_gb": ..., "mfu": ...,
+     "geometry": ..., "params_b": ..., "latency_video64_p50_s": ...,
+     "baseline_source": ...}
 
-The model geometry scales with the backend: a ~350M-param decoder (Qwen2-
-style GQA, bf16 compute, remat) with the SigLIP-class vision tower on TPU;
-a tiny config on CPU so the script stays runnable anywhere.
+Throughput: the full multimodal SFT step (OryxViT → Dynamic Compressor →
+splice → decoder fwd, masked CE, bwd, AdamW; Pallas flash attention on
+TPU) on the LARGEST 7B-shaped geometry whose fp32 AdamW training state
+fits the detected chip's HBM. Oryx-7B itself needs ~16 bytes/param of
+state (~122 GB) — more than any single chip; the geometry ladder below
+keeps the 7B shape (head_dim 128, GQA, vocab 152064, attention bias) and
+scales width/depth, so tokens/sec/chip and MFU are honest for the chip
+being measured. `geometry`/`params_b` in the output say exactly what ran.
 
-`vs_baseline` is measured against BASELINE.json's published numbers when
-present; BASELINE.json currently publishes none (`"published": {}`), so the
-ratio uses the documented placeholder below (an 8xA100 Oryx-7B SFT
-tokens/sec/chip estimate) and is to be re-anchored when real reference
-numbers appear.
+MFU uses the standard 6*N*tokens + attention-matmul model FLOPs (remat
+recompute NOT counted as useful work) over the chip's peak bf16 FLOPs.
+
+Latency: BASELINE config 3 — 64-frame video QA (16x compression) through
+serve/pipeline.OryxInference, greedy, 32 new tokens; p50 over repeats.
+
+`vs_baseline`: BASELINE.json publishes no reference number ("published":
+{}), so the ratio uses a documented 2000 tok/s/chip PLACEHOLDER
+(8xA100 Oryx-7B SFT estimate) and is labeled as such in
+`baseline_source` — it is NOT a measured-reference comparison.
 """
 
 from __future__ import annotations
@@ -26,28 +36,119 @@ import time
 
 import numpy as np
 
-# Placeholder reference throughput (tokens/sec/chip) for Oryx-7B SFT on
-# 8xA100; BASELINE.json `published` is empty. Replace when measured.
 PLACEHOLDER_BASELINE_TOK_S_CHIP = 2000.0
+BASELINE_SOURCE = "placeholder_2000_tok_s_chip_unverified"
 
 WARMUP_STEPS = 2
 TIMED_STEPS = 5
+LATENCY_REPEATS = 5
+LATENCY_NEW_TOKENS = 32
+
+# 7B-shaped ladder: (name, llm kwargs). All keep vocab 152064, head_dim
+# 128, GQA, attention bias — only width/depth shrink. Ordered largest
+# first; the largest whose training state fits HBM is benched.
+GEOMETRY_LADDER = (
+    ("oryx_7b", dict(
+        hidden_size=3584, intermediate_size=18944, num_layers=28,
+        num_heads=28, num_kv_heads=4)),
+    ("oryx_7b_depth14", dict(
+        hidden_size=3584, intermediate_size=18944, num_layers=14,
+        num_heads=28, num_kv_heads=4)),
+    ("oryx_3b", dict(
+        hidden_size=2560, intermediate_size=13696, num_layers=20,
+        num_heads=20, num_kv_heads=4)),
+    ("oryx_1_5b", dict(
+        hidden_size=1536, intermediate_size=8960, num_layers=28,
+        num_heads=12, num_kv_heads=2)),
+    ("oryx_0_9b", dict(
+        hidden_size=1280, intermediate_size=6912, num_layers=24,
+        num_heads=10, num_kv_heads=2)),
+    ("oryx_0_6b", dict(
+        hidden_size=1024, intermediate_size=5504, num_layers=20,
+        num_heads=8, num_kv_heads=2)),
+)
+
+# Peak dense bf16 FLOPs/s per chip kind (public spec sheets).
+PEAK_FLOPS = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+)
+
+STATE_BYTES_PER_PARAM = 16  # fp32 params + AdamW mu/nu + fp32 grads
+HBM_FRACTION = 0.82  # leave room for activations/logits/workspace
 
 
-def _bench_cfg(backend: str):
+def _llm_cfg(kw):
+    from oryx_tpu import config as cfg_lib
+
+    return cfg_lib.LLMConfig(
+        vocab_size=152064, head_dim=128, rope_theta=1_000_000.0,
+        attention_bias=True, **kw,
+    )
+
+
+def count_llm_params(c) -> int:
+    h, i, v, d = c.hidden_size, c.intermediate_size, c.vocab_size, c.head_dim
+    qo = h * c.num_heads * d * 2
+    kv = h * c.num_kv_heads * d * 2
+    bias = (c.num_heads + 2 * c.num_kv_heads) * d if c.attention_bias else 0
+    mlp = 3 * h * i
+    per_layer = qo + kv + bias + mlp + 2 * h
+    embeds = v * h * (1 if c.tie_word_embeddings else 2)
+    return c.num_layers * per_layer + embeds + h
+
+
+# Fallback HBM per chip kind when memory_stats() is unavailable (the axon
+# remote transport does not expose it). Public spec-sheet values.
+KNOWN_HBM_GB = (
+    ("v6", 32), ("v5p", 95), ("v5e", 16), ("v5 lite", 16),
+    ("v5litepod", 16), ("v5", 95), ("v4", 32), ("v3", 16),
+)
+
+
+def chip_info(jax):
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "unknown")
+    try:
+        stats = dev.memory_stats() or {}
+        hbm = int(stats.get("bytes_limit", 0))
+    except Exception:
+        hbm = 0
+    kl = kind.lower()
+    if not hbm:
+        for tag, gb in KNOWN_HBM_GB:
+            if tag in kl:
+                hbm = gb * 1024**3
+                break
+    peak = None
+    for tag, f in PEAK_FLOPS:
+        if tag in kl:
+            peak = f
+            break
+    return kind, hbm, peak
+
+
+def pick_geometry(hbm_bytes: int):
+    budget = hbm_bytes * HBM_FRACTION
+    for name, kw in GEOMETRY_LADDER:
+        c = _llm_cfg(kw)
+        if count_llm_params(c) * STATE_BYTES_PER_PARAM < budget:
+            return name, c
+    name, kw = GEOMETRY_LADDER[-1]
+    return name, _llm_cfg(kw)
+
+
+def _bench_cfg(backend: str, hbm_bytes: int):
     from oryx_tpu import config as cfg_lib
 
     if backend == "tpu" and not os.environ.get("BENCH_SMALL"):
-        llm = cfg_lib.LLMConfig(
-            vocab_size=16384,
-            hidden_size=1536,
-            intermediate_size=4096,
-            num_layers=12,
-            num_heads=12,
-            num_kv_heads=4,
-            head_dim=128,
-            attention_bias=True,
-        )
+        geo_name, llm = pick_geometry(hbm_bytes)
         vision = cfg_lib.VisionConfig(
             hidden_size=768,
             intermediate_size=2048,
@@ -60,7 +161,7 @@ def _bench_cfg(backend: str):
         batch_size, seq_bucket, img_patches_side = 8, (2048,), 16
         comp_heads = 12
     else:
-        llm = cfg_lib.tiny_llm()
+        geo_name, llm = "tiny", cfg_lib.tiny_llm()
         vision = cfg_lib.tiny_vision()
         batch_size, seq_bucket, img_patches_side = 2, (128,), 4
         comp_heads = 4
@@ -72,7 +173,7 @@ def _bench_cfg(backend: str):
         # Pallas flash attention on the real chip; portable XLA path on CPU.
         attn_impl="pallas" if backend == "tpu" else "xla",
     )
-    return cfg, batch_size, seq_bucket, img_patches_side
+    return geo_name, cfg, batch_size, seq_bucket, img_patches_side
 
 
 def _make_batch(cfg, batch_size, seq_bucket, img_side):
@@ -120,6 +221,64 @@ def _make_batch(cfg, batch_size, seq_bucket, img_side):
     }
 
 
+def model_flops_per_step(cfg, n_llm_params, host) -> float:
+    """Analytic model FLOPs for one SFT step: 6*N per token (fwd 2N +
+    bwd 4N matmul work) for decoder and ViT, plus attention matmuls
+    (QK^T and PV, fwd 2+2 flops/elem, bwd 2x). Remat recompute excluded."""
+    lc, vc = cfg.llm, cfg.vision
+    B, T = host["token_ids"].shape
+    tok = float(B * T)
+    # Decoder dense matmuls (exclude the embedding gather, include lm_head).
+    n_dense = n_llm_params - lc.vocab_size * lc.hidden_size
+    f = 6.0 * n_dense * tok
+    # Decoder attention: per layer fwd 4*T^2*heads*d flops (QK+PV), x3 bwd.
+    f += 12.0 * lc.num_layers * B * T * T * lc.num_heads * lc.head_dim
+    # Vision tower over the packed patch buffer.
+    P = float(host["segment_ids"].shape[-1])
+    n_vit = vc.num_layers * (
+        4 * vc.hidden_size * vc.num_heads * vc.head_dim
+        + 2 * vc.hidden_size * vc.intermediate_size
+    ) + (vc.patch_size**2 * 3) * vc.hidden_size
+    f += 6.0 * n_vit * P
+    f += 12.0 * vc.num_layers * P * P * vc.num_heads * vc.head_dim
+    return f
+
+
+class _CharTokenizer:
+    """Deterministic host-side tokenizer for the latency bench (no
+    pretrained vocab available offline)."""
+
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 50000) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 50000)
+
+
+def bench_video_latency(params, cfg) -> float | None:
+    """64-frame video-QA p50 end-to-end latency (s) through the serving
+    pipeline: preprocess + pack + ViT + compressor + splice + prefill +
+    32-token greedy decode."""
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    pipe = OryxInference(_CharTokenizer(), params, cfg)
+    rng = np.random.default_rng(0)
+    frames = [
+        rng.integers(0, 255, size=(224, 224, 3), dtype=np.uint8)
+        for _ in range(64)
+    ]
+    # Warmup (compile prefill + decode programs).
+    pipe.chat_video(frames, "what happens?",
+                    max_new_tokens=LATENCY_NEW_TOKENS)
+    times = []
+    for _ in range(LATENCY_REPEATS):
+        t0 = time.perf_counter()
+        pipe.chat_video(frames, "what happens?",
+                        max_new_tokens=LATENCY_NEW_TOKENS)
+        times.append(time.perf_counter() - t0)
+    return float(np.percentile(times, 50))
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -130,7 +289,9 @@ def main() -> None:
 
     backend = jax.default_backend()
     n_chips = jax.device_count()
-    cfg, batch_size, seq_bucket, img_side = _bench_cfg(backend)
+    chip, hbm, peak = chip_info(jax)
+    geo_name, cfg, batch_size, seq_bucket, img_side = _bench_cfg(backend, hbm)
+    n_llm = count_llm_params(cfg.llm)
     host = _make_batch(cfg, batch_size, seq_bucket, img_side)
     batch = {k: jnp.asarray(v)[None] for k, v in host.items()}  # accum=1
 
@@ -155,12 +316,36 @@ def main() -> None:
     if not np.isfinite(loss):
         raise RuntimeError(f"non-finite loss {loss} in bench step")
 
-    tok_s_chip = tokens_per_step * TIMED_STEPS / dt / n_chips
+    step_time = dt / TIMED_STEPS
+    tok_s_chip = tokens_per_step / step_time / n_chips
+    mfu = None
+    if peak:
+        flops = model_flops_per_step(cfg, n_llm, host)
+        mfu = round(flops / step_time / (n_chips * peak), 4)
+
+    del state, metrics, batch  # free HBM for the inference latency bench
+    latency = None
+    if not os.environ.get("BENCH_NO_LATENCY"):
+        try:
+            # Fresh params: the originals were donated into train_step.
+            params = oryx.init_params(cfg, jax.random.key(0))
+            latency = round(bench_video_latency(params, cfg), 3)
+        except Exception as e:  # keep the primary metric even if this fails
+            print(f"# latency bench failed: {e!r}")
+
     print(json.dumps({
         "metric": "sft_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 2),
         "unit": "tok/s",
         "vs_baseline": round(tok_s_chip / PLACEHOLDER_BASELINE_TOK_S_CHIP, 4),
+        "baseline_source": BASELINE_SOURCE,
+        "chip": chip,
+        "hbm_gb": round(hbm / 1024**3, 1) if hbm else None,
+        "geometry": geo_name,
+        "params_b": round(n_llm / 1e9, 2),
+        "step_time_s": round(step_time, 3),
+        "mfu": mfu,
+        "latency_video64_p50_s": latency,
     }))
 
 

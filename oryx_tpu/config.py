@@ -105,6 +105,10 @@ class TrainConfig:
     num_train_steps: int = 1000
     seed: int = 0
     remat: bool = True  # gradient checkpointing per decoder block
+    # Sequence-chunk size for the memory-efficient CE loss (0 = dense
+    # [B, T, V] logits). At 152k vocab the dense path needs ~10 GB fp32
+    # logits per 8x2048 batch — chunking is what fits a 16 GB v5e.
+    loss_chunk: int = 128
     # Which parameter groups train: "full", "projector_only" (stage-1
     # pretraining of the compressor/projector), "no_vision".
     tune: str = "full"

@@ -36,15 +36,29 @@ def _lib_path() -> str:
 
 
 def build(quiet: bool = True) -> bool:
-    """Compile the shared library in-tree. Returns success."""
+    """Compile the shared library in-tree. Returns success.
+
+    Cross-PROCESS safe: an exclusive flock serializes concurrent builders
+    (the module `_lock` only covers threads), and the Makefile writes to a
+    temp file + atomic rename so a concurrent dlopen never maps a
+    truncated .so.
+    """
     if not os.path.isdir(_NATIVE_DIR):
         return False
+    import fcntl
+
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
     try:
-        r = subprocess.run(
-            ["make", "-C", _NATIVE_DIR],
-            capture_output=quiet, text=True, timeout=120,
-        )
-        return r.returncode == 0
+        with open(lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            # Always run make: it is a no-op when the .so is newer than
+            # loader.cpp, and handles stale-library rebuilds; the lock
+            # only serializes concurrent builders.
+            r = subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                capture_output=quiet, text=True, timeout=120,
+            )
+            return r.returncode == 0
     except (OSError, subprocess.TimeoutExpired):
         return False
 

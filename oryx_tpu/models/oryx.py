@@ -89,8 +89,10 @@ def forward(
     mesh=None,
     compute_dtype=None,
     logits_dtype=jnp.float32,
+    return_hidden: bool = False,
 ) -> jnp.ndarray:
-    """Training/prefill forward: visual encode → splice → decoder logits.
+    """Training/prefill forward: visual encode → splice → decoder logits
+    (or final hidden states when return_hidden, for the chunked-CE loss).
 
     mesh: only needed for attn_impl='ring' without an ambient mesh
     (jax.sharding.set_mesh) in scope."""
@@ -101,13 +103,14 @@ def forward(
     embeds = splice.embed_spliced(
         params["llm"]["embed"]["weight"], vis, token_ids, visual_idx, is_visual
     )
-    logits, _ = qwen2.forward(
+    out, _ = qwen2.forward(
         params["llm"], cfg.llm,
         inputs_embeds=embeds, positions=positions, kv_mask=attn_mask,
         remat=remat, attn_impl=cfg.attn_impl, mesh=mesh,
         compute_dtype=compute_dtype, logits_dtype=logits_dtype,
+        return_hidden=return_hidden,
     )
-    return logits
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "cache_len"))

@@ -143,10 +143,11 @@ def forward(
 
         def attn_fn(q, k, v):
             return _sa.segment_attention(q, k, v, seg, seg)
-    elif attn_impl in ("xla", "ring"):
-        # "ring" (decoder sequence parallelism) has no meaning for the
-        # packed ViT buffer; its parallel story is sharding the packing
-        # axis, which the XLA path handles under GSPMD.
+    elif attn_impl in ("xla", "ring", "ring_flash"):
+        # "ring"/"ring_flash" (decoder sequence parallelism) have no
+        # meaning for the packed ViT buffer; its parallel story is
+        # sharding the packing axis, which the XLA path handles under
+        # GSPMD.
         def attn_fn(q, k, v):
             return attention(q, k, v, q_segment_ids=seg, kv_segment_ids=seg)
     else:

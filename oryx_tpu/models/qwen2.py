@@ -180,6 +180,7 @@ def forward(
     sp_axis: str = "sp",
     compute_dtype: jnp.dtype | None = None,
     logits_dtype: jnp.dtype = jnp.float32,
+    return_hidden: bool = False,
 ) -> tuple[jnp.ndarray, Params | None]:
     """Full decoder forward.
 
@@ -209,7 +210,7 @@ def forward(
         inputs_embeds = inputs_embeds.astype(compute_dtype)
     # Pin the hidden-state sharding so GSPMD doesn't guess intermediates:
     # batch over the data axes, sequence over sp only in ring mode.
-    seq_axis = "sp" if attn_impl == "ring" else None
+    seq_axis = "sp" if attn_impl.startswith("ring") else None
     hs_spec = (("dp", "fsdp"), seq_axis, None)
     h = constrain(inputs_embeds, *hs_spec)
     B, T, _ = h.shape
@@ -229,19 +230,22 @@ def forward(
     elif attn_impl == "xla":
         def attn_fn(q, k, v, **kw):
             return attention(q, k, v, causal=True, **kw)
-    elif attn_impl == "ring":
+    elif attn_impl in ("ring", "ring_flash"):
         # Sequence parallelism over the `sp` mesh axis (training/prefill;
-        # decode with a KV cache is not sequence-sharded).
+        # decode with a KV cache is not sequence-sharded). "ring_flash"
+        # runs the Pallas kernel per visiting block — O(tile) logits
+        # memory, the long-context configuration.
         from oryx_tpu.ops.ring_attention import ring_attention
 
         if kv_cache is not None:
-            raise ValueError("attn_impl='ring' does not support kv_cache")
+            raise ValueError(f"attn_impl={attn_impl!r} needs no kv_cache")
+        ring_impl = "flash" if attn_impl == "ring_flash" else "xla"
 
         def attn_fn(q, k, v, *, q_positions, kv_positions, kv_mask):
             return ring_attention(
                 q, k, v, mesh=mesh, axis_name=sp_axis,
                 batch_axes=("dp", "fsdp"), causal=True,
-                positions=q_positions, kv_mask=kv_mask,
+                positions=q_positions, kv_mask=kv_mask, impl=ring_impl,
             )
     else:
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
@@ -273,6 +277,11 @@ def forward(
     h, ys = jax.lax.scan(body, h, xs)
 
     h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
+    if return_hidden:
+        # Final hidden states pre-lm_head: the chunked-CE training path
+        # (train/loss.chunked_causal_lm_loss) projects to the vocab
+        # per-chunk instead of materializing [B, T, V] logits.
+        return h, ({"k": ys[0], "v": ys[1]} if kv_cache is not None else None)
     if cfg.tie_word_embeddings:
         logits = h @ params["embed"]["weight"].astype(h.dtype).T
     else:

@@ -28,6 +28,7 @@ Interpret mode runs the same kernel on CPU for tests.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -39,9 +40,40 @@ NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 # Tile sizes. 512×512 keeps the fp32 logits tile at 1 MB of VMEM while
 # amortizing DMA and per-tile softmax state updates; q/k/v/acc tiles add
 # ~0.8 MB — comfortably inside the ~16 MB VMEM budget with double
-# buffering.
-BLOCK_Q = 512
-BLOCK_K = 512
+# buffering. Validated on-chip (v5e, see TPU_VALIDATION.md): 512x512 beat
+# the 256/1024 variants on the bench shapes. Env-overridable for sweeps.
+BLOCK_Q = int(os.environ.get("ORYX_FLASH_BLOCK_Q", "512"))
+BLOCK_K = int(os.environ.get("ORYX_FLASH_BLOCK_K", "512"))
+
+
+def _causal_kv_clamp(block_q: int, block_k: int, enabled: bool):
+    """Grid-level kv skipping for causal PREFILL layouts (q AND kv
+    positions both arange from 0 — `enabled` must encode that): map every
+    causally-dead kv tile index to the LAST live tile for its q tile.
+    Pallas elides the DMA when an input block's index map repeats the
+    previous grid step's value, so dead tiles cost neither bandwidth nor
+    compute (the kernels' `run` predicate — keyed on the unclamped
+    program id — already skips their math). Invalid for the decode layout
+    (arbitrary q positions): tile index no longer bounds position there."""
+    if not enabled:
+        return lambda iq, ik: ik
+
+    def clamp(iq, ik):
+        return jnp.minimum(ik, ((iq + 1) * block_q - 1) // block_k)
+
+    return clamp
+
+
+def _causal_q_clamp(block_q: int, block_k: int, enabled: bool):
+    """dkv-kernel mirror of _causal_kv_clamp: q tiles entirely before a kv
+    tile are dead; map them to the FIRST live q tile."""
+    if not enabled:
+        return lambda ik, iq: iq
+
+    def clamp(ik, iq):
+        return jnp.maximum(iq, (ik * block_k) // block_q)
+
+    return clamp
 
 
 def _kernel(
@@ -140,8 +172,8 @@ def _pad_axis(x, axis: int, target: int, fill=0):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "has_segments", "kv_arange", "scale",
-                     "interpret", "with_lse"),
+    static_argnames=("causal", "has_segments", "kv_arange", "q_arange",
+                     "scale", "interpret", "with_lse"),
 )
 def _mha_forward(
     q, k, v, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
@@ -149,6 +181,7 @@ def _mha_forward(
     causal: bool,
     has_segments: bool,
     kv_arange: bool,
+    q_arange: bool,
     scale: float,
     interpret: bool,
     with_lse: bool = False,
@@ -186,6 +219,8 @@ def _mha_forward(
         def kern(qp, kp, qs, ks, kvd, q_, k_, v_, o_, m_, l_, a_):
             kern_full(qp, kp, qs, ks, kvd, q_, k_, v_, o_, None, m_, l_, a_)
 
+    ck = _causal_kv_clamp(block_q, block_k, causal and kv_arange and q_arange)
+
     o_spec = pl.BlockSpec(
         (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
     )
@@ -200,18 +235,26 @@ def _mha_forward(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ck(iq, ik))
+            ),
             pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
-            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ck(iq, ik))
+            ),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ck(iq, ik))
+            ),
             pl.BlockSpec(
                 (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+                (1, 1, block_k, D),
+                lambda b, h, iq, ik: (b, h // G, ck(iq, ik), 0),
             ),
             pl.BlockSpec(
-                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+                (1, 1, block_k, D),
+                lambda b, h, iq, ik: (b, h // G, ck(iq, ik), 0),
             ),
         ],
         out_specs=[o_spec, lse_spec] if with_lse else [o_spec],
@@ -314,6 +357,7 @@ def _dkv_kernel(
     causal: bool,
     has_segments: bool,
     kv_arange: bool,
+    q_arange: bool,
     block_q: int,
     block_k: int,
 ):
@@ -330,9 +374,16 @@ def _dkv_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     ik = pl.program_id(2)
-    if causal and kv_arange:
-        # q tiles whose max position is before this kv tile contribute
-        # nothing (qpos is arange in this mode too).
+    if causal and kv_arange and q_arange:
+        # Prefill: q tiles entirely before this kv tile contribute
+        # nothing. Keyed on program ids (NOT qpos_ref — its index map
+        # aliases dead q tiles onto live ones for the DMA skip). Padded q
+        # rows past the real length still run but contribute zeros (do is
+        # zero there).
+        run = ik * block_k <= (iq + 1) * block_q - 1
+    elif causal and kv_arange:
+        # Arbitrary q positions (decode layout): no q-side aliasing, so
+        # the actual positions bound the live kv range.
         run = ik * block_k <= jnp.max(qpos_ref[0])
     else:
         run = True
@@ -383,8 +434,8 @@ def _dkv_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "has_segments", "kv_arange", "scale",
-                     "interpret"),
+    static_argnames=("causal", "has_segments", "kv_arange", "q_arange",
+                     "scale", "interpret"),
 )
 def _mha_backward(
     q, k, v, do, lse, delta, q_pos, kv_pos, q_seg, kv_seg, kv_valid,
@@ -392,6 +443,7 @@ def _mha_backward(
     causal: bool,
     has_segments: bool,
     kv_arange: bool,
+    q_arange: bool,
     scale: float,
     interpret: bool,
 ):
@@ -422,23 +474,34 @@ def _mha_backward(
         kv_arange=kv_arange,
     )
 
+    ckv = _causal_kv_clamp(block_q, block_k, causal and kv_arange and q_arange)
+    cq = _causal_q_clamp(block_q, block_k, causal and kv_arange and q_arange)
+
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, **common),
         grid=(B, Hq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ckv(iq, ik))
+            ),
             pl.BlockSpec((1, block_q, LANES), lambda b, h, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
-            pl.BlockSpec((1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ckv(iq, ik))
+            ),
+            pl.BlockSpec(
+                (1, SUB, block_k), lambda b, h, iq, ik: (b, 0, ckv(iq, ik))
+            ),
             pl.BlockSpec(
                 (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+                (1, 1, block_k, D),
+                lambda b, h, iq, ik: (b, h // G, ckv(iq, ik), 0),
             ),
             pl.BlockSpec(
-                (1, 1, block_k, D), lambda b, h, iq, ik: (b, h // G, ik, 0)
+                (1, 1, block_k, D),
+                lambda b, h, iq, ik: (b, h // G, ckv(iq, ik), 0),
             ),
             pl.BlockSpec(
                 (1, 1, block_q, D), lambda b, h, iq, ik: (b, h, iq, 0)
@@ -461,18 +524,21 @@ def _mha_backward(
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, block_k=block_k, **common
+            _dkv_kernel, block_q=block_q, block_k=block_k,
+            q_arange=q_arange, **common
         ),
         grid=(B, Hk, nk, G, nq),
         in_specs=[
             pl.BlockSpec(
-                (1, block_q, LANES), lambda b, hk, ik, g, iq: (b, iq, 0)
+                (1, block_q, LANES),
+                lambda b, hk, ik, g, iq: (b, cq(ik, iq), 0),
             ),
             pl.BlockSpec(
                 (1, SUB, block_k), lambda b, hk, ik, g, iq: (b, 0, ik)
             ),
             pl.BlockSpec(
-                (1, block_q, LANES), lambda b, hk, ik, g, iq: (b, iq, 0)
+                (1, block_q, LANES),
+                lambda b, hk, ik, g, iq: (b, cq(ik, iq), 0),
             ),
             pl.BlockSpec(
                 (1, SUB, block_k), lambda b, hk, ik, g, iq: (b, 0, ik)
@@ -482,7 +548,7 @@ def _mha_backward(
             ),
             pl.BlockSpec(
                 (1, 1, block_q, D),
-                lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, cq(ik, iq), 0),
             ),
             pl.BlockSpec(
                 (1, 1, block_k, D), lambda b, hk, ik, g, iq: (b, hk, ik, 0)
@@ -492,15 +558,15 @@ def _mha_backward(
             ),
             pl.BlockSpec(
                 (1, 1, block_q, D),
-                lambda b, hk, ik, g, iq: (b, hk * G + g, iq, 0),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, cq(ik, iq), 0),
             ),
             pl.BlockSpec(
                 (1, 1, SUB, block_q),
-                lambda b, hk, ik, g, iq: (b, hk * G + g, 0, iq),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, 0, cq(ik, iq)),
             ),
             pl.BlockSpec(
                 (1, 1, SUB, block_q),
-                lambda b, hk, ik, g, iq: (b, hk * G + g, 0, iq),
+                lambda b, hk, ik, g, iq: (b, hk * G + g, 0, cq(ik, iq)),
             ),
         ],
         out_specs=[
@@ -578,6 +644,7 @@ def _prepare(q, k, v, q_positions, kv_positions, q_segment_ids,
     Tk_p = _round_up(Tk, block_k)
 
     kv_arange = kv_positions is None
+    q_arange = q_positions is None
     if q_positions is None:
         q_positions = jnp.broadcast_to(jnp.arange(Tq, dtype=jnp.int32), (B, Tq))
     if kv_positions is None:
@@ -611,7 +678,7 @@ def _prepare(q, k, v, q_positions, kv_positions, q_segment_ids,
     kv_valid = _pad_axis(kv_valid, 1, Tk_p)
     flags = dict(
         causal=causal, has_segments=has_segments, kv_arange=kv_arange,
-        scale=float(scale), interpret=_use_interpret(),
+        q_arange=q_arange, scale=float(scale), interpret=_use_interpret(),
     )
     return (qt, kt, vt, q_pos, kv_pos, q_seg, kv_seg, kv_valid), flags, Tq
 

@@ -46,6 +46,7 @@ def ring_attention_shard(
     axis_name: str,
     causal: bool = False,
     scale: float | None = None,
+    impl: str = "xla",
 ):
     """Per-shard body (call inside shard_map over `axis_name`).
 
@@ -53,7 +54,17 @@ def ring_attention_shard(
     q_pos/kv_pos: absolute positions [B, Tl]; kv_valid: [B, Tl] int.
     Returns [B, Tl, Hq, D] in q.dtype — exact attention over the global
     sequence.
+
+    impl: "xla" materializes [Tl, Tc] fp32 logits per visiting block;
+    "flash" runs the Pallas flash kernel per block and merges the
+    per-block normalized outputs via their logsumexp — O(tile) memory,
+    which is what makes Tl in the tens-of-thousands feasible.
     """
+    if impl == "flash":
+        return _ring_shard_flash(
+            q, k, v, q_pos, kv_pos, kv_valid,
+            axis_name=axis_name, causal=causal, scale=scale,
+        )
     B, Tl, Hq, D = q.shape
     _, _, Hk, _ = k.shape
     G = Hq // Hk
@@ -111,6 +122,176 @@ def ring_attention_shard(
     return out.astype(q.dtype)
 
 
+def _ring_shard_flash(
+    q, k, v, q_pos, kv_pos, kv_valid,
+    *,
+    axis_name: str,
+    causal: bool,
+    scale: float | None,
+):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _ring_flash_vjp(
+        q, k, v, q_pos, kv_pos, kv_valid, axis_name, causal, float(scale)
+    )
+
+
+def _ring_flash_forward(
+    q, k, v, q_pos, kv_pos, kv_valid, axis_name, causal, scale
+):
+    """Flash-inner ring forward: per visiting block, run the Pallas kernel
+    (fp32 softmax inside, O(tile) memory) and fold its normalized output
+    into a running LSE-weighted sum:
+
+        LSE' = logaddexp(LSE, lse_i)
+        out' = out·exp(LSE − LSE') + out_i·exp(lse_i − LSE')
+
+    Returns (out [B,Tl,Hq,D] in q.dtype, global lse [B,Hq,Tl] fp32). The
+    kernel marks fully-masked rows with lse = +FLT_MAX (a backward-pass
+    convention); those are re-mapped to the NEG sentinel so empty blocks
+    merge with weight 0 (NEG-NEG arithmetic stays finite, no NaNs).
+    """
+    from oryx_tpu.ops.pallas.flash_attention import _flash_attention_impl
+
+    B, Tl, Hq, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    out = jnp.zeros((B, Tl, Hq, D), jnp.float32)
+    lse = jnp.full((B, Hq, Tl), NEG, jnp.float32)
+
+    def merge(out, lse, k_cur, v_cur, kpos_cur, kvalid_cur):
+        o_i, lse_i = _flash_attention_impl(
+            q, k_cur, v_cur, q_pos, kpos_cur, None, None, kvalid_cur,
+            causal, scale, with_lse=True,
+        )
+        lse_i = lse_i[:, :, :Tl]  # kernel pads to block multiples
+        lse_i = jnp.where(lse_i > -0.5 * NEG, NEG, lse_i)  # empty rows
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new)  # [B, Hq, Tl]
+        w_new = jnp.exp(lse_i - lse_new)
+        wo = jnp.moveaxis(w_old, 1, 2)[..., None]  # [B, Tl, Hq, 1]
+        wn = jnp.moveaxis(w_new, 1, 2)[..., None]
+        out = out * wo + o_i.astype(jnp.float32) * wn
+        return out, lse_new
+
+    def body(_, carry):
+        out, lse, k_cur, v_cur, kpos_cur, kvalid_cur = carry
+        if causal:
+            live = jnp.min(kpos_cur) <= jnp.max(q_pos)
+            out, lse = jax.lax.cond(
+                live, merge, lambda o, s, *_: (o, s),
+                out, lse, k_cur, v_cur, kpos_cur, kvalid_cur,
+            )
+        else:
+            out, lse = merge(out, lse, k_cur, v_cur, kpos_cur, kvalid_cur)
+        k_cur, v_cur, kpos_cur, kvalid_cur = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm),
+            (k_cur, v_cur, kpos_cur, kvalid_cur),
+        )
+        return out, lse, k_cur, v_cur, kpos_cur, kvalid_cur
+
+    out, lse, *_ = jax.lax.fori_loop(
+        0, n, body, (out, lse, k, v, kv_pos, kv_valid)
+    )
+    return out.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _ring_flash_vjp(
+    q, k, v, q_pos, kv_pos, kv_valid, axis_name, causal, scale
+):
+    return _ring_flash_forward(
+        q, k, v, q_pos, kv_pos, kv_valid, axis_name, causal, scale
+    )[0]
+
+
+def _ring_flash_fwd(q, k, v, q_pos, kv_pos, kv_valid, axis_name, causal,
+                    scale):
+    out, lse = _ring_flash_forward(
+        q, k, v, q_pos, kv_pos, kv_valid, axis_name, causal, scale
+    )
+    return out, (q, k, v, q_pos, kv_pos, kv_valid, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, g):
+    """Ring backward: a second pass around the ring. dq accumulates
+    locally; each visiting block's dk/dv partials travel WITH the block
+    (n rotations = full circle, so they arrive home at loop end). Per
+    block, the Pallas flash backward kernels run against the GLOBAL
+    logsumexp saved from the forward — the standard ring-attention
+    backward, O(Tl) memory per device.
+    """
+    from oryx_tpu.ops.pallas.flash_attention import (
+        _mha_backward, _pad_axis, _prepare,
+    )
+
+    q, k, v, q_pos, kv_pos, kv_valid, out, lse = res
+    B, Tl, Hq, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # Restore the kernel's empty-row convention (+MAX ⇒ p underflows to 0)
+    # for rows that saw no valid key anywhere in the ring.
+    lse_bwd = jnp.where(
+        lse <= 0.5 * NEG, jnp.float32(jnp.finfo(jnp.float32).max), lse
+    )
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", g.astype(jnp.float32), out.astype(jnp.float32)
+    )  # [B, Hq, Tl]
+
+    dq0 = jnp.zeros((B, Tl, Hq, D), jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)
+
+    def block_grads(dq, dk_t, dv_t, k_cur, v_cur, kpos_cur, kvalid_cur):
+        padded, flags, _ = _prepare(
+            q, k_cur, v_cur, q_pos, kpos_cur, None, None, kvalid_cur,
+            causal, scale,
+        )
+        Tq_p = padded[0].shape[2]
+        do = _pad_axis(g.swapaxes(1, 2), 2, Tq_p)
+        lse_p = _pad_axis(lse_bwd, 2, Tq_p)
+        delta_p = _pad_axis(delta, 2, Tq_p)
+        dq_i, dk_i, dv_i = _mha_backward(
+            padded[0], padded[1], padded[2], do, lse_p, delta_p,
+            padded[3], padded[4], padded[5], padded[6], padded[7],
+            **flags,
+        )
+        dq = dq + dq_i[:, :, :Tl].swapaxes(1, 2)
+        dk_t = dk_t + dk_i[:, :, :Tl].swapaxes(1, 2)
+        dv_t = dv_t + dv_i[:, :, :Tl].swapaxes(1, 2)
+        return dq, dk_t, dv_t
+
+    def body(_, carry):
+        dq, k_cur, v_cur, kpos_cur, kvalid_cur, dk_t, dv_t = carry
+        if causal:
+            live = jnp.min(kpos_cur) <= jnp.max(q_pos)
+            dq, dk_t, dv_t = jax.lax.cond(
+                live, block_grads, lambda a, b, c, *_: (a, b, c),
+                dq, dk_t, dv_t, k_cur, v_cur, kpos_cur, kvalid_cur,
+            )
+        else:
+            dq, dk_t, dv_t = block_grads(
+                dq, dk_t, dv_t, k_cur, v_cur, kpos_cur, kvalid_cur
+            )
+        k_cur, v_cur, kpos_cur, kvalid_cur, dk_t, dv_t = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm),
+            (k_cur, v_cur, kpos_cur, kvalid_cur, dk_t, dv_t),
+        )
+        return dq, k_cur, v_cur, kpos_cur, kvalid_cur, dk_t, dv_t
+
+    dq, _, _, _, _, dk, dv = jax.lax.fori_loop(
+        0, n, body, (dq0, k, v, kv_pos, kv_valid, *dkv0)
+    )
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        None, None, None,
+    )
+
+
+_ring_flash_vjp.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(
     q, k, v,
     *,
@@ -121,10 +302,13 @@ def ring_attention(
     positions=None,
     kv_mask=None,
     scale: float | None = None,
+    impl: str = "xla",
 ):
     """Global-array entry: shards the sequence over `axis_name` and runs the
     ring. q/k/v: [B, T, H*, D] with T divisible by the axis size.
     mesh=None uses the ambient mesh (jax.sharding.use_mesh / jit context).
+    impl="flash" uses the Pallas kernel per visiting block (O(tile) logits
+    memory — required once per-shard T reaches the tens of thousands).
 
     batch_axes: mesh axes the batch dim is sharded over (e.g.
     ("dp", "fsdp") in the trainer) — carried through the shard_map so the
@@ -149,7 +333,7 @@ def ring_attention(
     fn = shard_map(
         partial(
             ring_attention_shard, axis_name=axis_name, causal=causal,
-            scale=scale,
+            scale=scale, impl=impl,
         ),
         mesh=mesh,
         in_specs=(seq, seq, seq, tok, tok, tok),

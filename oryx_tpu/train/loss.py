@@ -38,3 +38,72 @@ def causal_lm_loss(
         ) / num,
     }
     return loss, metrics
+
+
+def _project(hidden: jnp.ndarray, w: jnp.ndarray, transpose: bool):
+    w = w.astype(hidden.dtype)
+    return hidden @ (w.T if transpose else w)
+
+
+def chunked_causal_lm_loss(
+    hidden: jnp.ndarray,   # [B, T, H] final decoder hidden states
+    lm_head: jnp.ndarray,  # [H, V] kernel, or [V, H] embed if transpose
+    labels: jnp.ndarray,   # [B, T] int32, IGNORE_INDEX where unsupervised
+    *,
+    chunk: int = 128,
+    transpose: bool = False,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Masked CE without materializing [B, T, V] logits.
+
+    Scans over sequence chunks; each chunk projects to the vocab, reduces
+    to (sum loss, token count, correct count) and is rematerialized in the
+    backward pass (jax.checkpoint), so peak memory is one [B, chunk, V]
+    logits block instead of the full sequence. At Oryx-7B vocab (152064)
+    and a 2048-token bucket this is the difference between ~10 GB of fp32
+    logits (+ their gradient) and ~0.6 GB — required to train on a 16 GB
+    v5e chip. Numerics match causal_lm_loss (same fp32 reductions).
+    """
+    B, T, _ = hidden.shape
+    if chunk <= 0 or T <= chunk or T % chunk:
+        return causal_lm_loss(_project(hidden, lm_head, transpose), labels)
+    nc = T // chunk
+    hs = jnp.swapaxes(hidden.reshape(B, nc, chunk, -1), 0, 1)
+    ls = jnp.swapaxes(labels.reshape(B, nc, chunk), 0, 1)
+
+    def stats(hc, lc):
+        logits = _project(hc, lm_head, transpose).astype(jnp.float32)
+        mask = lc != IGNORE_INDEX
+        safe = jnp.where(mask, lc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, safe[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        correct = jnp.sum((jnp.argmax(logits, axis=-1) == safe) * mask)
+        return (
+            jnp.sum((logz - gold) * mask),
+            jnp.sum(mask).astype(jnp.int32),
+            correct.astype(jnp.int32),
+        )
+
+    stats = jax.checkpoint(stats)
+
+    def body(carry, xs):
+        dl, dn, dc = stats(*xs)
+        return (carry[0] + dl, carry[1] + dn, carry[2] + dc), None
+
+    (tot, n, correct), _ = jax.lax.scan(
+        body,
+        (
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        ),
+        (hs, ls),
+    )
+    num = jnp.maximum(n, 1)
+    metrics = {
+        "loss": tot / num,
+        "num_tokens": n,
+        "accuracy": correct / num,
+    }
+    return tot / num, metrics

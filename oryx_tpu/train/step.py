@@ -24,7 +24,7 @@ import optax
 
 from oryx_tpu.config import OryxConfig
 from oryx_tpu.models import oryx
-from oryx_tpu.train.loss import causal_lm_loss
+from oryx_tpu.train.loss import chunked_causal_lm_loss
 
 Params = dict[str, Any]
 
@@ -57,7 +57,7 @@ def init_state(
 def microbatch_loss(
     params: Params, cfg: OryxConfig, mb: dict[str, jnp.ndarray]
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
-    logits = oryx.forward(
+    hidden = oryx.forward(
         params, cfg,
         patches=mb["patches"], segment_ids=mb["segment_ids"],
         pos_coords=mb["pos_coords"], region_ids=mb["region_ids"],
@@ -69,8 +69,17 @@ def microbatch_loss(
         compute_dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
             cfg.dtype
         ],
+        return_hidden=True,
     )
-    return causal_lm_loss(logits, mb["labels"])
+    llm_p = params["llm"]
+    if cfg.llm.tie_word_embeddings:
+        w, transpose = llm_p["embed"]["weight"], True
+    else:
+        w, transpose = llm_p["lm_head"]["kernel"], False
+    return chunked_causal_lm_loss(
+        hidden, w, mb["labels"],
+        chunk=cfg.train.loss_chunk, transpose=transpose,
+    )
 
 
 def train_step_fn(

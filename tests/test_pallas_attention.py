@@ -189,3 +189,28 @@ def test_qwen2_forward_pallas_impl_matches_xla():
     ref, _ = qwen2.forward(params, cfg, input_ids=ids, attn_impl="xla")
     got, _ = qwen2.forward(params, cfg, input_ids=ids, attn_impl="pallas")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-4)
+
+
+def test_kv_cache_decode_multitile(monkeypatch):
+    """Decode layout across MULTIPLE kv tiles: q positions are arbitrary
+    (late in the cache) while kv positions are arange. Regression for the
+    causal DMA-clamp bug: the prefill tile-index clamp must NOT apply when
+    q positions aren't arange, or every kv tile aliases tile 0."""
+    from oryx_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_Q", 64)
+    monkeypatch.setattr(fa, "BLOCK_K", 64)
+    B, S, Hq, Hk, D = 2, 512, 4, 2, 32
+    q, k, v = _qkv(jax.random.key(11), B, 8, S, Hq, Hk, D)
+    cur_len = jnp.asarray([400, 210], jnp.int32)
+    q_pos = cur_len[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+    kv_mask = (
+        jnp.arange(S)[None, :] < (cur_len[:, None] + 8)
+    ).astype(jnp.int32)
+    ref = xla_attention(
+        q, k, v, causal=True, q_positions=q_pos, kv_mask=kv_mask
+    )
+    got = flash_attention(
+        q, k, v, causal=True, q_positions=q_pos, kv_mask=kv_mask
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
