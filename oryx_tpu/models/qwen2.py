@@ -149,11 +149,15 @@ def _block(
             kv_mask=kv_mask,
         )
     else:
+        # Right-padded prefill: every valid token's position equals its
+        # slot index, which lets the Pallas kernel skip causally-dead kv
+        # tiles (DMA + compute) despite the explicit position arrays.
         attn_out = attn_fn(
             q, k, v,
             q_positions=positions,
             kv_positions=positions,
             kv_mask=kv_mask,
+            slot_positions=True,
         )
     attn_out = attn_out.reshape(B, T, -1)
     h = h + _linear(attn_out, lp["o_proj"])
@@ -188,7 +192,12 @@ def forward(
       input_ids / inputs_embeds: exactly one; ids [B, T] or embeds [B, T, H].
         (Multimodal calls pass pre-spliced `inputs_embeds`; SURVEY.md §3.4.)
       positions: [B, T] absolute positions (RoPE + causal mask). Defaults to
-        arange when no cache is used.
+        arange when no cache is used. CONSTRAINT (no-cache path): every
+        valid token's position must equal its slot index (right-padded
+        rows with per-row arange — the build_mm_batch layout). The Pallas
+        path asserts this statically (slot_positions=True) to skip
+        causally-dead kv tiles; left-padded or offset layouts would be
+        silently mis-skipped. Use the kv_cache path for offset prefill.
       kv_cache: pytree from `init_kv_cache`; when present, k/v are written at
         `write_slots` ([B] first-slot indices, default positions[:, 0]) and
         attention runs over the whole cache with `kv_mask` [B, S] validity.
@@ -228,7 +237,7 @@ def forward(
         def attn_fn(q, k, v, **kw):
             return _fa.flash_attention(q, k, v, causal=True, **kw)
     elif attn_impl == "xla":
-        def attn_fn(q, k, v, **kw):
+        def attn_fn(q, k, v, slot_positions=False, **kw):
             return attention(q, k, v, causal=True, **kw)
     elif attn_impl in ("ring", "ring_flash"):
         # Sequence parallelism over the `sp` mesh axis (training/prefill;
@@ -241,7 +250,8 @@ def forward(
             raise ValueError(f"attn_impl={attn_impl!r} needs no kv_cache")
         ring_impl = "flash" if attn_impl == "ring_flash" else "xla"
 
-        def attn_fn(q, k, v, *, q_positions, kv_positions, kv_mask):
+        def attn_fn(q, k, v, *, q_positions, kv_positions, kv_mask,
+                    slot_positions=False):
             return ring_attention(
                 q, k, v, mesh=mesh, axis_name=sp_axis,
                 batch_axes=("dp", "fsdp"), causal=True,

@@ -605,32 +605,40 @@ def flash_attention(
     kv_segment_ids=None,
     kv_mask=None,
     scale: float | None = None,
+    slot_positions: bool = False,
 ):
     """Drop-in for ops.attention.attention with identical masking model.
 
     q: [B, Tq, Hq, D]; k/v: [B, Tk, Hk, D]. Returns [B, Tq, Hq, D].
+
+    slot_positions: static caller promise that every VALID token's
+    position equals its slot index (the right-padded prefill layout:
+    positions are per-row arange with masked pads). Enables the causal
+    tile skips (compute + DMA) that plain arange layouts get, while the
+    mask math still uses the explicit position arrays.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _flash_vjp(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        kv_mask, causal, float(scale),
+        kv_mask, causal, float(scale), slot_positions,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
 def _flash_vjp(
     q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-    kv_mask, causal, scale,
+    kv_mask, causal, scale, slot_positions,
 ):
     return _flash_attention_impl(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        kv_mask, causal, scale,
+        kv_mask, causal, scale, slot_positions=slot_positions,
     )[0]
 
 
 def _prepare(q, k, v, q_positions, kv_positions, q_segment_ids,
-             kv_segment_ids, kv_mask, causal, scale):
+             kv_segment_ids, kv_mask, causal, scale,
+             slot_positions=False):
     """Normalize/pad every operand to the kernel layouts. Returns the
     padded tensors plus the static flags shared by forward and backward."""
     B, Tq, Hq, D = q.shape
@@ -643,8 +651,8 @@ def _prepare(q, k, v, q_positions, kv_positions, q_segment_ids,
     Tq_p = _round_up(Tq, block_q)
     Tk_p = _round_up(Tk, block_k)
 
-    kv_arange = kv_positions is None
-    q_arange = q_positions is None
+    kv_arange = kv_positions is None or slot_positions
+    q_arange = q_positions is None or slot_positions
     if q_positions is None:
         q_positions = jnp.broadcast_to(jnp.arange(Tq, dtype=jnp.int32), (B, Tq))
     if kv_positions is None:
@@ -685,28 +693,28 @@ def _prepare(q, k, v, q_positions, kv_positions, q_segment_ids,
 
 def _flash_attention_impl(
     q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-    kv_mask, causal, scale, with_lse=False,
+    kv_mask, causal, scale, with_lse=False, slot_positions=False,
 ):
     padded, flags, Tq = _prepare(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        kv_mask, causal, scale,
+        kv_mask, causal, scale, slot_positions=slot_positions,
     )
     out, lse = _mha_forward(*padded, with_lse=with_lse, **flags)
     return out[:, :, :Tq].swapaxes(1, 2), lse
 
 
 def _fwd(q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-         kv_mask, causal, scale):
+         kv_mask, causal, scale, slot_positions):
     out, lse = _flash_attention_impl(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        kv_mask, causal, scale, with_lse=True,
+        kv_mask, causal, scale, with_lse=True, slot_positions=slot_positions,
     )
     res = (q, k, v, out, lse, q_positions, kv_positions, q_segment_ids,
            kv_segment_ids, kv_mask)
     return out, res
 
 
-def _bwd(causal, scale, res, g):
+def _bwd(causal, scale, slot_positions, res, g):
     """Flash backward: Pallas dq and dk/dv kernels using the saved
     logsumexp — O(T) memory (vs the O(T²) recompute fallback)."""
     (q, k, v, out, lse, q_positions, kv_positions, q_segment_ids,
@@ -715,7 +723,7 @@ def _bwd(causal, scale, res, g):
 
     padded, flags, _ = _prepare(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        kv_mask, causal, scale,
+        kv_mask, causal, scale, slot_positions=slot_positions,
     )
     qt = padded[0]
     Tq_p = qt.shape[2]

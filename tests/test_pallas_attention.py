@@ -214,3 +214,57 @@ def test_kv_cache_decode_multitile(monkeypatch):
         q, k, v, causal=True, q_positions=q_pos, kv_mask=kv_mask
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_slot_positions_padded_prefill(monkeypatch):
+    """slot_positions=True (training prefill layout: per-row arange
+    positions, right-padded, kv_mask) must match XLA while enabling the
+    causal tile skips — multi-tile to exercise the clamped index maps."""
+    from oryx_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_Q", 64)
+    monkeypatch.setattr(fa, "BLOCK_K", 64)
+    B, T = 2, 256
+    q, k, v = _qkv(jax.random.key(12), B, T, T, 4, 2, 32)
+    lengths = jnp.asarray([256, 140], jnp.int32)
+    kv_mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.int32)
+    # Per-row positions: arange on real slots, 0 on pads (build_mm_batch
+    # layout) — position == slot index wherever valid.
+    pos = jnp.where(
+        jnp.arange(T)[None, :] < lengths[:, None],
+        jnp.arange(T, dtype=jnp.int32)[None, :], 0,
+    )
+    ref = xla_attention(
+        q, k, v, causal=True, q_positions=pos, kv_positions=pos,
+        kv_mask=kv_mask,
+    )
+    got = flash_attention(
+        q, k, v, causal=True, q_positions=pos, kv_positions=pos,
+        kv_mask=kv_mask, slot_positions=True,
+    )
+    for b, n in enumerate([256, 140]):
+        np.testing.assert_allclose(
+            np.asarray(got)[b, :n], np.asarray(ref)[b, :n], atol=2e-5
+        )
+
+    # Gradients too: slot_positions reroutes BOTH backward kernels' skip
+    # logic (dq run bound over zeroed pad positions; dkv program-id skip
+    # with clamped q-side index maps). Pad rows masked out of the loss.
+    qmask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+
+    def loss(attn, **extra):
+        def f(q, k, v):
+            o = attn(
+                q, k, v, causal=True, q_positions=pos, kv_positions=pos,
+                kv_mask=kv_mask, **extra,
+            )
+            return jnp.sum((o * qmask[:, :, None, None]) ** 2)
+        return f
+
+    gf = jax.grad(loss(flash_attention, slot_positions=True),
+                  argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss(xla_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        )
