@@ -110,12 +110,32 @@ class TrainConfig:
     # logits per 8x2048 batch — chunking is what fits a 16 GB v5e.
     loss_chunk: int = 128
     # Which parameter groups train: "full", "projector_only" (stage-1
-    # pretraining of the compressor/projector), "no_vision".
+    # pretraining of the compressor/projector), "no_vision", "lora"
+    # (adapters + projector; requires lora.enable).
     tune: str = "full"
+    lora: "LoraConfig" = field(default_factory=lambda: LoraConfig())
     max_seq_len: int = 8192
     checkpoint_every: int = 500
     checkpoint_dir: str = "checkpoints"
     log_every: int = 10
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    """LoRA adapter training (the reference train.py's `lora_enable`
+    path). Adapters attach to the stacked decoder projections; base
+    weights freeze (tune='lora' selects lora_a/lora_b + projector)."""
+
+    enable: bool = False
+    r: int = 16
+    alpha: float = 32.0
+    # PEFT-compatible rank-stabilized scaling: alpha/sqrt(r) vs alpha/r.
+    use_rslora: bool = False
+    targets: tuple = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / (self.r**0.5 if self.use_rslora else self.r)
 
 
 @dataclass(frozen=True)
@@ -182,11 +202,23 @@ class OryxConfig:
 # Nested dataclass field types for from_dict, derived from type hints so
 # new nested-config fields are picked up automatically (string annotations
 # under `from __future__ import annotations` resolve fine at module level).
-_FIELD_TYPES = {
-    (OryxConfig, name): hint
-    for name, hint in typing.get_type_hints(OryxConfig).items()
-    if dataclasses.is_dataclass(hint)
-}
+# Collected recursively so arbitrarily nested configs (e.g.
+# TrainConfig.lora) round-trip as dataclasses, not dicts.
+def _collect_field_types(root):
+    out, stack, seen = {}, [root], set()
+    while stack:
+        tp = stack.pop()
+        if tp in seen:
+            continue
+        seen.add(tp)
+        for name, hint in typing.get_type_hints(tp).items():
+            if dataclasses.is_dataclass(hint):
+                out[(tp, name)] = hint
+                stack.append(hint)
+    return out
+
+
+_FIELD_TYPES = _collect_field_types(OryxConfig)
 
 
 # ---- Presets ---------------------------------------------------------------

@@ -411,9 +411,81 @@ def merge_lora_dir(params: Params, adapter_dir: str, cfg: LLMConfig) -> Params:
 
     with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
         acfg = json.load(f)
+    from oryx_tpu.config import LoraConfig
+
     r = int(acfg["r"])
-    alpha = float(acfg.get("lora_alpha", r))
-    # rsLoRA scales by alpha/sqrt(r) instead of alpha/r.
-    scaling = alpha / (r**0.5 if acfg.get("use_rslora") else r)
+    # Scaling formula (incl. rsLoRA's alpha/sqrt(r)) lives on LoraConfig.
+    scaling = LoraConfig(
+        r=r,
+        alpha=float(acfg.get("lora_alpha", r)),
+        use_rslora=bool(acfg.get("use_rslora")),
+    ).scaling
     sd_path = os.path.join(adapter_dir, "adapter_model.safetensors")
     return merge_lora(params, load_file(sd_path), cfg, scaling=scaling)
+
+
+# PEFT module scope per decoder projection (single source with
+# _LORA_TARGETS for what is adaptable at all).
+_LORA_SCOPE = {
+    "q_proj": "self_attn", "k_proj": "self_attn", "v_proj": "self_attn",
+    "o_proj": "self_attn", "gate_proj": "mlp", "up_proj": "mlp",
+    "down_proj": "mlp",
+}
+
+
+def export_lora(params: Params, lora) -> tuple[StateDict, dict]:
+    """Trained in-tree adapters → PEFT layout (the reverse of merge_lora):
+    per-layer `base_model.model.model.layers.{i}.<scope>.<proj>.lora_A/
+    lora_B.weight` in torch [r, in]/[out, r] orientation, plus an
+    adapter_config.json dict. `lora` is config.LoraConfig and must be the
+    config the adapters were created with — r and scaling are validated
+    against the params so the recorded adapter_config can never disagree
+    with the weights (a silent factor-of-sqrt(r) merge error otherwise)."""
+    sd: StateDict = {}
+    targets = []
+    for name, p in params["layers"].items():
+        if not (isinstance(p, dict) and "lora_a" in p):
+            continue
+        targets.append(name)
+        scope = _LORA_SCOPE[name]
+        a = np.asarray(jnp.asarray(p["lora_a"], jnp.float32))  # [L, in, r]
+        b = np.asarray(jnp.asarray(p["lora_b"], jnp.float32))  # [L, r, out]
+        if a.shape[2] != lora.r:
+            raise ValueError(
+                f"{name}: adapter rank {a.shape[2]} != lora.r {lora.r}"
+            )
+        scale_leaf = float(np.asarray(p["lora_scale"]).flat[0])
+        if abs(scale_leaf - lora.scaling) > 1e-6 * max(1.0, abs(scale_leaf)):
+            raise ValueError(
+                f"{name}: params lora_scale {scale_leaf} != config scaling "
+                f"{lora.scaling} (r/alpha/use_rslora mismatch)"
+            )
+        for i in range(a.shape[0]):
+            base = f"base_model.model.model.layers.{i}.{scope}.{name}"
+            # ascontiguousarray: safetensors serializes the raw buffer, so
+            # a transposed VIEW would be written with the wrong layout.
+            sd[f"{base}.lora_A.weight"] = np.ascontiguousarray(a[i].T)
+            sd[f"{base}.lora_B.weight"] = np.ascontiguousarray(b[i].T)
+    if not sd:
+        raise ValueError("params contain no LoRA adapters")
+    adapter_cfg = {
+        "peft_type": "LORA",
+        "r": int(lora.r),
+        "lora_alpha": float(lora.alpha),
+        "use_rslora": bool(lora.use_rslora),
+        "target_modules": sorted(targets),
+        "bias": "none",
+    }
+    return sd, adapter_cfg
+
+
+def export_lora_dir(params: Params, lora, out_dir: str) -> None:
+    """Write a PEFT adapter directory (adapter_config.json +
+    adapter_model.safetensors) loadable by merge_lora_dir / PEFT."""
+    from safetensors.numpy import save_file
+
+    sd, acfg = export_lora(params, lora)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump(acfg, f, indent=2)
+    save_file(sd, os.path.join(out_dir, "adapter_model.safetensors"))

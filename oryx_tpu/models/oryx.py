@@ -41,6 +41,21 @@ def init_params(cfg: OryxConfig, key: jax.Array, dtype=jnp.float32) -> Params:
     }
 
 
+def enable_lora(params: Params, cfg: OryxConfig, key: jax.Array) -> Params:
+    """Attach LoRA adapters to the decoder (reference `lora_enable`)."""
+    return {
+        **params,
+        "llm": qwen2.add_lora_params(
+            params["llm"], cfg.llm, cfg.train.lora, key
+        ),
+    }
+
+
+def merge_lora(params: Params) -> Params:
+    """Fold trained adapters into the decoder kernels for serving."""
+    return {**params, "llm": qwen2.merge_lora_params(params["llm"])}
+
+
 def encode_visual(
     params: Params,
     cfg: OryxConfig,

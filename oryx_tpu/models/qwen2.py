@@ -112,9 +112,70 @@ def _cache_write(cache_layer: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray)
 
 def _linear(x, p):
     y = x @ p["kernel"].astype(x.dtype)
+    if "lora_a" in p:
+        # Low-rank residual (W + scale·A·B)x; scale rides as a [1, 1]
+        # per-layer leaf so the stacked-layer scan slices it with the rest.
+        delta = (x @ p["lora_a"].astype(x.dtype)) @ p["lora_b"].astype(x.dtype)
+        y = y + delta * p["lora_scale"].astype(x.dtype)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
+
+
+def add_lora_params(
+    params: Params, cfg: LLMConfig, lora, key: jax.Array,
+    dtype: jnp.dtype = jnp.float32,
+) -> Params:
+    """Attach LoRA adapters to the stacked decoder projections.
+
+    Reference parity: train.py's `lora_enable` (PEFT LoraConfig on the
+    decoder projections). A ~ N(0, 0.02), B = 0 — the adapted model is
+    exactly the base model at step 0. `lora` is config.LoraConfig.
+    """
+    import copy
+
+    L = cfg.num_layers
+    layers = dict(params["layers"])
+    keys = iter(jax.random.split(key, len(lora.targets)))
+    for name in lora.targets:
+        if name not in layers:
+            raise ValueError(f"unknown LoRA target {name!r}")
+        p = dict(layers[name])
+        d_in, d_out = p["kernel"].shape[1], p["kernel"].shape[2]
+        p["lora_a"] = (
+            jax.random.normal(next(keys), (L, d_in, lora.r), jnp.float32)
+            * 0.02
+        ).astype(dtype)
+        p["lora_b"] = jnp.zeros((L, lora.r, d_out), dtype)
+        p["lora_scale"] = jnp.full((L, 1, 1), lora.scaling, dtype)
+        layers[name] = p
+    out = copy.copy(params)
+    out["layers"] = layers
+    return out
+
+
+def merge_lora_params(params: Params) -> Params:
+    """Fold trained adapters into the base kernels (for serving/export):
+    kernel += scale·A·B per layer; adapter leaves are dropped."""
+    import copy
+
+    layers = {}
+    for name, p in params["layers"].items():
+        if isinstance(p, dict) and "lora_a" in p:
+            p = dict(p)
+            delta = jnp.einsum(
+                "lir,lro->lio", p["lora_a"].astype(jnp.float32),
+                p["lora_b"].astype(jnp.float32),
+            ) * p["lora_scale"].astype(jnp.float32)
+            p["kernel"] = (
+                p["kernel"].astype(jnp.float32) + delta
+            ).astype(params["layers"][name]["kernel"].dtype)
+            for k_ in ("lora_a", "lora_b", "lora_scale"):
+                del p[k_]
+        layers[name] = p
+    out = copy.copy(params)
+    out["layers"] = layers
+    return out
 
 
 def _block(

@@ -41,8 +41,21 @@ def param_groups(params: Params) -> Params:
 
 
 def trainable_mask(params: Params, tune: str) -> Params:
-    """tune: 'full' | 'projector_only' | 'no_vision' (reference freeze
-    modes: full FT, stage-1 adapter pretraining, frozen vision tower)."""
+    """tune: 'full' | 'projector_only' | 'no_vision' | 'lora' (reference
+    freeze modes: full FT, stage-1 adapter pretraining, frozen vision
+    tower, LoRA adapters + projector with the base model frozen)."""
+    if tune == "lora":
+        def leaf_mask(path, _):
+            names = tuple(p.key for p in path if hasattr(p, "key"))
+            return (
+                bool(names)
+                and (
+                    names[-1] in ("lora_a", "lora_b")
+                    or names[0] == "compressor"
+                )
+            )
+
+        return jax.tree_util.tree_map_with_path(leaf_mask, params)
     groups = param_groups(params)
     allowed = {
         "full": {"llm", "projector", "vision"},

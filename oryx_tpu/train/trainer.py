@@ -43,6 +43,31 @@ class Trainer:
         with jax.sharding.set_mesh(self.mesh):
             if params is None:
                 params = oryx.init_params(cfg, jax.random.key(cfg.train.seed))
+            if cfg.train.tune == "lora" and not cfg.train.lora.enable:
+                raise ValueError(
+                    "tune='lora' requires train.lora.enable=True (otherwise "
+                    "no adapters exist and only the projector would train)"
+                )
+            if cfg.train.lora.enable:
+                if not cfg.train.lora.targets:
+                    raise ValueError("lora.enable with empty lora.targets")
+                layers = params["llm"]["layers"]
+                have = [
+                    t for t in cfg.train.lora.targets
+                    if "lora_a" in layers.get(t, {})
+                ]
+                if not have:
+                    # Attach adapters to the (fresh or pretrained) base
+                    # model; tune="lora" freezes all but A/B + projector.
+                    params = oryx.enable_lora(
+                        params, cfg, jax.random.key(cfg.train.seed + 1)
+                    )
+                elif set(have) != set(cfg.train.lora.targets):
+                    raise ValueError(
+                        f"params carry adapters on {sorted(have)} but "
+                        f"config targets {sorted(cfg.train.lora.targets)} "
+                        f"— refusing to train a silently narrower adapter"
+                    )
             self.tx = make_optimizer(cfg.train, params)
             pspecs = sharding.param_shardings(self.mesh, params, sharding_mode)
             params = sharding.shard_params(params, pspecs)
