@@ -160,6 +160,13 @@ def attention(
             qc, k, v, q_positions=qp, q_segment_ids=qs, **kwargs
         )
 
+    # checkpoint: without it reverse-mode saves every chunk's probs —
+    # O(Tq·Tk) residuals, exactly the memory this path exists to avoid
+    # (451 GB at the 131072-patch long-video bucket). Recompute per chunk
+    # in backward instead (flash-style tradeoff). prevent_cse barriers are
+    # unnecessary under lax.map/scan.
+    body = jax.checkpoint(body, prevent_cse=False)
+
     # Sequential over chunks: peak memory = one chunk's logits.
     outs = jax.lax.map(
         body, (split_q(q), split_q(q_positions), split_q(q_segment_ids))
