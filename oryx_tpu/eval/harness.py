@@ -23,6 +23,7 @@ import json
 import os
 import re
 import string
+import sys
 import time
 from typing import Any, Sequence
 
@@ -176,8 +177,64 @@ def evaluate(
     return EvalResult(acc, correct, len(mine), dt, out)
 
 
+def merge_results(results: Sequence[EvalResult]) -> EvalResult:
+    """Merge per-process shard results (the reference's accelerate-split
+    eval aggregation): accuracy re-derived from summed counts, wall time =
+    max over processes (they run concurrently), records concatenated."""
+    if not results:
+        raise ValueError("no results to merge")
+    correct = sum(r.num_correct for r in results)
+    total = sum(r.num_total for r in results)
+    return EvalResult(
+        accuracy=correct / max(total, 1),
+        num_correct=correct,
+        num_total=total,
+        seconds=max(r.seconds for r in results),
+        records=[rec for r in results for rec in r.records],
+    )
+
+
+def _print_summary(result: EvalResult) -> None:
+    print(json.dumps({
+        "accuracy": result.accuracy, "n": result.num_total,
+        "seconds": round(result.seconds, 1),
+    }))
+
+
+def _write_output(result: EvalResult, path: str) -> None:
+    outdir = os.path.dirname(os.path.abspath(path))
+    os.makedirs(outdir, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(result.to_dict(), f, indent=2)
+
+
 def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # Merge mode is parsed by a dedicated pre-parser so --merge=FILE and
+    # abbreviations work, and any flag it doesn't know is an error rather
+    # than silently dropped.
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--merge", nargs="+", default=None)
+    pre.add_argument("--output", default=None)
+    pre_args, rest = pre.parse_known_args(argv)
+    if pre_args.merge is not None:
+        if rest:
+            raise SystemExit(
+                f"unrecognized arguments with --merge: {rest}"
+            )
+        merged = merge_results([
+            EvalResult(**json.load(open(p))) for p in pre_args.merge
+        ])
+        _print_summary(merged)
+        if pre_args.output:
+            _write_output(merged, pre_args.output)
+        return
+
     ap = argparse.ArgumentParser(description="Oryx-TPU benchmark eval")
+    ap.add_argument(
+        "--merge", nargs="+", default=None, metavar="RESULTS_JSON",
+        help="merge per-process result files (from --output) and exit",
+    )
     ap.add_argument("--model-path", required=True)
     ap.add_argument("--tokenizer-path", default=None)
     ap.add_argument("--task", required=True, help="task .json/.jsonl file")
@@ -209,15 +266,9 @@ def main(argv: list[str] | None = None) -> None:
         max_new_tokens=args.max_new_tokens, batch_size=args.batch_size,
         process_index=args.process_index, process_count=args.process_count,
     )
-    print(json.dumps({
-        "accuracy": result.accuracy, "n": result.num_total,
-        "seconds": round(result.seconds, 1),
-    }))
+    _print_summary(result)
     if args.output:
-        outdir = os.path.dirname(os.path.abspath(args.output))
-        os.makedirs(outdir, exist_ok=True)
-        with open(args.output, "w") as f:
-            json.dump(result.to_dict(), f, indent=2)
+        _write_output(result, args.output)
 
 
 if __name__ == "__main__":

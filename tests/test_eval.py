@@ -168,3 +168,47 @@ def test_adapters_mvbench_and_unknown():
     assert adapters.adapt("native", recs) == recs
     with pytest.raises(ValueError):
         adapters.adapt("nope", recs)
+
+
+def test_merge_results():
+    a = harness.EvalResult(0.5, 2, 4, 10.0, [{"id": 0}, {"id": 2}])
+    b = harness.EvalResult(1.0, 3, 3, 12.0, [{"id": 1}])
+    m = harness.merge_results([a, b])
+    assert m.num_correct == 5 and m.num_total == 7
+    assert m.accuracy == pytest.approx(5 / 7)
+    assert m.seconds == 12.0
+    assert len(m.records) == 3
+    with pytest.raises(ValueError):
+        harness.merge_results([])
+
+
+def test_merge_cli(tmp_path, capsys):
+    import dataclasses as dc
+
+    a = harness.EvalResult(0.5, 1, 2, 3.0, [{"id": 0}])
+    b = harness.EvalResult(1.0, 2, 2, 4.0, [{"id": 1}])
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    for p, r in ((pa, a), (pb, b)):
+        with open(p, "w") as f:
+            json.dump(dc.asdict(r), f)
+    harness.main(["--merge", pa, pb])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n"] == 4 and out["accuracy"] == pytest.approx(0.75)
+
+
+def test_merge_cli_equals_form_and_output(tmp_path, capsys):
+    import dataclasses as dc
+
+    a = harness.EvalResult(1.0, 2, 2, 1.0, [{"id": 0}, {"id": 1}])
+    pa = str(tmp_path / "a.json")
+    with open(pa, "w") as f:
+        json.dump(dc.asdict(a), f)
+    out_path = str(tmp_path / "nested" / "merged.json")
+    harness.main([f"--merge={pa}", "--output", out_path])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n"] == 2
+    with open(out_path) as f:
+        merged = json.load(f)
+    assert len(merged["records"]) == 2
+    with pytest.raises(SystemExit):
+        harness.main(["--merge", pa, "--bogus-flag"])
