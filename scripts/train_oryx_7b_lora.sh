@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Oryx-7B LoRA SFT (reference-equivalent: train.py --lora_enable True
+# --lora_r 128 --lora_alpha 256, decoder projections adapted, base model
+# frozen, projector co-trained; SURVEY.md §2 "Training entry"). LoRA
+# shrinks trainable/optimizer state to the adapters, so this fits fewer
+# chips than full FT. Merge for serving via models/oryx.merge_lora or
+# export a PEFT adapter dir via models/import_hf.export_lora_dir.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:?path to conversation-records json}
+TOKENIZER=${TOKENIZER:?path to Qwen2 tokenizer dir}
+HF_LLM=${HF_LLM:-}          # HF safetensors dir (Qwen2-7B-Instruct)
+HF_VISION=${HF_VISION:-}    # HF safetensors dir (SigLIP-family tower)
+
+python -m oryx_tpu.train.cli \
+  --config scripts/configs/oryx_7b_sft_lora.json \
+  --data "$DATA" \
+  --tokenizer-path "$TOKENIZER" \
+  ${HF_LLM:+--hf-llm "$HF_LLM"} \
+  ${HF_VISION:+--hf-vision "$HF_VISION"} \
+  --sharding fsdp \
+  --metrics-path logs/oryx7b_lora_metrics.jsonl \
+  --output-dir models/oryx7b-sft-lora \
+  "$@"
