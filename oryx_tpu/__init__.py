@@ -18,6 +18,7 @@ from oryx_tpu.config import (  # noqa: F401
     MeshConfig,
     TrainConfig,
     GenerationConfig,
+    LoraConfig,
     oryx_7b,
     oryx_34b,
     oryx_tiny,
