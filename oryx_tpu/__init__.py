@@ -21,5 +21,7 @@ from oryx_tpu.config import (  # noqa: F401
     LoraConfig,
     oryx_7b,
     oryx_34b,
+    oryx_1_5_7b,
+    oryx_1_5_32b,
     oryx_tiny,
 )

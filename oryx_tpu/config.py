@@ -245,6 +245,33 @@ def yi_34b() -> LLMConfig:
     )
 
 
+def qwen2_5_7b() -> LLMConfig:
+    """Qwen2.5-7B-Instruct geometry (Oryx-1.5-7B backbone).
+
+    Tensor-identical to Qwen2-7B (same hidden/intermediate/layers/GQA/
+    vocab/bias); kept as a named preset so Oryx-1.5 configs say what they
+    mean and survive any future divergence.
+    """
+    return LLMConfig()
+
+
+def qwen2_5_32b() -> LLMConfig:
+    """Qwen2.5-32B-Instruct geometry (Oryx-1.5-32B backbone)."""
+    return LLMConfig(
+        vocab_size=152064,
+        hidden_size=5120,
+        intermediate_size=27648,
+        num_layers=64,
+        num_heads=40,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1_000_000.0,
+        rms_norm_eps=1e-5,
+        max_position_embeddings=32768,
+        attention_bias=True,
+    )
+
+
 def tiny_llm(vocab_size: int = 512) -> LLMConfig:
     """Tiny geometry for tests (CPU-fast, GQA exercised)."""
     return LLMConfig(
@@ -279,6 +306,16 @@ def oryx_7b() -> OryxConfig:
 
 def oryx_34b() -> OryxConfig:
     return OryxConfig(llm=yi_34b())
+
+
+def oryx_1_5_7b() -> OryxConfig:
+    """Oryx-1.5-7B: Qwen2.5-7B backbone, same vision/compressor stack."""
+    return OryxConfig(llm=qwen2_5_7b())
+
+
+def oryx_1_5_32b() -> OryxConfig:
+    """Oryx-1.5-32B: Qwen2.5-32B backbone, same vision/compressor stack."""
+    return OryxConfig(llm=qwen2_5_32b())
 
 
 def oryx_tiny() -> OryxConfig:

@@ -98,10 +98,34 @@ def from_mlvu(
 from_mvbench = from_mlvu
 
 
+def from_nextqa(
+    recs: list[dict[str, Any]], *, video_root: str = "", video_ext: str = ".mp4"
+) -> list[dict[str, Any]]:
+    """NExT-QA multiple-choice annotations (the CSV rows of val.csv /
+    test.csv): option texts in columns a0..a4, integer `answer` index,
+    videos addressed by numeric `video` id."""
+    out = []
+    for r in recs:
+        opts = [str(r[f"a{i}"]) for i in range(5) if f"a{i}" in r]
+        vid = str(r["video"])
+        video = vid if vid.endswith(video_ext) else vid + video_ext
+        out.append({
+            "id": f"{vid}_{r.get('qid', len(out))}",
+            "question": r["question"],
+            "options": opts,
+            # CSV rows arrive as strings; the answer column is the index.
+            "answer": LETTERS[int(r["answer"])],
+            "video": os.path.join(video_root, video) if video_root else video,
+            "meta": {k: r[k] for k in ("type", "frame_count") if k in r},
+        })
+    return out
+
+
 ADAPTERS: dict[str, Callable[..., list[dict[str, Any]]]] = {
     "videomme": from_videomme,
     "mlvu": from_mlvu,
     "mvbench": from_mvbench,
+    "nextqa": from_nextqa,
 }
 
 

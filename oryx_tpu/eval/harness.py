@@ -5,7 +5,7 @@ harness (VideoMME, MLVU, MVBench, NextQA, ...; SURVEY.md §1 L7, §3.5) — an
 adapter wraps the §3.2 inference stack and the harness aggregates accuracy,
 optionally splitting the dataset across ranks with each rank running an
 independent replica. This module is that harness, standalone: a task is a
-JSON/JSONL file of records
+JSON/JSONL (or CSV, e.g. NextQA's annotations) file of records
 
     {"id": ..., "question": ..., "options": ["...", ...] | null,
      "answer": "B" | "<free text>", "image": path|[paths] | "video": path}
@@ -36,10 +36,16 @@ MCQ_SUFFIX = "Answer with the option's letter from the given choices directly."
 
 
 def load_task(path: str) -> list[dict[str, Any]]:
-    """Load a task file: .jsonl (one record per line) or .json (list)."""
-    with open(path) as f:
+    """Load a task file: .jsonl (one record per line), .json (list), or
+    .csv (header row → dict per row; NextQA ships its MC annotations as
+    CSV)."""
+    with open(path, newline="") as f:
         if path.endswith(".jsonl"):
             return [json.loads(line) for line in f if line.strip()]
+        if path.endswith(".csv"):
+            import csv
+
+            return list(csv.DictReader(f))
         recs = json.load(f)
     if not isinstance(recs, list):
         raise ValueError(f"{path}: expected a list of records")
@@ -237,10 +243,12 @@ def main(argv: list[str] | None = None) -> None:
     )
     ap.add_argument("--model-path", required=True)
     ap.add_argument("--tokenizer-path", default=None)
-    ap.add_argument("--task", required=True, help="task .json/.jsonl file")
+    ap.add_argument(
+        "--task", required=True, help="task .json/.jsonl/.csv file"
+    )
     ap.add_argument(
         "--format", default="native",
-        help="task record format: native|videomme|mlvu|mvbench",
+        help="task record format: native|videomme|mlvu|mvbench|nextqa",
     )
     ap.add_argument("--media-root", default="")
     ap.add_argument("--num-frames", type=int, default=64)

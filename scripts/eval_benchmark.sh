@@ -6,7 +6,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 MODEL=${MODEL:?path to oryx_tpu model dir}
-TASK=${TASK:?task .json/.jsonl file}
+TASK=${TASK:?task .json/.jsonl/.csv file}
 
 python -m oryx_tpu.eval.harness \
   --model-path "$MODEL" \

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Oryx-1.5-32B (Qwen2.5-32B backbone) SFT on a v5e-64 pod: fsdp=64 +
+# grad accum. The reference's Oryx-1.5 series swaps the backbone to
+# Qwen2.5 (7B/32B) with the same vision/compressor stack and training
+# recipe (SURVEY.md §2b "ZeRO-3 for 34B/long-video" applies unchanged).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA=${DATA:?path to conversation-records json}
+TOKENIZER=${TOKENIZER:?path to Qwen2.5 tokenizer dir}
+HF_LLM=${HF_LLM:-}
+HF_VISION=${HF_VISION:-}
+
+python -m oryx_tpu.train.cli \
+  --config scripts/configs/oryx_1_5_32b_sft.json \
+  --data "$DATA" \
+  --tokenizer-path "$TOKENIZER" \
+  ${HF_LLM:+--hf-llm "$HF_LLM"} \
+  ${HF_VISION:+--hf-vision "$HF_VISION"} \
+  --sharding fsdp \
+  --metrics-path logs/oryx1_5_32b_metrics.jsonl \
+  --output-dir models/oryx1_5_32b-sft \
+  "$@"

@@ -170,6 +170,28 @@ def test_adapters_mvbench_and_unknown():
         adapters.adapt("nope", recs)
 
 
+def test_adapters_nextqa_csv(tmp_path):
+    from oryx_tpu.eval import adapters
+
+    csv_path = tmp_path / "val.csv"
+    csv_path.write_text(
+        "video,frame_count,width,height,question,answer,qid,type,"
+        "a0,a1,a2,a3,a4\n"
+        "3238737531,1528,640,480,how do the two man play the instrument,"
+        "1,6,CH,roll the handle,tap their feet,strum the string,"
+        "hit with sticks,pat with hand\n"
+    )
+    recs = harness.load_task(str(csv_path))
+    out = adapters.adapt("nextqa", recs, video_root="/data/nextqa")
+    r = out[0]
+    assert r["id"] == "3238737531_6"
+    assert r["answer"] == "B"
+    assert len(r["options"]) == 5
+    assert r["options"][2] == "strum the string"
+    assert r["video"] == "/data/nextqa/3238737531.mp4"
+    assert r["meta"]["type"] == "CH"
+
+
 def test_merge_results():
     a = harness.EvalResult(0.5, 2, 4, 10.0, [{"id": 0}, {"id": 2}])
     b = harness.EvalResult(1.0, 3, 3, 12.0, [{"id": 1}])
