@@ -173,6 +173,25 @@ def _bench_cfg(backend: str, hbm_bytes: int):
         # Pallas flash attention on the real chip; portable XLA path on CPU.
         attn_impl="pallas" if backend == "tpu" else "xla",
     )
+    # Remat policy (utils/remat.py), BENCH_REMAT_POLICY = none|block|
+    # dots|attn. TPU default "attn": saving the flash outputs + lse
+    # (~0.7 GB at this geometry) skips the kernel recompute in the
+    # backward — measured +4% step time over "block" on v5e, while
+    # "dots" exceeds HBM by ~5 GB (TPU_VALIDATION.md).
+    pol = os.environ.get(
+        "BENCH_REMAT_POLICY", "attn" if cfg.attn_impl == "pallas" else ""
+    )
+    if pol:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg,
+            train=dataclasses.replace(
+                cfg.train,
+                remat=pol != "none",
+                remat_policy=pol if pol != "none" else "block",
+            ),
+        )
     return geo_name, cfg, batch_size, seq_bucket, img_patches_side
 
 

@@ -65,7 +65,7 @@ def encode_visual(
     region_ids: jnp.ndarray,
     q_region_ids: jnp.ndarray,
     *,
-    remat: bool = False,
+    remat: bool | str = False,
     compute_dtype=None,
 ) -> jnp.ndarray:
     """Packed patches → packed LLM-space visual embeddings [Q, H_llm].
@@ -100,7 +100,7 @@ def forward(
     is_visual: jnp.ndarray,
     attn_mask: jnp.ndarray,
     positions: jnp.ndarray,
-    remat: bool = False,
+    remat: bool | str = False,
     mesh=None,
     compute_dtype=None,
     logits_dtype=jnp.float32,

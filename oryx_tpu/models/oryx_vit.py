@@ -25,6 +25,7 @@ from oryx_tpu.config import VisionConfig
 from oryx_tpu.ops.attention import attention
 from oryx_tpu.ops.norms import layer_norm
 from oryx_tpu.parallel.sharding import constrain
+from oryx_tpu.utils.remat import wrap_remat
 
 Params = dict[str, Any]
 
@@ -109,7 +110,7 @@ def forward(
     segment_ids: jnp.ndarray,
     pos_coords: jnp.ndarray,
     *,
-    remat: bool = False,
+    remat: bool | str = False,
     attn_impl: str = "xla",
     compute_dtype: jnp.dtype | None = None,
 ) -> jnp.ndarray:
@@ -171,8 +172,7 @@ def forward(
         h = h + _linear(x, lp["fc2"])
         return constrain(h, *pk_spec), None
 
-    if remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+    body = wrap_remat(body, remat)
     h, _ = jax.lax.scan(body, h, params["layers"])
 
     h = layer_norm(

@@ -30,6 +30,7 @@ from oryx_tpu.ops.attention import attention
 from oryx_tpu.ops.norms import rms_norm
 from oryx_tpu.ops.rope import apply_rope, rope_cos_sin
 from oryx_tpu.parallel.sharding import constrain
+from oryx_tpu.utils.remat import wrap_remat
 
 Params = dict[str, Any]
 
@@ -239,7 +240,7 @@ def forward(
     kv_cache: Params | None = None,
     write_slots: jnp.ndarray | None = None,
     kv_mask: jnp.ndarray | None = None,
-    remat: bool = False,
+    remat: bool | str = False,
     attn_impl: str = "xla",
     mesh=None,
     sp_axis: str = "sp",
@@ -338,8 +339,7 @@ def forward(
         h = constrain(h, *hs_spec)
         return h, (ck, cv) if kv_cache is not None else None
 
-    if remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+    body = wrap_remat(body, remat)
 
     if kv_cache is not None:
         xs = (params["layers"], kv_cache["k"], kv_cache["v"])

@@ -32,6 +32,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -44,6 +45,33 @@ NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 # the 256/1024 variants on the bench shapes. Env-overridable for sweeps.
 BLOCK_Q = int(os.environ.get("ORYX_FLASH_BLOCK_Q", "512"))
 BLOCK_K = int(os.environ.get("ORYX_FLASH_BLOCK_K", "512"))
+# Backward kernels take independent tile sizes: the dq/dkv kernels
+# stream three extra operands (do, lse, Δ) per tile and accumulate into
+# VMEM scratch, so their DMA/compute balance differs. On-chip (v5e,
+# TPU_VALIDATION.md) 1024×1024 backward tiles beat the 512×512 forward
+# tiling by ~2-3% of attention fwd+bwd at both T=2048 and T=4096;
+# shorter/indivisible sequences fall back to the forward tiling
+# (_bwd_block). Env: unset → the 1024 default; 0 → None = inherit the
+# forward value AT CALL TIME; any other value → itself.
+def _bwd_env(name: str) -> int | None:
+    raw = os.environ.get(name)
+    if raw is None:
+        return 1024
+    return int(raw) or None
+
+
+BWD_BLOCK_Q = _bwd_env("ORYX_FLASH_BWD_BLOCK_Q")
+BWD_BLOCK_K = _bwd_env("ORYX_FLASH_BWD_BLOCK_K")
+
+
+def _bwd_block(pref: int | None, fwd: int, T: int) -> int:
+    """Backward tile size: the preferred bwd block when set and dividing
+    the padded length (which was padded to FORWARD-block multiples), else
+    fall back to the forward choice (always a divisor)."""
+    if pref is None:
+        return min(fwd, T)
+    b = min(pref, T)
+    return b if T % b == 0 else min(fwd, T)
 
 
 def _causal_kv_clamp(block_q: int, block_k: int, enabled: bool):
@@ -452,8 +480,8 @@ def _mha_backward(
     B, Hq, Tq, D = q.shape
     _, Hk, Tk, _ = k.shape
     G = Hq // Hk
-    block_q = min(BLOCK_Q, Tq)
-    block_k = min(BLOCK_K, Tk)
+    block_q = _bwd_block(BWD_BLOCK_Q, BLOCK_Q, Tq)
+    block_k = _bwd_block(BWD_BLOCK_K, BLOCK_K, Tk)
     nq = Tq // block_q
     nk = Tk // block_k
 
@@ -709,6 +737,12 @@ def _fwd(q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
         kv_mask, causal, scale, with_lse=True, slot_positions=slot_positions,
     )
+    # Under block remat, a policy that saves these names (utils/remat.py
+    # "attn") keeps the kernel output + softmax stats across the forward,
+    # so the backward's block recompute reuses them instead of re-running
+    # the forward kernel — the single most expensive recomputed op.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     res = (q, k, v, out, lse, q_positions, kv_positions, q_segment_ids,
            kv_segment_ids, kv_mask)
     return out, res

@@ -377,6 +377,22 @@ class PrefetchIterator:
         self.close()
 
 
+def length_estimate(rec: dict[str, Any]) -> int:
+    """Cheap per-record token-length proxy WITHOUT loading media (the
+    reference Trainer's `lengths` property: whitespace token count plus a
+    flat per-visual allowance). Only relative order matters — it drives
+    length grouping, not allocation."""
+    n = sum(
+        len(m.get("value", "").split()) for m in rec.get("conversations", ())
+    )
+    if rec.get("video") is not None:
+        n += 1024  # frames × tokens/frame / 16x compression, order-of
+    else:
+        img = rec.get("image")
+        n += 729 * (len(img) if isinstance(img, (list, tuple)) else 1 if img else 0)
+    return n
+
+
 def grouped_batch_iterator(
     dataset: SupervisedDataset,
     batch_size: int,
@@ -386,16 +402,23 @@ def grouped_batch_iterator(
     process_index: int = 0,
     process_count: int = 1,
     grad_accum_steps: int = 1,
+    length_group_size: int = 8,
     **collate_kw,
 ) -> Iterator[dict[str, np.ndarray]]:
-    """Modality-grouped, shuffled, per-process-sharded batch stream.
+    """Modality- and length-grouped, shuffled, per-process-sharded batches.
 
-    The reference's modality-grouped LengthGroupedSampler: indices are
-    shuffled within modality groups so image and video samples never share
-    a batch (their compression ratios and shapes differ wildly), then
-    round-robined across processes (host-side data sharding, SURVEY.md
-    §2c(c)). Per-modality tails smaller than batch_size carry over to the
-    next epoch (and are reshuffled into it) so no modality is starved.
+    The reference's modality-grouped LengthGroupedSampler
+    (`oryx/train/oryx_trainer.py`, SURVEY.md §2 "Trainer subclass"):
+    indices are shuffled within modality groups so image and video
+    samples never share a batch (their compression ratios and shapes
+    differ wildly); within a modality, shuffled indices are chunked into
+    megabatches of `length_group_size` × batch_size and sorted by
+    `length_estimate` so same-batch samples have similar lengths — less
+    bucket padding per batch while staying stochastic across epochs
+    (length_group_size=0/1 disables). Batches are then round-robined
+    across processes (host-side data sharding, SURVEY.md §2c(c)).
+    Per-modality tails smaller than batch_size carry over to the next
+    epoch (and are reshuffled into it) so no modality is starved.
 
     With grad_accum_steps > 1, each yielded dict has a leading [accum, ...]
     axis from `collate_microbatches` and batch_size counts samples per
@@ -406,6 +429,13 @@ def grouped_batch_iterator(
     for i in range(len(dataset)):
         by_mod.setdefault(record_modality(dataset.records[i]), []).append(i)
     leftover: dict[str, list[int]] = {m: [] for m in by_mod}
+    # Length proxies computed once (the reference Trainer's one-shot
+    # `lengths` property), not per epoch inside the sort key.
+    lengths = (
+        [length_estimate(r) for r in dataset.records]
+        if length_group_size > 1
+        else None
+    )
 
     epoch = 0
     while num_epochs is None or epoch < num_epochs:
@@ -413,6 +443,17 @@ def grouped_batch_iterator(
         for mod, idxs in by_mod.items():
             idxs = leftover[mod] + list(idxs)
             rng.shuffle(idxs)
+            if length_group_size > 1:
+                mega = batch_size * length_group_size
+                idxs = [
+                    i
+                    for j in range(0, len(idxs), mega)
+                    for i in sorted(
+                        idxs[j : j + mega],
+                        key=lengths.__getitem__,
+                        reverse=True,
+                    )
+                ]
             full = len(idxs) - len(idxs) % batch_size
             for j in range(0, full, batch_size):
                 batches.append(idxs[j : j + batch_size])

@@ -1,0 +1,53 @@
+"""Rematerialization (gradient-checkpointing) policy selection.
+
+The reference gets one knob — HF `gradient_checkpointing=True`, i.e.
+recompute everything per decoder block (SURVEY.md §2b "Gradient
+checkpointing"). On TPU the memory/FLOPs trade is tunable: XLA can save
+the MXU (matmul) outputs and recompute only the cheap elementwise/VPU
+work, buying back most of the remat recompute FLOPs wherever HBM has
+headroom. `wrap_remat` is used by every scan-block body (decoder, ViT).
+
+Policies:
+  * False / "none" — no checkpointing: all intermediates saved (fastest
+    backward, highest memory).
+  * True / "block" — `jax.checkpoint` of the whole block: only the block
+    inputs survive the forward; everything is recomputed in the backward
+    (the reference-equivalent default).
+  * "dots" — checkpoint with `checkpoint_dots`: matmul outputs are saved,
+    elementwise ops recomputed. ~the activation memory of "none" minus
+    fusion temporaries, but the backward skips all MXU recompute.
+  * "attn" — save only the flash-attention kernel outputs + logsumexp
+    (named "flash_out"/"flash_lse" in ops/pallas/flash_attention._fwd):
+    a thin slice of "dots" costing ~2 bytes/token/layer/head-dim that
+    spares the backward from re-running the forward attention kernel —
+    the most expensive single op in a block recompute.
+"""
+
+from __future__ import annotations
+
+import jax
+
+POLICIES = ("none", "block", "dots", "attn")
+
+
+def wrap_remat(body, remat: bool | str):
+    """Wrap a scan-step body per the remat policy (see module docstring)."""
+    if remat in (False, None, "none"):
+        return body
+    if remat in (True, "block"):
+        return jax.checkpoint(body, prevent_cse=False)
+    if remat == "dots":
+        return jax.checkpoint(
+            body,
+            prevent_cse=False,
+            policy=jax.checkpoint_policies.checkpoint_dots,
+        )
+    if remat == "attn":
+        return jax.checkpoint(
+            body,
+            prevent_cse=False,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"
+            ),
+        )
+    raise ValueError(f"unknown remat policy {remat!r}; have {POLICIES}")

@@ -150,6 +150,36 @@ def test_grouped_iterator_modality_and_leftover_carry():
     assert len(batches) == 2 + 1 + 3 + 2
 
 
+def test_grouped_iterator_length_grouping():
+    """Within a modality, megabatches sort by length_estimate so batches
+    hold similar-length samples; every index still appears exactly once."""
+
+    class _Recording(_StubDataset):
+        def __init__(self, mods):
+            super().__init__(mods)
+            self.seen = []
+
+        def __getitem__(self, i):
+            self.seen.append(i)
+            return super().__getitem__(i)
+
+    ds = _Recording(["image"] * 8)
+    # Distinct text lengths 1..8 words (visual allowance is constant).
+    for i, rec in enumerate(ds.records):
+        rec["conversations"] = [{"from": "human", "value": "w " * (i + 1)}]
+    for _ in data_lib.grouped_batch_iterator(
+        ds, 2, seed=0, num_epochs=1, length_group_size=4,  # one megabatch
+        buckets=(64, 256), base_grid=8,
+    ):
+        pass
+    assert sorted(ds.seen) == list(range(8))
+    # One epoch = one megabatch = globally sorted desc by length: each
+    # 2-sample batch is a contiguous descending (idx i has length i+1).
+    batches = [ds.seen[j : j + 2] for j in range(0, 8, 2)]
+    for b in batches:
+        assert b[0] == b[1] + 1
+
+
 def test_grouped_iterator_accum_layout():
     ds = _StubDataset(["image"] * 8)
     it = data_lib.grouped_batch_iterator(

@@ -118,6 +118,8 @@ def test_gradients_multitile_gqa_mask(monkeypatch):
 
     monkeypatch.setattr(fa, "BLOCK_Q", 64)
     monkeypatch.setattr(fa, "BLOCK_K", 64)
+    monkeypatch.setattr(fa, "BWD_BLOCK_Q", None)  # inherit 64x64 so the
+    monkeypatch.setattr(fa, "BWD_BLOCK_K", None)  # backward stays multi-tile
     B, T = 2, 160
     q, k, v = _qkv(jax.random.key(6), B, T, T, 4, 2, 16)
     lengths = jnp.asarray([160, 90], jnp.int32)
@@ -143,12 +145,47 @@ def test_gradients_multitile_gqa_mask(monkeypatch):
         )
 
 
+def test_gradients_distinct_bwd_blocks(monkeypatch):
+    """Backward tiling decoupled from forward tiling (ORYX_FLASH_BWD_*):
+    fwd 64x64 tiles, bwd 128x32 — parity must hold across the remapped
+    causal clamps and GQA reduction."""
+    from oryx_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_Q", 64)
+    monkeypatch.setattr(fa, "BLOCK_K", 64)
+    monkeypatch.setattr(fa, "BWD_BLOCK_Q", 128)
+    monkeypatch.setattr(fa, "BWD_BLOCK_K", 32)
+    q, k, v = _qkv(jax.random.key(11), 2, 256, 256, 4, 2, 16)
+
+    def loss(attn):
+        def f(q, k, v):
+            return jnp.sum(attn(q, k, v, causal=True) ** 2)
+        return f
+
+    gp = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss(xla_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-3
+        )
+    # A bwd block that does not divide the padded length falls back to
+    # the forward tiling rather than failing to lower.
+    monkeypatch.setattr(fa, "BWD_BLOCK_K", 96)
+    gp2 = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp2, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-3
+        )
+
+
 def test_gradients_segments(monkeypatch):
     """Backward with segment ids (packed-ViT layout), non-causal."""
     from oryx_tpu.ops.pallas import flash_attention as fa
 
     monkeypatch.setattr(fa, "BLOCK_Q", 64)
     monkeypatch.setattr(fa, "BLOCK_K", 64)
+    monkeypatch.setattr(fa, "BWD_BLOCK_Q", None)  # inherit 64x64 so the
+    monkeypatch.setattr(fa, "BWD_BLOCK_K", None)  # backward stays multi-tile
     P, H, D = 128, 4, 16
     q, k, v = _qkv(jax.random.key(7), 1, P, P, H, H, D)
     seg = np.zeros(P, np.int32)
@@ -200,6 +237,8 @@ def test_kv_cache_decode_multitile(monkeypatch):
 
     monkeypatch.setattr(fa, "BLOCK_Q", 64)
     monkeypatch.setattr(fa, "BLOCK_K", 64)
+    monkeypatch.setattr(fa, "BWD_BLOCK_Q", None)  # inherit 64x64 so the
+    monkeypatch.setattr(fa, "BWD_BLOCK_K", None)  # backward stays multi-tile
     B, S, Hq, Hk, D = 2, 512, 4, 2, 32
     q, k, v = _qkv(jax.random.key(11), B, 8, S, Hq, Hk, D)
     cur_len = jnp.asarray([400, 210], jnp.int32)
@@ -224,6 +263,8 @@ def test_slot_positions_padded_prefill(monkeypatch):
 
     monkeypatch.setattr(fa, "BLOCK_Q", 64)
     monkeypatch.setattr(fa, "BLOCK_K", 64)
+    monkeypatch.setattr(fa, "BWD_BLOCK_Q", None)  # inherit 64x64 so the
+    monkeypatch.setattr(fa, "BWD_BLOCK_K", None)  # backward stays multi-tile
     B, T = 2, 256
     q, k, v = _qkv(jax.random.key(12), B, T, T, 4, 2, 32)
     lengths = jnp.asarray([256, 140], jnp.int32)
