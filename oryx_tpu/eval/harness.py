@@ -257,16 +257,31 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--process-index", type=int, default=0)
     ap.add_argument("--process-count", type=int, default=1)
+    ap.add_argument(
+        "--shard", default=None, metavar="MODE=N",
+        help="multi-chip serving (tp=8 / fsdp=8) for models that exceed "
+        "one chip; combine with --process-* to also split the dataset "
+        "across hosts",
+    )
     args = ap.parse_args(argv)
 
     from oryx_tpu.serve.builder import load_pretrained_model
 
+    from oryx_tpu.parallel.mesh import parse_shard_arg
+
+    try:
+        mesh, mode = parse_shard_arg(args.shard)
+    except ValueError as e:
+        ap.error(str(e))
+
     tokenizer, params, cfg = load_pretrained_model(
-        args.model_path, tokenizer_path=args.tokenizer_path
+        args.model_path, tokenizer_path=args.tokenizer_path,
+        mesh=mesh, sharding_mode=mode,
     )
     from oryx_tpu.eval.adapters import adapt
 
-    pipe = OryxInference(tokenizer, params, cfg)
+    pipe = OryxInference(tokenizer, params, cfg, mesh=mesh,
+                         sharding_mode=mode)
     records = adapt(args.format, load_task(args.task))
     result = evaluate(
         pipe, records,

@@ -57,6 +57,20 @@ def build_hybrid_mesh(cfg: MeshConfig, *, num_slices: int) -> Mesh:
     return Mesh(dev, AXES)
 
 
+def parse_shard_arg(arg: str | None) -> tuple[Mesh | None, str]:
+    """CLI `--shard MODE=N` (e.g. "tp=8", "fsdp=8") → (mesh, mode) for
+    multi-chip serving; (None, "tp") when arg is None. Shared by the
+    serve and eval CLIs so validation lives once."""
+    if arg is None:
+        return None, "tp"
+    mode, sep, n = arg.partition("=")
+    if mode not in ("tp", "fsdp") or not sep or not n.isdigit() or int(n) < 1:
+        raise ValueError(
+            f"--shard expects tp=N or fsdp=N with N >= 1, got {arg!r}"
+        )
+    return build_mesh(MeshConfig(**{mode: int(n)})), mode
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -62,6 +62,80 @@ def load_tokenizer(model_path: str):
     return AutoTokenizer.from_pretrained(model_path, use_fast=True)
 
 
+def serving_param_shardings(mesh, params_like: Any, mode: str = "tp"):
+    """Inference-time placement over a mesh (the reference's 34B
+    `device_map` across 8 GPUs, SURVEY.md §2 "Model builder"):
+
+      "tp"    weights split over attention heads / MLP columns (tp axis);
+              embeddings/norms replicated — decode-friendly, no per-layer
+              weight gathers.
+      "fsdp"  memory-sharded over the fsdp axis (ZeRO-3-style); each
+              layer's weights are all-gathered when used.
+
+    params_like may be concrete or abstract (ShapeDtypeStructs).
+    """
+    from oryx_tpu.parallel import sharding as sharding_lib
+
+    rules_mode = {"tp": "zero2", "fsdp": "fsdp"}.get(mode)
+    if rules_mode is None:
+        raise ValueError(f"unknown serving sharding mode {mode!r}: tp|fsdp")
+    return sharding_lib.param_shardings(mesh, params_like, rules_mode)
+
+
+def _serving_restore_target(meta, cfg: OryxConfig, mesh, mode: str, dtype):
+    """Map checkpoint METADATA (bare params or a full TrainState) to an
+    orbax restore target that pulls ONLY the model weights, sharded
+    straight onto their serving devices: param leaves become abstract
+    arrays with serving shardings (no host-RAM or single-device copy of
+    a 34B tree); TrainState extras (optimizer moments, step) become
+    `ocp.PLACEHOLDER` and are never read. The dtype override applies to
+    floating leaves only."""
+    import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import numpy as np
+
+    from oryx_tpu.models import oryx
+
+    params_shape = jax.eval_shape(
+        lambda: oryx.init_params(cfg, jax.random.key(0))
+    )
+    specs = serving_param_shardings(mesh, params_shape, mode)
+    flat_specs = [
+        (tuple(str(p) for p in path), s.spec)
+        for path, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: hasattr(x, "spec")
+        )[0]
+    ]
+    meta_paths = [
+        tuple(str(p) for p in path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(meta)[0]
+    ]
+    # TrainState-shaped checkpoints carry the weights under a top-level
+    # "params" node; bare-params checkpoints ARE the weights (their top
+    # level is llm/vit/compressor).
+    state_shaped = any("params" in keys[0] for keys in meta_paths)
+
+    def build(path, leaf):
+        keys = tuple(str(p) for p in path)
+        wanted = "params" in keys[0] if state_shaped else True
+        if not wanted:
+            return ocp.PLACEHOLDER
+        spec = P()
+        for ppath, s in flat_specs:
+            if keys[-len(ppath):] == ppath and len(leaf.shape) == len(s):
+                spec = s
+                break
+        d = leaf.dtype
+        if dtype is not None and np.issubdtype(leaf.dtype, np.floating):
+            d = dtype
+        return jax.ShapeDtypeStruct(
+            leaf.shape, d, sharding=NamedSharding(mesh, spec)
+        )
+
+    return jax.tree_util.tree_map_with_path(build, meta), state_shaped
+
+
 def load_pretrained_model(
     model_path: str,
     *,
@@ -69,11 +143,18 @@ def load_pretrained_model(
     tokenizer: Any | None = None,
     cfg: OryxConfig | None = None,
     dtype=jnp.float32,
+    mesh=None,
+    sharding_mode: str = "tp",
 ) -> tuple[Any, Params, OryxConfig]:
     """Load (tokenizer, params, cfg) from an oryx_tpu model directory.
 
     tokenizer_path defaults to model_path; pass the HF backbone dir when the
     model dir carries no tokenizer files, or inject `tokenizer` directly.
+
+    mesh: when given, params are restored SHARDED over it per
+    `serving_param_shardings(mode=sharding_mode)` — required for models
+    that exceed one chip (34B-class serving); pass the same mesh to
+    `OryxInference`.
     """
     cfg_file = os.path.join(model_path, CONFIG_NAME)
     if cfg is None:
@@ -90,16 +171,25 @@ def load_pretrained_model(
         raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir}")
     mgr = ckpt_lib.CheckpointManager(ckpt_dir)
     try:
-        # Restore the checkpoint's own structure (orbax rejects a target
-        # tree that is a strict subtree, so a bare-params abstract target
-        # would fail on TrainState-shaped checkpoints), then take params.
-        restored = mgr.restore()
+        if mesh is None:
+            # Restore the checkpoint's own structure (orbax rejects a
+            # target tree that is a strict subtree, so a bare-params
+            # abstract target would fail on TrainState-shaped
+            # checkpoints).
+            restored = mgr.restore()
+            cast = lambda x: jnp.asarray(x, dtype)  # noqa: E731
+        else:
+            target, _ = _serving_restore_target(
+                mgr.metadata(), cfg, mesh, sharding_mode, dtype
+            )
+            restored = mgr.restore_partial(target)
+            cast = lambda x: x  # dtype applied in the restore target
     finally:
         mgr.close()
-    # Accept both bare-params and TrainState-shaped checkpoints.
+    # Both checkpoint shapes: take the weights subtree of a TrainState.
     if isinstance(restored, dict) and "params" in restored:
         restored = restored["params"]
-    params = jax.tree.map(lambda x: jnp.asarray(x, dtype), restored)
+    params = jax.tree.map(cast, restored)
 
     if tokenizer is None:
         tokenizer = load_tokenizer(tokenizer_path or model_path)

@@ -18,7 +18,15 @@ def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="Oryx-TPU inference")
     ap.add_argument("--model-path", required=True)
     ap.add_argument("--tokenizer-path", default=None)
-    ap.add_argument("--question", required=True)
+    ap.add_argument(
+        "--question", default=None,
+        help="one-shot question (omit with --interactive)",
+    )
+    ap.add_argument(
+        "--interactive", action="store_true",
+        help="multi-turn REPL over the given media (reference CLI loop); "
+        "':reset' clears history, ':q' exits",
+    )
     ap.add_argument("--image", action="append", default=[],
                     help="image path (repeatable)")
     ap.add_argument("--video", default=None,
@@ -26,27 +34,68 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--num-frames", type=int, default=64)
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--template", default="qwen")
+    ap.add_argument(
+        "--shard", default=None, metavar="MODE=N",
+        help="multi-chip serving over all visible devices, e.g. tp=8 or "
+        "fsdp=8 (34B-class models; the reference's device_map analog)",
+    )
     args = ap.parse_args(argv)
+    if args.question is None and not args.interactive:
+        ap.error("--question is required unless --interactive")
 
     from oryx_tpu.serve.builder import load_pretrained_model
-    from oryx_tpu.serve.pipeline import OryxInference
+    from oryx_tpu.serve.pipeline import ChatSession, OryxInference
+
+    from oryx_tpu.parallel.mesh import parse_shard_arg
+
+    try:
+        mesh, mode = parse_shard_arg(args.shard)
+    except ValueError as e:
+        ap.error(str(e))
 
     tokenizer, params, cfg = load_pretrained_model(
-        args.model_path, tokenizer_path=args.tokenizer_path
+        args.model_path, tokenizer_path=args.tokenizer_path,
+        mesh=mesh, sharding_mode=mode,
     )
-    pipe = OryxInference(tokenizer, params, cfg, template=args.template)
+    pipe = OryxInference(
+        tokenizer, params, cfg, template=args.template,
+        mesh=mesh, sharding_mode=mode,
+    )
 
     if args.video is not None:
-        frames = media.load_video_frames(args.video, args.num_frames)
-        answer = pipe.chat_video(
-            frames, args.question, max_new_tokens=args.max_new_tokens
-        )
+        images = media.load_video_frames(args.video, args.num_frames)
+        is_video = True
     else:
         images = [media.load_image(p) for p in args.image]
-        answer = pipe.chat(
-            args.question, images=images or None,
-            max_new_tokens=args.max_new_tokens,
-        )
+        is_video = False
+
+    if args.interactive:
+        session = ChatSession(pipe, images=images, is_video=is_video)
+        if args.question:
+            print(f"user: {args.question}")
+            print(f"assistant: {session.ask(args.question, max_new_tokens=args.max_new_tokens)}")
+        while True:
+            try:
+                q = input("user: ").strip()
+            except EOFError:
+                break
+            if q in (":q", ":quit", ":exit"):
+                break
+            if q == ":reset":
+                session.reset()
+                continue
+            if not q:
+                continue
+            print(
+                "assistant: "
+                + session.ask(q, max_new_tokens=args.max_new_tokens)
+            )
+        return
+
+    answer = pipe.chat(
+        args.question, images=images or None, is_video=is_video,
+        max_new_tokens=args.max_new_tokens,
+    )
     print(answer)
 
 

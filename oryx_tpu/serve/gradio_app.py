@@ -25,25 +25,55 @@ def build_app(pipe, *, num_frames: int = 64):
 
     import numpy as np
 
-    def answer(image, video, question):
+    def answer(image, video, question, history, session):
+        """Multi-turn chat. Media are captured from the widgets at the
+        conversation's FIRST turn and pinned in `session` for the rest of
+        it — the prompt attaches placeholders to turn one, so honoring a
+        mid-conversation widget change would bind new media to a past
+        turn that never saw them. Start a new conversation to switch
+        media."""
+        history = history or []
         if not question:
-            return "Please enter a question."
-        if video is not None:
-            from oryx_tpu.data import media
+            return history, "", session
+        if session is None:  # first turn: capture media
+            if video is not None:
+                from oryx_tpu.data import media
 
-            frames = media.load_video_frames(video, num_frames)
-            return pipe.chat_video(frames, question)
-        images = [np.asarray(image)] if image is not None else None
-        return pipe.chat(question, images=images)
+                session = {
+                    "images": media.load_video_frames(video, num_frames),
+                    "is_video": True,
+                }
+            elif image is not None:
+                session = {"images": [np.asarray(image)], "is_video": False}
+            else:
+                session = {"images": None, "is_video": False}
+        reply = pipe.chat(
+            question, images=session["images"],
+            is_video=session["is_video"],
+            history=[tuple(t) for t in history],
+        )
+        return history + [(question, reply)], "", session
 
     with gr.Blocks(title="Oryx-TPU") as app:
         gr.Markdown("# Oryx-TPU — image / video QA")
+        gr.Markdown(
+            "Media are read at the first question of a conversation; "
+            "press *New conversation* to ask about different media."
+        )
         with gr.Row():
             image = gr.Image(label="Image", type="numpy")
             video = gr.Video(label="Video (or frames dir)")
+        chat = gr.Chatbot(label="Conversation")
+        session = gr.State(None)
         question = gr.Textbox(label="Question")
-        out = gr.Textbox(label="Answer")
-        gr.Button("Ask").click(answer, [image, video, question], out)
+        with gr.Row():
+            gr.Button("Ask").click(
+                answer, [image, video, question, chat, session],
+                [chat, question, session],
+            )
+            gr.Button("New conversation").click(
+                lambda: ([], None), [], [chat, session]
+            )
     return app
 
 

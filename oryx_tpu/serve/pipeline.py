@@ -75,10 +75,25 @@ class OryxInference:
         cfg: OryxConfig,
         *,
         template: str = "qwen",
+        mesh=None,
+        sharding_mode: str = "tp",
     ) -> None:
         self.tokenizer = tokenizer
-        self.params = params
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            # Multi-chip serving (the reference's 34B device_map): place
+            # params per the serving shardings (no-op for params already
+            # restored sharded by builder.load_pretrained_model(mesh=...))
+            # and run every device call under this mesh so GSPMD inserts
+            # the collectives.
+            from oryx_tpu.parallel.sharding import shard_params
+            from oryx_tpu.serve.builder import serving_param_shardings
+
+            params = shard_params(
+                params, serving_param_shardings(mesh, params, sharding_mode)
+            )
+        self.params = params
         self.conv = conv_templates[template]
         # In-loop stop matching (KeywordsStoppingCriteria parity): rows end
         # as soon as the template's stop string is emitted instead of
@@ -87,14 +102,36 @@ class OryxInference:
             [self.conv.stop_str] if self.conv.stop_str else [], tokenizer
         )
 
+    def _mesh_scope(self):
+        from contextlib import nullcontext
+
+        return (
+            jax.sharding.set_mesh(self.mesh)
+            if self.mesh is not None
+            else nullcontext()
+        )
+
     # ---- host-side prompt/media prep ------------------------------------
 
-    def build_prompt(self, question: str, num_media: int) -> str:
+    def build_prompt(
+        self,
+        question: str,
+        num_media: int,
+        history: Sequence[tuple[str, str]] | None = None,
+    ) -> str:
         """Conversation-templated prompt with one `<image>` placeholder per
-        media item prepended to the user turn (reference README style)."""
+        media item prepended to the FIRST user turn (reference multi-turn
+        CLI style: media ride with the opening message, later turns are
+        text against the same visual context)."""
         conv = self.conv.copy()
         prefix = (DEFAULT_IMAGE_TOKEN + "\n") * num_media
-        conv.append_message(conv.roles[0], prefix + question)
+        turns = list(history or [])
+        for i, (user, assistant) in enumerate(turns):
+            conv.append_message(conv.roles[0], (prefix if i == 0 else "") + user)
+            conv.append_message(conv.roles[1], assistant)
+        conv.append_message(
+            conv.roles[0], question if turns else prefix + question
+        )
         conv.append_message(conv.roles[1], None)
         return conv.get_prompt()
 
@@ -106,15 +143,19 @@ class OryxInference:
         *,
         images: Sequence[np.ndarray] | None = None,
         is_video: bool = False,
+        history: Sequence[tuple[str, str]] | None = None,
         max_new_tokens: int | None = None,
         seed: int = 0,
     ) -> str:
-        """Single-turn QA over optional images / video frames."""
+        """QA over optional images / video frames. history: prior
+        (user, assistant) turns of the same conversation (media stay
+        attached to the first turn)."""
         return self.chat_batch(
             [{
                 "question": question,
                 "images": list(images or []),
                 "is_video": is_video,
+                "history": list(history or []),
             }],
             max_new_tokens=max_new_tokens,
             seed=seed,
@@ -149,6 +190,7 @@ class OryxInference:
             prompt = self.build_prompt(
                 req["question"],
                 (1 if is_video else len(images)) if images else 0,
+                history=req.get("history"),
             )
             ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
             if is_video and len(images) > 1:
@@ -181,11 +223,12 @@ class OryxInference:
             max_patches=max_patches,
         )
         batch = splice.build_mm_batch(ids_rows, splice.query_slots(packed))
-        toks, num = oryx.mm_generate(
-            self.params, self.cfg, packed, batch,
-            max_new_tokens=max_new, key=key,
-            stop_sequences=self.stop_sequences,
-        )
+        with self._mesh_scope():
+            toks, num = oryx.mm_generate(
+                self.params, self.cfg, packed, batch,
+                max_new_tokens=max_new, key=key,
+                stop_sequences=self.stop_sequences,
+            )
         return [self._decode(toks[b], int(num[b])) for b in range(len(toks))]
 
     def _text_batch(self, ids_rows, max_new: int, key) -> list[str]:
@@ -197,10 +240,12 @@ class OryxInference:
             rows[b, : len(ids)] = ids
             lengths[b] = len(ids)
         cache_len = packing.round_up_bucket(T + max_new)
-        toks, num = _jit_text_generate(
-            self.params, self.cfg, jnp.asarray(rows), jnp.asarray(lengths),
-            max_new, cache_len, key, self.stop_sequences,
-        )
+        with self._mesh_scope():
+            toks, num = _jit_text_generate(
+                self.params, self.cfg, jnp.asarray(rows),
+                jnp.asarray(lengths), max_new, cache_len, key,
+                self.stop_sequences,
+            )
         toks, num = np.asarray(toks), np.asarray(num)
         return [self._decode(toks[b], int(num[b])) for b in range(B)]
 
@@ -229,3 +274,32 @@ class OryxInference:
         if stop and stop in text:
             text = text.split(stop)[0]
         return text.strip()
+
+
+class ChatSession:
+    """Stateful multi-turn conversation over one media context (the
+    reference's interactive CLI loop: media attach to the first turn,
+    every later question re-prefills against the accumulated history)."""
+
+    def __init__(
+        self,
+        pipe: OryxInference,
+        *,
+        images: Sequence[np.ndarray] | None = None,
+        is_video: bool = False,
+    ) -> None:
+        self.pipe = pipe
+        self.images = list(images or [])
+        self.is_video = is_video and bool(self.images)
+        self.history: list[tuple[str, str]] = []
+
+    def ask(self, question: str, **kw) -> str:
+        reply = self.pipe.chat(
+            question, images=self.images, is_video=self.is_video,
+            history=self.history, **kw,
+        )
+        self.history.append((question, reply))
+        return reply
+
+    def reset(self) -> None:
+        self.history.clear()

@@ -31,6 +31,10 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True,
                 enable_async_checkpointing=True,
             ),
+            # Register the handler up front so `item_metadata` works on a
+            # fresh manager (without it, metadata() returns None until a
+            # save has happened in-process).
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
@@ -53,8 +57,53 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(state_like)
         )
 
+    def restore_partial(self, target: Any, step: int | None = None) -> Any:
+        """Restore only the non-PLACEHOLDER leaves of `target` (abstract
+        arrays, optionally with shardings so shards land straight on
+        their devices); `ocp.PLACEHOLDER` leaves are never read from
+        disk. The Standard handler rejects placeholders, so this goes
+        through the underlying PyTree layer."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        path = os.path.join(self.directory, str(step), "default")
+
+        # PyTreeRestore takes placement from restore_args, NOT from the
+        # target's ShapeDtypeStruct.sharding (which it silently ignores,
+        # restoring with the save-time sharding instead).
+        def rargs(leaf):
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return ocp.ArrayRestoreArgs(
+                    sharding=leaf.sharding, global_shape=leaf.shape,
+                    dtype=leaf.dtype,
+                )
+            return ocp.RestoreArgs()
+
+        return ocp.PyTreeCheckpointer().restore(
+            path,
+            args=ocp.args.PyTreeRestore(
+                item=target, restore_args=jax.tree.map(rargs, target)
+            ),
+        )
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def metadata(self, step: int | None = None) -> Any:
+        """Saved-tree structure as abstract leaves (shape/dtype, no data)
+        — the basis for building a sharded restore target without ever
+        materializing the checkpoint on host."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        meta = self._mgr.item_metadata(step)
+        if meta is None:
+            raise RuntimeError(
+                f"no item metadata for step {step} in {self.directory}"
+            )
+        return meta
 
     def wait(self) -> None:
         """Block until pending async saves finish."""
