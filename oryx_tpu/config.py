@@ -103,6 +103,10 @@ class TrainConfig:
     global_batch_size: int = 128
     grad_accum_steps: int = 1
     num_train_steps: int = 1000
+    # Length-grouped batching within modality groups (reference
+    # LengthGroupedSampler): megabatches of this many batches sort by a
+    # per-record length proxy before splitting; 0/1 disables.
+    length_group_size: int = 8
     seed: int = 0
     remat: bool = True  # gradient checkpointing (see remat_policy)
     # What remat saves when enabled (utils/remat.py): "block" recomputes

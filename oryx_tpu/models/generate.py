@@ -179,3 +179,151 @@ def generate(
         jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
     )
     return toks, num.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Streaming
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "gen_cfg", "cache_len", "attn_impl", "compute_dtype",
+        "stop_L",
+    ),
+)
+def _stream_prefill(
+    params, cfg: LLMConfig, gen_cfg: GenerationConfig, inputs_embeds,
+    lengths, key, *, cache_len: int, attn_impl: str, compute_dtype,
+    stop_L: int,
+):
+    B, T, _ = inputs_embeds.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+    kv_mask = (slot_ar < lengths[:, None]).astype(jnp.int32)
+    cache = qwen2.init_kv_cache(
+        cfg, B, cache_len, dtype=compute_dtype or jnp.float32
+    )
+    logits, cache = qwen2.forward(
+        params, cfg,
+        inputs_embeds=inputs_embeds, positions=positions,
+        kv_cache=cache, write_slots=jnp.zeros((B,), jnp.int32),
+        kv_mask=kv_mask, attn_impl=attn_impl, compute_dtype=compute_dtype,
+    )
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    key, sk = jax.random.split(key)
+    tok0 = sample_token(
+        last, sk, temperature=gen_cfg.temperature, top_p=gen_cfg.top_p,
+        top_k=gen_cfg.top_k,
+    )
+    recent0 = jnp.full((B, stop_L), -2, jnp.int32)
+    return (cache, tok0, lengths, jnp.zeros((B,), bool), recent0), key
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "gen_cfg", "cache_len", "attn_impl", "compute_dtype",
+        "chunk",
+    ),
+    donate_argnames=("carry",),
+)
+def _stream_chunk(
+    params, cfg: LLMConfig, gen_cfg: GenerationConfig, carry, key,
+    stop_sequences, *, cache_len: int, attn_impl: str, compute_dtype,
+    chunk: int,
+):
+    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+
+    def stop_hit(recent):
+        if stop_sequences is None:
+            return jnp.zeros((recent.shape[0],), bool)
+        m = (stop_sequences[None] == -1) | (
+            recent[:, None, :] == stop_sequences[None]
+        )
+        return jnp.any(jnp.all(m, axis=-1), axis=-1)
+
+    def step(carry, step_key):
+        cache, tok, cur_len, finished, recent = carry
+        pos = cur_len[:, None]
+        kv_mask = (slot_ar <= cur_len[:, None]).astype(jnp.int32)
+        logits, cache = qwen2.forward(
+            params, cfg,
+            input_ids=tok[:, None], positions=pos,
+            kv_cache=cache, write_slots=cur_len,
+            kv_mask=kv_mask, attn_impl=attn_impl,
+            compute_dtype=compute_dtype,
+        )
+        nxt = sample_token(
+            logits[:, 0], step_key, temperature=gen_cfg.temperature,
+            top_p=gen_cfg.top_p, top_k=gen_cfg.top_k,
+        )
+        if recent.shape[1]:
+            recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
+        finished = (
+            finished | (tok == gen_cfg.eos_token_id) | stop_hit(recent)
+        )
+        nxt = jnp.where(finished, gen_cfg.eos_token_id, nxt)
+        return (cache, nxt, cur_len + 1, finished, recent), (tok, finished)
+
+    key, sub = jax.random.split(key)
+    carry, (toks, fin) = jax.lax.scan(
+        init=carry, f=step, xs=jax.random.split(sub, chunk)
+    )
+    return carry, jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1), key
+
+
+def generate_stream(
+    params,
+    cfg: LLMConfig,
+    gen_cfg: GenerationConfig,
+    *,
+    inputs_embeds: jnp.ndarray,
+    lengths: jnp.ndarray,
+    max_new_tokens: int,
+    cache_len: int,
+    key: jax.Array | None = None,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+    stop_sequences: jnp.ndarray | None = None,
+    chunk: int = 8,
+):
+    """Streaming twin of `generate` (HF TextIteratorStreamer parity):
+    yields np int32 token blocks [B, <=chunk] as they decode, with the
+    same semantics (EOS fill after finish, stop sequences end rows).
+    The decode runs WHOLE `chunk`-token compiled dispatches (a shrunken
+    final chunk would compile a second decode program); overshoot
+    tokens past max_new_tokens are computed and dropped, so cache_len
+    must cover T + ceil(max_new/chunk)*chunk. Larger chunks amortize
+    host round-trips, smaller ones lower first-token latency.
+    """
+    padded_new = -(-max_new_tokens // chunk) * chunk
+    assert cache_len >= inputs_embeds.shape[1] + padded_new, (
+        cache_len, inputs_embeds.shape[1], padded_new
+    )
+    if key is None:
+        key = jax.random.key(0)
+    stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
+    common = dict(
+        cache_len=cache_len, attn_impl=attn_impl,
+        compute_dtype=compute_dtype,
+    )
+    carry, key = _stream_prefill(
+        params, cfg, gen_cfg, inputs_embeds, lengths, key,
+        stop_L=stop_L, **common,
+    )
+    done = 0
+    while done < max_new_tokens:
+        carry, toks, fin, key = _stream_chunk(
+            params, cfg, gen_cfg, carry, key, stop_sequences,
+            chunk=chunk, **common,
+        )
+        n = min(chunk, max_new_tokens - done)
+        toks, fin = np.asarray(toks)[:, :n], np.asarray(fin)[:, :n]
+        yield toks
+        done += n
+        if fin[:, -1].all():
+            break

@@ -128,6 +128,22 @@ def forward(
     return out
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def mm_embeds(params, cfg: OryxConfig, arrays):
+    """Visual encode + splice only → [B, T, H] decoder inputs (the
+    prefill half of `mm_generate`; used by the streaming decode path)."""
+    vis = encode_visual(
+        params, cfg,
+        arrays["patches"], arrays["segment_ids"], arrays["pos_coords"],
+        arrays["region_ids"], arrays["q_region_ids"],
+        compute_dtype=_dtype(cfg),
+    )
+    return splice.embed_spliced(
+        params["llm"]["embed"]["weight"], vis,
+        arrays["token_ids"], arrays["visual_idx"], arrays["is_visual"],
+    )
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "cache_len"))
 def _jit_mm_generate(
     params, cfg: OryxConfig, arrays, max_new_tokens: int, cache_len: int,

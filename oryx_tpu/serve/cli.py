@@ -71,9 +71,18 @@ def main(argv: list[str] | None = None) -> None:
 
     if args.interactive:
         session = ChatSession(pipe, images=images, is_video=is_video)
+
+        def answer(q: str) -> None:
+            print("assistant: ", end="", flush=True)
+            for delta in session.ask_stream(
+                q, max_new_tokens=args.max_new_tokens
+            ):
+                print(delta, end="", flush=True)
+            print()
+
         if args.question:
             print(f"user: {args.question}")
-            print(f"assistant: {session.ask(args.question, max_new_tokens=args.max_new_tokens)}")
+            answer(args.question)
         while True:
             try:
                 q = input("user: ").strip()
@@ -86,10 +95,7 @@ def main(argv: list[str] | None = None) -> None:
                 continue
             if not q:
                 continue
-            print(
-                "assistant: "
-                + session.ask(q, max_new_tokens=args.max_new_tokens)
-            )
+            answer(q)
         return
 
     answer = pipe.chat(

@@ -161,6 +161,41 @@ class OryxInference:
             seed=seed,
         )[0]
 
+    def _prepare_request(
+        self, req: dict[str, Any]
+    ) -> tuple[np.ndarray, list[np.ndarray], list[int], list[int]]:
+        """One request dict → (token ids with per-frame sentinels, raw
+        images, per-image side factors, per-image patch caps). The single
+        source of the prep policy for batch AND streaming paths."""
+        cfgv = self.cfg.vision
+        images = list(req.get("images") or [])
+        is_video = bool(req.get("is_video")) and len(images) > 0
+        modality = infer_modality(len(images), is_video)
+        prompt = self.build_prompt(
+            req["question"],
+            (1 if is_video else len(images)) if images else 0,
+            history=req.get("history"),
+        )
+        ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
+        if is_video and len(images) > 1:
+            idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
+            ids = np.concatenate(
+                [ids[:idx],
+                 np.full(len(images), IMAGE_TOKEN_INDEX, ids.dtype),
+                 ids[idx + 1:]]
+            )
+        if not images:
+            return ids, [], [], []
+        per_img_cap = (
+            max(1, cfgv.max_patches_per_image // len(images))
+            if modality == MODALITY_VIDEO
+            else cfgv.max_patches_per_image
+        )
+        factor = int(COMPRESSOR_RATIO[modality] ** 0.5)
+        return (
+            ids, images, [factor] * len(images), [per_img_cap] * len(images)
+        )
+
     def chat_batch(
         self,
         requests: Sequence[dict[str, Any]],
@@ -178,47 +213,24 @@ class OryxInference:
         """
         max_new = max_new_tokens or self.cfg.generation.max_new_tokens
         key = jax.random.key(seed)
-        cfgv = self.cfg.vision
         all_images: list[np.ndarray] = []
         side_factors: list[int] = []
         max_patches: list[int] = []
         ids_rows: list[np.ndarray] = []
         for req in requests:
-            images = list(req.get("images") or [])
-            is_video = bool(req.get("is_video")) and len(images) > 0
-            modality = infer_modality(len(images), is_video)
-            prompt = self.build_prompt(
-                req["question"],
-                (1 if is_video else len(images)) if images else 0,
-                history=req.get("history"),
-            )
-            ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
-            if is_video and len(images) > 1:
-                idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
-                ids = np.concatenate(
-                    [ids[:idx],
-                     np.full(len(images), IMAGE_TOKEN_INDEX, ids.dtype),
-                     ids[idx + 1:]]
-                )
+            ids, images, factors, caps = self._prepare_request(req)
             ids_rows.append(ids)
-            if images:
-                per_img_cap = (
-                    max(1, cfgv.max_patches_per_image // len(images))
-                    if modality == MODALITY_VIDEO
-                    else cfgv.max_patches_per_image
-                )
-                factor = int(COMPRESSOR_RATIO[modality] ** 0.5)
-                all_images.extend(images)
-                side_factors.extend([factor] * len(images))
-                max_patches.extend([per_img_cap] * len(images))
+            all_images.extend(images)
+            side_factors.extend(factors)
+            max_patches.extend(caps)
 
         if not all_images:
             return self._text_batch(ids_rows, max_new, key)
 
         packed = packing.pack_raw_images(
             all_images,
-            patch_size=cfgv.patch_size,
-            base_grid=cfgv.base_grid,
+            patch_size=self.cfg.vision.patch_size,
+            base_grid=self.cfg.vision.base_grid,
             side_factors=side_factors,
             max_patches=max_patches,
         )
@@ -248,6 +260,117 @@ class OryxInference:
             )
         toks, num = np.asarray(toks), np.asarray(num)
         return [self._decode(toks[b], int(num[b])) for b in range(B)]
+
+    def chat_stream(
+        self,
+        question: str,
+        *,
+        images: Sequence[np.ndarray] | None = None,
+        is_video: bool = False,
+        history: Sequence[tuple[str, str]] | None = None,
+        max_new_tokens: int | None = None,
+        seed: int = 0,
+        chunk: int = 8,
+    ):
+        """Streaming `chat` (HF TextIteratorStreamer parity): yields text
+        DELTAS as tokens decode; ''.join(deltas) equals chat()'s reply
+        exactly (incomplete UTF-8 tails, stop-string prefixes and
+        leading/trailing whitespace are held back until resolvable).
+        Single request; decode runs `chunk` tokens per device dispatch.
+        """
+        max_new = max_new_tokens or self.cfg.generation.max_new_tokens
+        key = jax.random.key(seed)
+        cfgv = self.cfg.vision
+        ids, images, factors, caps = self._prepare_request({
+            "question": question, "images": list(images or []),
+            "is_video": is_video, "history": list(history or []),
+        })
+
+        if images:
+            packed = packing.pack_raw_images(
+                images,
+                patch_size=cfgv.patch_size,
+                base_grid=cfgv.base_grid,
+                side_factors=factors,
+                max_patches=caps,
+            )
+            batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+            arrays = {
+                "patches": jnp.asarray(packed.patches),
+                "segment_ids": jnp.asarray(packed.segment_ids),
+                "pos_coords": jnp.asarray(packed.pos_coords),
+                "region_ids": jnp.asarray(packed.region_ids),
+                "q_region_ids": jnp.asarray(packed.q_region_ids),
+                "token_ids": jnp.asarray(batch.token_ids),
+                "visual_idx": jnp.asarray(batch.visual_idx),
+                "is_visual": jnp.asarray(batch.is_visual),
+            }
+            with self._mesh_scope():
+                embeds = oryx.mm_embeds(self.params, self.cfg, arrays)
+            lengths = jnp.asarray(batch.lengths)
+        else:
+            T = packing.round_up_bucket(len(ids))
+            rows = np.zeros((1, T), np.int32)
+            rows[0, : len(ids)] = ids
+            with self._mesh_scope():
+                embeds = self.params["llm"]["embed"]["weight"][
+                    jnp.asarray(rows)
+                ]
+            lengths = jnp.asarray([len(ids)], np.int32)
+
+        # Decode always runs whole chunks (a shrunken final chunk would
+        # compile a second decode program); overshoot tokens are dropped
+        # and the cache is sized for the padded length.
+        padded_new = -(-max_new // chunk) * chunk
+        cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
+        eos = self.cfg.generation.eos_token_id
+        stop = self.conv.stop_str
+        emitted: list[int] = []
+        text_done = ""
+        finished = False
+
+        def stable_prefix(text: str) -> str:
+            """The prefix of `text` that can never change as more tokens
+            decode: hold back an incomplete UTF-8 tail (U+FFFD), any
+            suffix that could grow into the stop string, and leading/
+            trailing whitespace (chat() strips both ends; lstrip is
+            consistent across calls, rstripped text re-emits once
+            non-whitespace follows)."""
+            text = text.lstrip()
+            while text.endswith("�"):
+                text = text[:-1]
+            if stop:
+                for i in range(len(stop) - 1, 0, -1):
+                    if text.endswith(stop[:i]):
+                        text = text[: len(text) - i]
+                        break
+            return text.rstrip()
+
+        with self._mesh_scope():
+            for block in generate_lib.generate_stream(
+                self.params["llm"], self.cfg.llm, self.cfg.generation,
+                inputs_embeds=embeds, lengths=lengths,
+                max_new_tokens=max_new, cache_len=cache_len, key=key,
+                attn_impl=self.cfg.attn_impl,
+                compute_dtype=oryx.compute_dtype(self.cfg),
+                stop_sequences=self.stop_sequences, chunk=chunk,
+            ):
+                for t in block[0]:
+                    if int(t) == eos:
+                        finished = True
+                        break
+                    emitted.append(int(t))
+                text = self.tokenizer.decode(
+                    emitted, skip_special_tokens=True
+                )
+                if stop and stop in text:
+                    text, finished = text.split(stop)[0], True
+                safe = text.strip() if finished else stable_prefix(text)
+                if len(safe) > len(text_done):
+                    yield safe[len(text_done):]
+                    text_done = safe
+                if finished:
+                    return
 
     def chat_video(
         self,
@@ -300,6 +423,18 @@ class ChatSession:
         )
         self.history.append((question, reply))
         return reply
+
+    def ask_stream(self, question: str, **kw):
+        """Streamed `ask`: yields text deltas; records the turn in
+        history once the stream is consumed."""
+        parts: list[str] = []
+        for delta in self.pipe.chat_stream(
+            question, images=self.images, is_video=self.is_video,
+            history=self.history, **kw,
+        ):
+            parts.append(delta)
+            yield delta
+        self.history.append((question, "".join(parts).strip()))
 
     def reset(self) -> None:
         self.history.clear()

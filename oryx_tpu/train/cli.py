@@ -45,6 +45,8 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--sharding", default="fsdp",
                     choices=["fsdp", "zero2", "ddp"])
     ap.add_argument("--metrics-path", default=None)
+    ap.add_argument("--tensorboard-dir", default=None,
+                    help="also report metrics as TensorBoard scalars")
     ap.add_argument("--num-steps", type=int, default=None)
     ap.add_argument("--video-frames", type=int, default=64)
     # Multi-host rendezvous (auto-detected on TPU pods; explicit for tests).
@@ -121,6 +123,7 @@ def main(argv: list[str] | None = None) -> None:
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         grad_accum_steps=cfg.train.grad_accum_steps,
+        length_group_size=cfg.train.length_group_size,
         patch_size=cfg.vision.patch_size,
         base_grid=cfg.vision.base_grid,
         max_len=cfg.train.max_seq_len,
@@ -131,6 +134,7 @@ def main(argv: list[str] | None = None) -> None:
         params=load_params(args, cfg),
         sharding_mode=args.sharding,
         metrics_path=args.metrics_path,
+        tensorboard_dir=args.tensorboard_dir,
     )
     state = trainer.fit(batches)
 

@@ -33,11 +33,15 @@ class Trainer:
         params: dict[str, Any] | None = None,
         sharding_mode: str = "fsdp",
         metrics_path: str | None = None,
+        tensorboard_dir: str | None = None,
     ) -> None:
         self.cfg = cfg
         self.mesh = mesh_lib.build_mesh(cfg.mesh)
         self.sharding_mode = sharding_mode
-        self.logger = MetricLogger(metrics_path, log_every=cfg.train.log_every)
+        self.logger = MetricLogger(
+            metrics_path, log_every=cfg.train.log_every,
+            tensorboard_dir=tensorboard_dir,
+        )
         self.ckpt = CheckpointManager(cfg.train.checkpoint_dir)
 
         with jax.sharding.set_mesh(self.mesh):

@@ -25,15 +25,35 @@ def rank0_print(*args, **kwargs) -> None:
 
 
 class MetricLogger:
-    """JSONL metric stream + rolling throughput (tokens/sec/chip)."""
+    """JSONL metric stream + rolling throughput (tokens/sec/chip).
 
-    def __init__(self, path: str | None = None, *, log_every: int = 10):
+    tensorboard_dir: optional `report_to=tensorboard` parity — every
+    logged record also lands as TB scalars (torch's SummaryWriter, a
+    host-side dependency already in the image; gated so its absence
+    only disables TB, never training).
+    """
+
+    def __init__(
+        self,
+        path: str | None = None,
+        *,
+        log_every: int = 10,
+        tensorboard_dir: str | None = None,
+    ):
         self.path = path
         self.log_every = log_every
         self._f = None
+        self._tb = None
         if path and jax.process_index() == 0:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "a")
+        if tensorboard_dir and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception as e:  # TB optional: log and continue
+                rank0_print(f"tensorboard disabled: {e!r}")
         self._last_time = time.perf_counter()
         self._last_step = 0
         self._tokens_since = 0
@@ -65,7 +85,13 @@ class MetricLogger:
         if self._f:
             self._f.write(json.dumps(rec) + "\n")
             self._f.flush()
+        if self._tb:
+            for k, v in rec.items():
+                if k != "step":
+                    self._tb.add_scalar(f"train/{k}", v, step)
 
     def close(self) -> None:
         if self._f:
             self._f.close()
+        if self._tb:
+            self._tb.close()

@@ -203,6 +203,29 @@ def test_chat_batch_all_text(tiny_model):
     assert all(isinstance(r, str) for r in replies)
 
 
+def test_chat_stream_matches_chat(tiny_model):
+    """Streamed deltas concatenate to the non-streaming reply (greedy),
+    for text-only and image requests, across chunk sizes that do and do
+    not divide max_new_tokens."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    img = np.random.default_rng(4).integers(
+        0, 255, size=(30, 44, 3), dtype=np.uint8
+    )
+    cases = [
+        dict(question="hello there"),
+        dict(question="what is this?", images=[img]),
+    ]
+    for kw in cases:
+        ref = pipe.chat(max_new_tokens=6, **kw)
+        # Chunk 4 exercises the whole-chunk overshoot path (6 % 4 != 0).
+        for chunk in (2, 4):
+            streamed = "".join(
+                pipe.chat_stream(max_new_tokens=6, chunk=chunk, **kw)
+            )
+            assert streamed == ref, (kw, chunk, streamed, ref)
+
+
 def test_build_prompt_history(tiny_model):
     """Multi-turn prompts: media placeholders on the FIRST user turn,
     history turns templated exactly like Conversation.get_prompt."""

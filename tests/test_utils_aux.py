@@ -47,8 +47,23 @@ def test_metric_logger_writes_jsonl(tmp_path):
     assert "tokens_per_sec_per_chip" in lines[0]
 
 
+def test_metric_logger_tensorboard(tmp_path):
+    from oryx_tpu.utils.metrics import MetricLogger
+
+    tb_dir = str(tmp_path / "tb")
+    lg = MetricLogger(None, log_every=1, tensorboard_dir=tb_dir)
+    if lg._tb is None:
+        pytest.skip("tensorboard writer unavailable")
+    lg.log_step(1, {"loss": 1.0, "num_tokens": 10})
+    lg.close()
+    assert any(
+        f.startswith("events.out.tfevents") for f in os.listdir(tb_dir)
+    )
+
+
 @pytest.mark.parametrize("name", [
     "oryx_7b_sft", "oryx_34b_sft", "oryx_7b_longvideo", "oryx_7b_pretrain",
+    "oryx_1_5_32b_sft", "oryx_7b_sft_lora",
 ])
 def test_launch_configs_load(name):
     from oryx_tpu.config import OryxConfig
