@@ -1,0 +1,395 @@
+"""OpenAI-compatible chat API server with dynamic batching.
+
+Beyond-parity serving front-end (the reference ships only a CLI/Gradio
+demo; SURVEY.md §2 "Inference example / demo"): an HTTP endpoint speaking
+the `/v1/chat/completions` schema so existing OpenAI-client tooling
+points at an Oryx-TPU model unchanged. Stdlib-only (http.server) — no
+web-framework dependency.
+
+  POST /v1/chat/completions
+    {"model": "...", "messages": [{"role": "user", "content": ...}],
+     "max_tokens": 64, "stream": false}
+  GET /v1/models
+  GET /healthz
+
+Content may be a plain string or OpenAI content-part lists; image parts
+(`{"type": "image_url", "image_url": {"url": "data:image/...;base64,..."
+| "file:///path" | "/path"}}`) attach media to the turn. Multi-turn
+history maps onto the conversation template (media pinned to the first
+turn, as everywhere in this framework).
+
+Dynamic batching: non-streaming requests arriving within `batch_window`
+seconds are decoded as ONE `chat_batch` program (the TPU batching win);
+`stream=true` requests run singly via `chat_stream` and emit SSE chunks.
+
+    python -m oryx_tpu.serve.api_server --model-path models/oryx7b-sft \
+        [--shard tp=8] [--port 8000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import io
+import json
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+
+def _decode_image(url: str, *, allow_local_files: bool) -> np.ndarray:
+    """data: URI (base64) or — when explicitly allowed — a file
+    path/URI → HWC uint8 array. Local paths are opt-in: a network
+    client must not be able to make the server open arbitrary files."""
+    if url.startswith("data:"):
+        from PIL import Image
+
+        b64 = url.split(",", 1)[1]
+        img = Image.open(io.BytesIO(base64.b64decode(b64)))
+        return np.asarray(img.convert("RGB"))
+    if not allow_local_files:
+        raise ValueError(
+            "image_url must be a data: URI (local file paths require "
+            "--allow-local-files)"
+        )
+    from oryx_tpu.data import media
+
+    path = url[len("file://"):] if url.startswith("file://") else url
+    return media.load_image(path)
+
+
+def parse_messages(
+    messages: list[dict[str, Any]],
+    *,
+    allow_local_files: bool = False,
+) -> tuple[str, list[tuple[str, str]], list[np.ndarray]]:
+    """OpenAI messages → (current question, (user, assistant) history,
+    images). The last message must be a user turn; system messages are
+    folded into the next user text (the conversation template carries
+    its own system prompt)."""
+    turns: list[tuple[str, str | None]] = []
+    images: list[np.ndarray] = []
+    pending_system = ""
+    for m in messages:
+        role, content = m.get("role"), m.get("content", "")
+        text_parts: list[str] = []
+        if isinstance(content, str):
+            text_parts.append(content)
+        else:
+            for part in content:
+                if part.get("type") == "text":
+                    text_parts.append(part.get("text", ""))
+                elif part.get("type") == "image_url":
+                    images.append(_decode_image(
+                        part["image_url"]["url"],
+                        allow_local_files=allow_local_files,
+                    ))
+        text = "\n".join(t for t in text_parts if t)
+        if role == "system":
+            # Multiple system messages concatenate (never overwrite).
+            pending_system = (
+                f"{pending_system}\n{text}" if pending_system else text
+            )
+        elif role == "user":
+            if pending_system:
+                text = f"{pending_system}\n{text}" if text else pending_system
+                pending_system = ""
+            turns.append((text, None))
+        elif role == "assistant":
+            if not turns or turns[-1][1] is not None:
+                raise ValueError("assistant message without a user turn")
+            turns[-1] = (turns[-1][0], text)
+    if not turns or turns[-1][1] is not None:
+        raise ValueError("the last message must be from the user")
+    question = turns[-1][0]
+    history = [(u, a) for u, a in turns[:-1]]
+    if any(a is None for _, a in history):
+        raise ValueError("history user turns must alternate with assistant")
+    return question, history, images
+
+
+class _Pending:
+    def __init__(self, request: dict[str, Any], max_new: int):
+        self.request = request
+        self.max_new = max_new
+        self.done = threading.Event()
+        self.reply: str | None = None
+        self.error: str | None = None
+
+
+class Batcher:
+    """Groups concurrent non-streaming requests into one chat_batch call.
+
+    A single worker thread drains the queue: it waits `window` seconds
+    after the first pending request for company (requests with the same
+    max_tokens batch together), then runs the whole group as one
+    compiled decode. `device_lock` serializes the device against
+    concurrent streaming requests; HTTP threads only enqueue and wait.
+    """
+
+    def __init__(
+        self,
+        pipe,
+        *,
+        window: float = 0.02,
+        max_batch: int = 8,
+        device_lock: threading.Lock | None = None,
+    ):
+        self.pipe = pipe
+        self.window = window
+        self.max_batch = max_batch
+        self.device_lock = device_lock or threading.Lock()
+        self.q: queue.Queue[_Pending] = queue.Queue()
+        # A request popped from the queue whose max_tokens mismatched the
+        # group in flight; it LEADS the next group (FIFO — re-queueing to
+        # the tail could starve it under sustained mixed traffic).
+        self._carry: _Pending | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, request: dict[str, Any], max_new: int) -> _Pending:
+        p = _Pending(request, max_new)
+        self.q.put(p)
+        return p
+
+    def _run(self) -> None:
+        while True:
+            first = self._carry or self.q.get()
+            self._carry = None
+            group = [first]
+            deadline = time.monotonic() + self.window
+            while len(group) < self.max_batch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    nxt = self.q.get(timeout=left)
+                except queue.Empty:
+                    break
+                if nxt.max_new != first.max_new:
+                    # Different decode length → it LEADS the next group.
+                    self._carry = nxt
+                    break
+                group.append(nxt)
+            try:
+                with self.device_lock:
+                    replies = self.pipe.chat_batch(
+                        [p.request for p in group],
+                        max_new_tokens=first.max_new,
+                    )
+                for p, r in zip(group, replies):
+                    p.reply = r
+            except Exception as e:  # surface per-request, keep serving
+                for p in group:
+                    p.error = f"{type(e).__name__}: {e}"
+            for p in group:
+                p.done.set()
+
+
+def _completion_body(model: str, reply: str) -> dict[str, Any]:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": reply},
+            "finish_reason": "stop",
+        }],
+    }
+
+
+def _chunk_body(model: str, cid: str, delta: str | None) -> dict[str, Any]:
+    choice: dict[str, Any] = {"index": 0, "delta": {}, "finish_reason": None}
+    if delta is None:
+        choice["finish_reason"] = "stop"
+    else:
+        choice["delta"] = {"content": delta}
+    return {
+        "id": cid, "object": "chat.completion.chunk",
+        "created": int(time.time()), "model": model, "choices": [choice],
+    }
+
+
+def build_server(
+    pipe,
+    *,
+    model_name: str = "oryx-tpu",
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    batch_window: float = 0.02,
+    max_batch: int = 8,
+    allow_local_files: bool = False,
+) -> ThreadingHTTPServer:
+    """Construct (not start) the HTTP server around a pipeline."""
+    # chat_stream is not thread-safe against itself or chat_batch (one
+    # device, one program at a time) — streaming requests serialize with
+    # each other and with the batcher through this lock.
+    stream_lock = threading.Lock()
+    batcher = Batcher(
+        pipe, window=batch_window, max_batch=max_batch,
+        device_lock=stream_lock,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet access log
+            pass
+
+        def _json(self, code: int, body: dict[str, Any]) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"status": "ok"})
+            elif self.path == "/v1/models":
+                self._json(200, {
+                    "object": "list",
+                    "data": [{
+                        "id": model_name, "object": "model",
+                        "owned_by": "oryx-tpu",
+                    }],
+                })
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/chat/completions":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                question, history, images = parse_messages(
+                    req["messages"], allow_local_files=allow_local_files
+                )
+                raw_max = req.get(
+                    "max_tokens", req.get("max_completion_tokens")
+                )
+                if raw_max is None:
+                    max_new = pipe.cfg.generation.max_new_tokens
+                else:
+                    max_new = int(raw_max)
+                    if max_new < 1:
+                        raise ValueError(
+                            f"max_tokens must be >= 1, got {max_new}"
+                        )
+            except Exception as e:
+                self._json(400, {"error": {
+                    "message": f"{type(e).__name__}: {e}",
+                    "type": "invalid_request_error",
+                }})
+                return
+
+            is_video = bool(req.get("video")) and len(images) > 1
+            if req.get("stream"):
+                # A producer thread owns the device (and the lock); this
+                # handler thread only writes to the socket, so a slow or
+                # stalled client can never block the device for others.
+                deltas: queue.Queue[tuple[str, str | None]] = queue.Queue()
+
+                def produce():
+                    try:
+                        with stream_lock:
+                            for d in pipe.chat_stream(
+                                question, images=images or None,
+                                is_video=is_video, history=history,
+                                max_new_tokens=max_new,
+                            ):
+                                deltas.put(("delta", d))
+                        deltas.put(("end", None))
+                    except Exception as e:
+                        deltas.put(("error", f"{type(e).__name__}: {e}"))
+
+                threading.Thread(target=produce, daemon=True).start()
+                cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                while True:
+                    kind, payload = deltas.get()
+                    if kind == "delta":
+                        self._sse(_chunk_body(model_name, cid, payload))
+                    elif kind == "error":
+                        self._sse({"error": {"message": payload}})
+                        break
+                    else:
+                        self._sse(_chunk_body(model_name, cid, None))
+                        break
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+                return
+
+            pending = batcher.submit(
+                {
+                    "question": question, "images": images,
+                    "is_video": is_video, "history": history,
+                },
+                max_new,
+            )
+            pending.done.wait()
+            if pending.error is not None:
+                self._json(500, {"error": {"message": pending.error}})
+            else:
+                self._json(200, _completion_body(model_name, pending.reply))
+
+        def _sse(self, body: dict[str, Any]) -> None:
+            self.wfile.write(f"data: {json.dumps(body)}\n\n".encode())
+            self.wfile.flush()
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="Oryx-TPU OpenAI-style server")
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--tokenizer-path", default=None)
+    ap.add_argument("--model-name", default="oryx-tpu")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--batch-window", type=float, default=0.02)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument(
+        "--allow-local-files", action="store_true",
+        help="let image_url reference server-local file paths (off by "
+        "default: any network client could read arbitrary images)",
+    )
+    ap.add_argument(
+        "--shard", default=None, metavar="MODE=N",
+        help="multi-chip serving (tp=N | fsdp=N over all visible devices)",
+    )
+    args = ap.parse_args(argv)
+
+    from oryx_tpu.parallel.mesh import parse_shard_arg
+    from oryx_tpu.serve.builder import load_pretrained_model
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    mesh, mode = parse_shard_arg(args.shard)
+    tokenizer, params, cfg = load_pretrained_model(
+        args.model_path, tokenizer_path=args.tokenizer_path,
+        mesh=mesh, sharding_mode=mode,
+    )
+    pipe = OryxInference(tokenizer, params, cfg, mesh=mesh,
+                         sharding_mode=mode)
+    srv = build_server(
+        pipe, model_name=args.model_name, host=args.host, port=args.port,
+        batch_window=args.batch_window, max_batch=args.max_batch,
+        allow_local_files=args.allow_local_files,
+    )
+    print(f"serving {args.model_name} on http://{args.host}:{args.port}")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+"""OpenAI-compatible API server tests: message parsing, a live server
+round-trip (batched + streaming SSE), dynamic batching."""
+
+import base64
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve import api_server
+from oryx_tpu.serve.pipeline import OryxInference
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def _data_uri(img: np.ndarray) -> str:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+
+def test_parse_messages_history_and_images():
+    img = np.random.default_rng(0).integers(
+        0, 255, size=(16, 16, 3), dtype=np.uint8
+    )
+    messages = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": [
+            {"type": "text", "text": "what is this?"},
+            {"type": "image_url", "image_url": {"url": _data_uri(img)}},
+        ]},
+        {"role": "assistant", "content": "a cat"},
+        {"role": "user", "content": "why?"},
+    ]
+    q, hist, images = api_server.parse_messages(messages)
+    assert q == "why?"
+    assert hist == [("be brief\nwhat is this?", "a cat")]
+    assert len(images) == 1 and images[0].shape == (16, 16, 3)
+
+
+def test_parse_messages_system_concat_and_local_files(tmp_path):
+    # Multiple system messages concatenate in order.
+    q, hist, _ = api_server.parse_messages([
+        {"role": "system", "content": "be terse"},
+        {"role": "system", "content": "answer in French"},
+        {"role": "user", "content": "hi"},
+    ])
+    assert q == "be terse\nanswer in French\nhi"
+    # Local file paths are rejected unless explicitly allowed.
+    from PIL import Image
+
+    p = tmp_path / "x.png"
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(p)
+    msg = [{"role": "user", "content": [
+        {"type": "image_url", "image_url": {"url": str(p)}},
+        {"type": "text", "text": "what?"},
+    ]}]
+    with pytest.raises(ValueError, match="allow-local-files"):
+        api_server.parse_messages(msg)
+    _, _, images = api_server.parse_messages(msg, allow_local_files=True)
+    assert images[0].shape == (8, 8, 3)
+
+
+def test_server_rejects_bad_max_tokens(server):
+    url, _ = server
+    for bad in (0, -5):
+        try:
+            _post(url, {
+                "max_tokens": bad,
+                "messages": [{"role": "user", "content": "q"}],
+            })
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_parse_messages_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        api_server.parse_messages(
+            [{"role": "assistant", "content": "hi"}]
+        )
+    with pytest.raises(ValueError):
+        api_server.parse_messages([
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "content": "a"},
+        ])
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    srv = api_server.build_server(pipe, port=0, batch_window=0.1)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", pipe
+    srv.shutdown()
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=300)
+
+
+def test_server_completion_matches_pipeline(server):
+    url, pipe = server
+    body = {
+        "model": "oryx-tpu",
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5,
+    }
+    with _post(url, body) as resp:
+        out = json.load(resp)
+    reply = out["choices"][0]["message"]["content"]
+    assert out["object"] == "chat.completion"
+    assert reply == pipe.chat("hello there", max_new_tokens=5)
+
+    # /v1/models and /healthz answer.
+    with urllib.request.urlopen(url + "/v1/models", timeout=30) as r:
+        assert json.load(r)["data"][0]["id"] == "oryx-tpu"
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        assert json.load(r)["status"] == "ok"
+
+
+def test_server_streaming_sse(server):
+    url, pipe = server
+    body = {
+        "model": "oryx-tpu", "stream": True,
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5,
+    }
+    deltas, done = [], False
+    with _post(url, body) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for line in resp:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                done = True
+                break
+            chunk = json.loads(payload)
+            delta = chunk["choices"][0]["delta"]
+            if "content" in delta:
+                deltas.append(delta["content"])
+    assert done
+    assert "".join(deltas) == pipe.chat("hello there", max_new_tokens=5)
+
+
+def test_server_dynamic_batching(server):
+    url, pipe = server
+    qs = ["hello there", "what now?", "tell me more"]
+    refs = [pipe.chat(q, max_new_tokens=4) for q in qs]
+    results = [None] * len(qs)
+
+    def call(i):
+        body = {
+            "model": "m", "max_tokens": 4,
+            "messages": [{"role": "user", "content": qs[i]}],
+        }
+        with _post(url, body) as resp:
+            results[i] = json.load(
+                resp
+            )["choices"][0]["message"]["content"]
+
+    threads = [
+        threading.Thread(target=call, args=(i,)) for i in range(len(qs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert results == refs
+
+
+def test_server_bad_request(server):
+    url, _ = server
+    try:
+        _post(url, {"messages": [{"role": "assistant", "content": "x"}]})
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "invalid_request_error" in e.read().decode()

@@ -60,6 +60,34 @@ def _batch(cfg, n=8):
     }
 
 
+def test_trainer_checkpoint_resume(tmp_path):
+    """Failure posture (SURVEY.md §5): a fresh Trainer on the same
+    checkpoint_dir resumes from the saved step and continues — the
+    crashed-pod restart path."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh (conftest)")
+    cfg = _cfg(tmp_path, "resume")
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, checkpoint_every=1)
+    )
+    b = _batch(cfg)
+    t1 = Trainer(cfg, sharding_mode="fsdp")
+    s1 = t1.fit(iter([b]), num_steps=1, resume=False, prefetch=0)
+    assert int(jax.device_get(s1.step)) == 1
+
+    t2 = Trainer(cfg, sharding_mode="fsdp")
+    start = t2.resume_if_available()
+    assert start == 1
+    # Resumed params equal the step-1 params, not a fresh init.
+    for a, c in zip(
+        jax.tree_util.tree_leaves(s1.params),
+        jax.tree_util.tree_leaves(t2.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    s2 = t2.fit(iter([b]), num_steps=2, resume=True, prefetch=0)
+    assert int(jax.device_get(s2.step)) == 2
+
+
 @pytest.mark.parametrize("mode", ["fsdp", "zero2", "ddp"])
 def test_trainer_mode_one_step(tmp_path, mode):
     if jax.device_count() < 8:
