@@ -172,9 +172,12 @@ def evaluate(
         for (gi, rec), reply in zip(group, replies):
             ok = score_record(rec, reply)
             correct += ok
-            out.append(
-                {"id": rec.get("id", gi), "reply": reply, "correct": ok}
-            )
+            row = {"id": rec.get("id", gi), "reply": reply, "correct": ok}
+            if rec.get("meta"):
+                # Adapter-provided tags (duration, question_type, ...)
+                # ride along for per-category accuracy breakdowns.
+                row["meta"] = rec["meta"]
+            out.append(row)
         n = len(out)
         if log_every and (n % log_every < len(group) or n == len(mine)):
             print(f"[eval] {n}/{len(mine)} acc={correct / n:.4f}", flush=True)
@@ -200,11 +203,30 @@ def merge_results(results: Sequence[EvalResult]) -> EvalResult:
     )
 
 
-def _print_summary(result: EvalResult) -> None:
-    print(json.dumps({
+def breakdown(result: EvalResult, key: str) -> dict[str, dict[str, Any]]:
+    """Per-category accuracy over a meta tag (lmms-eval's per-split
+    reporting: VideoMME by `duration`, MLVU/NextQA by question type).
+    Records without the tag land under "<untagged>"."""
+    groups: dict[str, list[int]] = {}
+    for r in result.records:
+        cat = str((r.get("meta") or {}).get(key, "<untagged>"))
+        g = groups.setdefault(cat, [0, 0])
+        g[0] += bool(r["correct"])
+        g[1] += 1
+    return {
+        cat: {"accuracy": c / max(n, 1), "n": n}
+        for cat, (c, n) in sorted(groups.items())
+    }
+
+
+def _print_summary(result: EvalResult, by: list[str] | None = None) -> None:
+    rec: dict[str, Any] = {
         "accuracy": result.accuracy, "n": result.num_total,
         "seconds": round(result.seconds, 1),
-    }))
+    }
+    for key in by or []:
+        rec[f"by_{key}"] = breakdown(result, key)
+    print(json.dumps(rec))
 
 
 def _write_output(result: EvalResult, path: str) -> None:
@@ -222,6 +244,7 @@ def main(argv: list[str] | None = None) -> None:
     pre = argparse.ArgumentParser(add_help=False)
     pre.add_argument("--merge", nargs="+", default=None)
     pre.add_argument("--output", default=None)
+    pre.add_argument("--by", nargs="+", default=None)
     pre_args, rest = pre.parse_known_args(argv)
     if pre_args.merge is not None:
         if rest:
@@ -231,7 +254,7 @@ def main(argv: list[str] | None = None) -> None:
         merged = merge_results([
             EvalResult(**json.load(open(p))) for p in pre_args.merge
         ])
-        _print_summary(merged)
+        _print_summary(merged, by=pre_args.by)
         if pre_args.output:
             _write_output(merged, pre_args.output)
         return
@@ -251,6 +274,11 @@ def main(argv: list[str] | None = None) -> None:
         help="task record format: native|videomme|mlvu|mvbench|nextqa",
     )
     ap.add_argument("--media-root", default="")
+    ap.add_argument(
+        "--by", nargs="+", default=None, metavar="META_KEY",
+        help="per-category accuracy breakdown over adapter meta tags "
+        "(e.g. --by duration task_type)",
+    )
     ap.add_argument("--num-frames", type=int, default=64)
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--output", default=None, help="results json path")
@@ -265,23 +293,18 @@ def main(argv: list[str] | None = None) -> None:
     )
     args = ap.parse_args(argv)
 
-    from oryx_tpu.serve.builder import load_pretrained_model
-
+    from oryx_tpu.eval.adapters import adapt
     from oryx_tpu.parallel.mesh import parse_shard_arg
+    from oryx_tpu.serve.builder import load_pipeline
 
     try:
         mesh, mode = parse_shard_arg(args.shard)
     except ValueError as e:
         ap.error(str(e))
-
-    tokenizer, params, cfg = load_pretrained_model(
+    pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
         mesh=mesh, sharding_mode=mode,
     )
-    from oryx_tpu.eval.adapters import adapt
-
-    pipe = OryxInference(tokenizer, params, cfg, mesh=mesh,
-                         sharding_mode=mode)
     records = adapt(args.format, load_task(args.task))
     result = evaluate(
         pipe, records,
@@ -289,7 +312,7 @@ def main(argv: list[str] | None = None) -> None:
         max_new_tokens=args.max_new_tokens, batch_size=args.batch_size,
         process_index=args.process_index, process_count=args.process_count,
     )
-    _print_summary(result)
+    _print_summary(result, by=args.by)
     if args.output:
         _write_output(result, args.output)
 

@@ -93,13 +93,16 @@ def generate(
     attn_impl: str = "xla",
     compute_dtype=None,
     stop_sequences: jnp.ndarray | None = None,  # [S, L], left-pad -1
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (tokens [B, max_new_tokens] int32, num_generated [B] int32).
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens [B, max_new_tokens] int32, num_generated [B] int32,
+    finished [B] bool).
 
     Slots after EOS are filled with eos_token_id. cache_len must be a bucket
     >= T + max_new_tokens. A row also finishes when its trailing tokens
     match any stop sequence (num_generated then includes the stop tokens;
-    the caller trims the decoded text).
+    the caller trims the decoded text). finished=False marks a row cut off
+    by max_new_tokens (the OpenAI "length" finish reason) rather than by
+    EOS/stop.
     """
     B, T, _ = inputs_embeds.shape
     assert cache_len >= T + max_new_tokens, (cache_len, T, max_new_tokens)
@@ -178,7 +181,7 @@ def generate(
     num = jnp.where(
         jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
     )
-    return toks, num.astype(jnp.int32)
+    return toks, num.astype(jnp.int32), jnp.any(fin, axis=1)
 
 
 # ---------------------------------------------------------------------------

@@ -185,10 +185,11 @@ def mm_generate(
     max_new_tokens: int | None = None,
     key: jax.Array | None = None,
     stop_sequences: jnp.ndarray | None = None,
-) -> tuple[np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """End-to-end multimodal generation from host-side packed inputs.
 
-    Returns (tokens [B, max_new_tokens], num_generated [B]) as numpy.
+    Returns (tokens [B, max_new_tokens], num_generated [B], finished [B]
+    bool — False means cut off by max_new_tokens) as numpy.
     The reference equivalent is `model.generate(input_ids, images=...)`
     (SURVEY.md §3.2). stop_sequences: see generate.make_stop_sequences.
     """
@@ -209,7 +210,7 @@ def mm_generate(
         "is_visual": jnp.asarray(batch.is_visual),
         "lengths": jnp.asarray(batch.lengths),
     }
-    toks, num = _jit_mm_generate(
+    toks, num, fin = _jit_mm_generate(
         params, cfg, arrays, max_new_tokens, cache_len, key, stop_sequences
     )
-    return np.asarray(toks), np.asarray(num)
+    return np.asarray(toks), np.asarray(num), np.asarray(fin)

@@ -77,6 +77,8 @@ def parse_messages(
     pending_system = ""
     for m in messages:
         role, content = m.get("role"), m.get("content", "")
+        if role not in ("system", "user", "assistant"):
+            raise ValueError(f"unsupported message role {role!r}")
         text_parts: list[str] = []
         if isinstance(content, str):
             text_parts.append(content)
@@ -104,10 +106,12 @@ def parse_messages(
             if not turns or turns[-1][1] is not None:
                 raise ValueError("assistant message without a user turn")
             turns[-1] = (turns[-1][0], text)
+    if pending_system:
+        raise ValueError("system message must precede a user turn")
     if not turns or turns[-1][1] is not None:
         raise ValueError("the last message must be from the user")
     question = turns[-1][0]
-    history = [(u, a) for u, a in turns[:-1]]
+    history = turns[:-1]
     if any(a is None for _, a in history):
         raise ValueError("history user turns must alternate with assistant")
     return question, history, images
@@ -119,6 +123,7 @@ class _Pending:
         self.max_new = max_new
         self.done = threading.Event()
         self.reply: str | None = None
+        self.finish_reason: str = "stop"
         self.error: str | None = None
 
 
@@ -178,12 +183,13 @@ class Batcher:
                 group.append(nxt)
             try:
                 with self.device_lock:
-                    replies = self.pipe.chat_batch(
+                    replies, reasons = self.pipe.chat_batch(
                         [p.request for p in group],
                         max_new_tokens=first.max_new,
+                        return_finish_reasons=True,
                     )
-                for p, r in zip(group, replies):
-                    p.reply = r
+                for p, r, why in zip(group, replies, reasons):
+                    p.reply, p.finish_reason = r, why
             except Exception as e:  # surface per-request, keep serving
                 for p in group:
                     p.error = f"{type(e).__name__}: {e}"
@@ -191,7 +197,9 @@ class Batcher:
                 p.done.set()
 
 
-def _completion_body(model: str, reply: str) -> dict[str, Any]:
+def _completion_body(
+    model: str, reply: str, finish_reason: str = "stop"
+) -> dict[str, Any]:
     return {
         "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
         "object": "chat.completion",
@@ -200,15 +208,17 @@ def _completion_body(model: str, reply: str) -> dict[str, Any]:
         "choices": [{
             "index": 0,
             "message": {"role": "assistant", "content": reply},
-            "finish_reason": "stop",
+            "finish_reason": finish_reason,
         }],
     }
 
 
-def _chunk_body(model: str, cid: str, delta: str | None) -> dict[str, Any]:
+def _chunk_body(
+    model: str, cid: str, delta: str | None, finish_reason: str = "stop"
+) -> dict[str, Any]:
     choice: dict[str, Any] = {"index": 0, "delta": {}, "finish_reason": None}
     if delta is None:
-        choice["finish_reason"] = "stop"
+        choice["finish_reason"] = finish_reason
     else:
         choice["delta"] = {"content": delta}
     return {
@@ -299,15 +309,21 @@ def build_server(
                 deltas: queue.Queue[tuple[str, str | None]] = queue.Queue()
 
                 def produce():
+                    gen = pipe.chat_stream(
+                        question, images=images or None,
+                        is_video=is_video, history=history,
+                        max_new_tokens=max_new,
+                    )
                     try:
                         with stream_lock:
-                            for d in pipe.chat_stream(
-                                question, images=images or None,
-                                is_video=is_video, history=history,
-                                max_new_tokens=max_new,
-                            ):
+                            while True:
+                                try:
+                                    d = next(gen)
+                                except StopIteration as s:
+                                    # Generator return value = reason.
+                                    deltas.put(("end", s.value or "stop"))
+                                    return
                                 deltas.put(("delta", d))
-                        deltas.put(("end", None))
                     except Exception as e:
                         deltas.put(("error", f"{type(e).__name__}: {e}"))
 
@@ -325,7 +341,9 @@ def build_server(
                         self._sse({"error": {"message": payload}})
                         break
                     else:
-                        self._sse(_chunk_body(model_name, cid, None))
+                        self._sse(
+                            _chunk_body(model_name, cid, None, payload)
+                        )
                         break
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
@@ -342,7 +360,9 @@ def build_server(
             if pending.error is not None:
                 self._json(500, {"error": {"message": pending.error}})
             else:
-                self._json(200, _completion_body(model_name, pending.reply))
+                self._json(200, _completion_body(
+                    model_name, pending.reply, pending.finish_reason
+                ))
 
         def _sse(self, body: dict[str, Any]) -> None:
             self.wfile.write(f"data: {json.dumps(body)}\n\n".encode())
@@ -371,17 +391,12 @@ def main(argv: list[str] | None = None) -> None:
     )
     args = ap.parse_args(argv)
 
-    from oryx_tpu.parallel.mesh import parse_shard_arg
-    from oryx_tpu.serve.builder import load_pretrained_model
-    from oryx_tpu.serve.pipeline import OryxInference
+    from oryx_tpu.serve.builder import load_pipeline
 
-    mesh, mode = parse_shard_arg(args.shard)
-    tokenizer, params, cfg = load_pretrained_model(
+    pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
-        mesh=mesh, sharding_mode=mode,
+        shard=args.shard,
     )
-    pipe = OryxInference(tokenizer, params, cfg, mesh=mesh,
-                         sharding_mode=mode)
     srv = build_server(
         pipe, model_name=args.model_name, host=args.host, port=args.port,
         batch_window=args.batch_window, max_batch=args.max_batch,

@@ -229,6 +229,37 @@ def load_from_hf(
     return tokenizer, params, cfg
 
 
+def load_pipeline(
+    model_path: str,
+    *,
+    tokenizer_path: str | None = None,
+    tokenizer: Any | None = None,
+    shard: str | None = None,
+    mesh=None,
+    sharding_mode: str = "tp",
+    template: str = "qwen",
+):
+    """One-call serving setup shared by the serve/eval/API CLIs:
+    (optionally sharded) model load → OryxInference. Pass either a
+    `--shard`-style string (`shard="tp=8"`) or a pre-built mesh + mode
+    (CLIs parse the string themselves so malformed values surface as
+    argparse usage errors, not load failures)."""
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    if shard is not None:
+        from oryx_tpu.parallel.mesh import parse_shard_arg
+
+        mesh, sharding_mode = parse_shard_arg(shard)
+    tokenizer, params, cfg = load_pretrained_model(
+        model_path, tokenizer_path=tokenizer_path, tokenizer=tokenizer,
+        mesh=mesh, sharding_mode=sharding_mode,
+    )
+    return OryxInference(
+        tokenizer, params, cfg, template=template, mesh=mesh,
+        sharding_mode=sharding_mode,
+    )
+
+
 def export_hf(directory: str, cfg: OryxConfig, params: Params) -> None:
     """Write a reference-layout checkpoint (LLM + vision safetensors +
     projector npz) for interop with reference-stack users."""

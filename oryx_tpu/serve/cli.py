@@ -43,23 +43,17 @@ def main(argv: list[str] | None = None) -> None:
     if args.question is None and not args.interactive:
         ap.error("--question is required unless --interactive")
 
-    from oryx_tpu.serve.builder import load_pretrained_model
-    from oryx_tpu.serve.pipeline import ChatSession, OryxInference
-
     from oryx_tpu.parallel.mesh import parse_shard_arg
+    from oryx_tpu.serve.builder import load_pipeline
+    from oryx_tpu.serve.pipeline import ChatSession
 
     try:
         mesh, mode = parse_shard_arg(args.shard)
     except ValueError as e:
         ap.error(str(e))
-
-    tokenizer, params, cfg = load_pretrained_model(
+    pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
-        mesh=mesh, sharding_mode=mode,
-    )
-    pipe = OryxInference(
-        tokenizer, params, cfg, template=args.template,
-        mesh=mesh, sharding_mode=mode,
+        mesh=mesh, sharding_mode=mode, template=args.template,
     )
 
     if args.video is not None:

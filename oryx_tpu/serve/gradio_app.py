@@ -83,15 +83,23 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--tokenizer-path", default=None)
     ap.add_argument("--num-frames", type=int, default=64)
     ap.add_argument("--port", type=int, default=7860)
+    ap.add_argument(
+        "--shard", default=None, metavar="MODE=N",
+        help="multi-chip serving (tp=N | fsdp=N over all visible devices)",
+    )
     args = ap.parse_args(argv)
 
-    from oryx_tpu.serve.builder import load_pretrained_model
-    from oryx_tpu.serve.pipeline import OryxInference
+    from oryx_tpu.parallel.mesh import parse_shard_arg
+    from oryx_tpu.serve.builder import load_pipeline
 
-    tokenizer, params, cfg = load_pretrained_model(
-        args.model_path, tokenizer_path=args.tokenizer_path
+    try:
+        mesh, mode = parse_shard_arg(args.shard)
+    except ValueError as e:
+        ap.error(str(e))
+    pipe = load_pipeline(
+        args.model_path, tokenizer_path=args.tokenizer_path,
+        mesh=mesh, sharding_mode=mode,
     )
-    pipe = OryxInference(tokenizer, params, cfg)
     app = build_app(pipe, num_frames=args.num_frames)
     app.launch(server_port=args.port)
 

@@ -202,7 +202,8 @@ class OryxInference:
         *,
         max_new_tokens: int | None = None,
         seed: int = 0,
-    ) -> list[str]:
+        return_finish_reasons: bool = False,
+    ) -> list[str] | tuple[list[str], list[str]]:
         """Batched single-turn QA: one ViT + compressor + decode scan for
         the whole batch (the batching win the reference gets from varlen
         flash-attn plus HF batched generate; SURVEY.md §3.5).
@@ -210,6 +211,8 @@ class OryxInference:
         requests: dicts with "question" (str), optional "images"
         (list of np arrays, pre-sampled for video), optional "is_video".
         Mixed text-only / image / multi-image / video rows are fine.
+        return_finish_reasons: also return per-row "stop" (EOS or stop
+        string) vs "length" (cut off by max_new_tokens).
         """
         max_new = max_new_tokens or self.cfg.generation.max_new_tokens
         key = jax.random.key(seed)
@@ -225,25 +228,33 @@ class OryxInference:
             max_patches.extend(caps)
 
         if not all_images:
-            return self._text_batch(ids_rows, max_new, key)
-
-        packed = packing.pack_raw_images(
-            all_images,
-            patch_size=self.cfg.vision.patch_size,
-            base_grid=self.cfg.vision.base_grid,
-            side_factors=side_factors,
-            max_patches=max_patches,
-        )
-        batch = splice.build_mm_batch(ids_rows, splice.query_slots(packed))
-        with self._mesh_scope():
-            toks, num = oryx.mm_generate(
-                self.params, self.cfg, packed, batch,
-                max_new_tokens=max_new, key=key,
-                stop_sequences=self.stop_sequences,
+            toks, num, fin = self._text_batch(ids_rows, max_new, key)
+        else:
+            packed = packing.pack_raw_images(
+                all_images,
+                patch_size=self.cfg.vision.patch_size,
+                base_grid=self.cfg.vision.base_grid,
+                side_factors=side_factors,
+                max_patches=max_patches,
             )
-        return [self._decode(toks[b], int(num[b])) for b in range(len(toks))]
+            batch = splice.build_mm_batch(
+                ids_rows, splice.query_slots(packed)
+            )
+            with self._mesh_scope():
+                toks, num, fin = oryx.mm_generate(
+                    self.params, self.cfg, packed, batch,
+                    max_new_tokens=max_new, key=key,
+                    stop_sequences=self.stop_sequences,
+                )
+        replies = [
+            self._decode(toks[b], int(num[b])) for b in range(len(toks))
+        ]
+        if not return_finish_reasons:
+            return replies
+        reasons = ["stop" if bool(f) else "length" for f in fin]
+        return replies, reasons
 
-    def _text_batch(self, ids_rows, max_new: int, key) -> list[str]:
+    def _text_batch(self, ids_rows, max_new: int, key):
         B = len(ids_rows)
         T = packing.round_up_bucket(max(len(r) for r in ids_rows))
         rows = np.zeros((B, T), np.int32)
@@ -253,13 +264,12 @@ class OryxInference:
             lengths[b] = len(ids)
         cache_len = packing.round_up_bucket(T + max_new)
         with self._mesh_scope():
-            toks, num = _jit_text_generate(
+            toks, num, fin = _jit_text_generate(
                 self.params, self.cfg, jnp.asarray(rows),
                 jnp.asarray(lengths), max_new, cache_len, key,
                 self.stop_sequences,
             )
-        toks, num = np.asarray(toks), np.asarray(num)
-        return [self._decode(toks[b], int(num[b])) for b in range(B)]
+        return np.asarray(toks), np.asarray(num), np.asarray(fin)
 
     def chat_stream(
         self,
@@ -277,6 +287,8 @@ class OryxInference:
         exactly (incomplete UTF-8 tails, stop-string prefixes and
         leading/trailing whitespace are held back until resolvable).
         Single request; decode runs `chunk` tokens per device dispatch.
+        The generator's RETURN value (StopIteration.value) is the finish
+        reason: "stop" (EOS/stop string) or "length" (max_new_tokens).
         """
         max_new = max_new_tokens or self.cfg.generation.max_new_tokens
         key = jax.random.key(seed)
@@ -370,7 +382,13 @@ class OryxInference:
                     yield safe[len(text_done):]
                     text_done = safe
                 if finished:
-                    return
+                    return "stop"
+        # Decode window exhausted without EOS/stop: flush the held-back
+        # tail (chat() would return it) and report the truncation.
+        tail = text.strip() if emitted else ""
+        if len(tail) > len(text_done):
+            yield tail[len(text_done):]
+        return "length"
 
     def chat_video(
         self,

@@ -100,6 +100,42 @@ def test_parse_messages_rejects_bad_shapes():
             {"role": "user", "content": "q"},
             {"role": "assistant", "content": "a"},
         ])
+    # Trailing system message would be silently lost — reject it.
+    with pytest.raises(ValueError, match="precede a user turn"):
+        api_server.parse_messages([
+            {"role": "user", "content": "q"},
+            {"role": "system", "content": "answer in JSON"},
+        ])
+    # Unsupported roles are an error, not a silent drop.
+    with pytest.raises(ValueError, match="unsupported message role"):
+        api_server.parse_messages([
+            {"role": "tool", "content": "output"},
+            {"role": "user", "content": "q"},
+        ])
+
+
+def test_server_reports_length_finish_reason(server):
+    """The tiny vocab never emits the EOS id, so every decode truncates:
+    finish_reason must say 'length', not 'stop'."""
+    url, _ = server
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 3,
+    }) as r:
+        assert json.load(r)["choices"][0]["finish_reason"] == "length"
+    deltas_final = None
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 3, "stream": True,
+    }) as r:
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                c = json.loads(line[6:])
+                fr = c["choices"][0]["finish_reason"]
+                if fr is not None:
+                    deltas_final = fr
+    assert deltas_final == "length"
 
 
 @pytest.fixture(scope="module")

@@ -95,7 +95,7 @@ def test_greedy_generate_matches_hf():
 
     gen_cfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=-1)
     embeds = params["embed"]["weight"][jnp.asarray(ids)]
-    toks, num = gen_lib.generate(
+    toks, num, _ = gen_lib.generate(
         params, tiny, gen_cfg,
         inputs_embeds=embeds, lengths=jnp.full((2,), 7, jnp.int32),
         max_new_tokens=NEW, cache_len=32,
@@ -122,14 +122,14 @@ def test_mm_generate_end_to_end():
     batch = splice.build_mm_batch([prompt_ids], slots, buckets=(64,))
     assert batch.lengths[0] == 4 + 12
 
-    toks, num = oryx.mm_generate(
+    toks, num, _ = oryx.mm_generate(
         params, cfg, packed, batch, max_new_tokens=4, key=jax.random.key(7)
     )
     assert toks.shape == (1, 4)
     assert np.all((toks >= 0) & (toks < cfg.llm.vocab_size))
 
     # Determinism under identical inputs.
-    toks2, _ = oryx.mm_generate(
+    toks2, _, _ = oryx.mm_generate(
         params, cfg, packed, batch, max_new_tokens=4, key=jax.random.key(7)
     )
     np.testing.assert_array_equal(toks, toks2)

@@ -192,6 +192,32 @@ def test_adapters_nextqa_csv(tmp_path):
     assert r["meta"]["type"] == "CH"
 
 
+def test_breakdown_by_meta():
+    res = harness.EvalResult(0.5, 2, 4, 1.0, [
+        {"id": 0, "correct": True, "meta": {"duration": "short"}},
+        {"id": 1, "correct": False, "meta": {"duration": "short"}},
+        {"id": 2, "correct": True, "meta": {"duration": "long"}},
+        {"id": 3, "correct": False},
+    ])
+    by = harness.breakdown(res, "duration")
+    assert by["short"] == {"accuracy": 0.5, "n": 2}
+    assert by["long"] == {"accuracy": 1.0, "n": 1}
+    assert by["<untagged>"]["n"] == 1
+
+
+def test_evaluate_carries_meta(tmp_path):
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    records = [{
+        "id": 7, "question": "what?", "options": ["a", "b"],
+        "answer": "A", "meta": {"task_type": "count"},
+    }]
+    res = harness.evaluate(pipe, records, max_new_tokens=2, log_every=0)
+    assert res.records[0]["meta"] == {"task_type": "count"}
+    assert harness.breakdown(res, "task_type")["count"]["n"] == 1
+
+
 def test_merge_results():
     a = harness.EvalResult(0.5, 2, 4, 10.0, [{"id": 0}, {"id": 2}])
     b = harness.EvalResult(1.0, 3, 3, 12.0, [{"id": 1}])

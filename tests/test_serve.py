@@ -155,19 +155,41 @@ def test_generate_stop_sequences(tiny_model):
         inputs_embeds=embeds, lengths=lengths, max_new_tokens=8,
         cache_len=32, key=jax.random.key(1),
     )
-    toks, num = generate_lib.generate(
+    toks, num, _ = generate_lib.generate(
         params["llm"], cfg.llm, cfg.generation, **kw
     )
     toks, num = np.asarray(toks), np.asarray(num)
     assert num[0] >= 4, "need a few tokens for the stop test"
     # Stop on the exact 2-token sequence at positions 1..2.
     stop = jnp.asarray(toks[0, 1:3][None], jnp.int32)
-    toks2, num2 = generate_lib.generate(
+    toks2, num2, fin2 = generate_lib.generate(
         params["llm"], cfg.llm, cfg.generation, stop_sequences=stop, **kw
     )
     toks2, num2 = np.asarray(toks2), np.asarray(num2)
     np.testing.assert_array_equal(toks2[0, :3], toks[0, :3])
     assert num2[0] == 3  # tokens 0..2, ending at the stop sequence
+    assert bool(np.asarray(fin2)[0])  # ended by stop, not by max_new
+
+
+def test_finish_reasons(tiny_model):
+    """Rows cut off by max_new_tokens report "length" (the tiny vocab
+    never contains the Qwen EOS id, so decode always truncates)."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    replies, reasons = pipe.chat_batch(
+        [{"question": "hi"}], max_new_tokens=3, return_finish_reasons=True
+    )
+    assert reasons == ["length"]
+
+    gen = pipe.chat_stream("hi", max_new_tokens=3)
+    parts = []
+    while True:
+        try:
+            parts.append(next(gen))
+        except StopIteration as s:
+            assert s.value == "length"
+            break
+    assert "".join(parts) == replies[0]
 
 
 def test_chat_batch_matches_single(tiny_model):
