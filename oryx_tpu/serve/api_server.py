@@ -77,6 +77,13 @@ def parse_messages(
     pending_system = ""
     for m in messages:
         role, content = m.get("role"), m.get("content", "")
+        if role == "developer":  # OpenAI's modern alias for system
+            role = "system"
+        if role in ("tool", "function"):
+            raise ValueError(
+                "tool/function messages are not supported "
+                "(this model has no tool-calling)"
+            )
         if role not in ("system", "user", "assistant"):
             raise ValueError(f"unsupported message role {role!r}")
         text_parts: list[str] = []
@@ -391,11 +398,16 @@ def main(argv: list[str] | None = None) -> None:
     )
     args = ap.parse_args(argv)
 
+    from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
 
+    try:
+        mesh, mode = parse_shard_arg(args.shard)
+    except ValueError as e:
+        ap.error(str(e))
     pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
-        shard=args.shard,
+        mesh=mesh, sharding_mode=mode,
     )
     srv = build_server(
         pipe, model_name=args.model_name, host=args.host, port=args.port,

@@ -79,6 +79,15 @@ class OryxInference:
         sharding_mode: str = "tp",
     ) -> None:
         self.tokenizer = tokenizer
+        # Ring attention is a TRAINING/prefill configuration (sequence
+        # parallelism, no KV cache); decode needs the cached path. Models
+        # trained under a ring config serve with the equivalent dense
+        # kernel instead of crashing in generate().
+        if cfg.attn_impl.startswith("ring"):
+            import dataclasses
+
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+            cfg = dataclasses.replace(cfg, attn_impl=impl)
         self.cfg = cfg
         self.mesh = mesh
         if mesh is not None:

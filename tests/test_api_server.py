@@ -106,12 +106,24 @@ def test_parse_messages_rejects_bad_shapes():
             {"role": "user", "content": "q"},
             {"role": "system", "content": "answer in JSON"},
         ])
-    # Unsupported roles are an error, not a silent drop.
-    with pytest.raises(ValueError, match="unsupported message role"):
+    # Unsupported roles are an error, not a silent drop; tool/function
+    # get a no-tool-calling message.
+    with pytest.raises(ValueError, match="tool-calling"):
         api_server.parse_messages([
             {"role": "tool", "content": "output"},
             {"role": "user", "content": "q"},
         ])
+    with pytest.raises(ValueError, match="unsupported message role"):
+        api_server.parse_messages([
+            {"role": "narrator", "content": "x"},
+            {"role": "user", "content": "q"},
+        ])
+    # "developer" is OpenAI's alias for system.
+    q, hist, _ = api_server.parse_messages([
+        {"role": "developer", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+    ])
+    assert q == "be brief\nhi"
 
 
 def test_server_reports_length_finish_reason(server):

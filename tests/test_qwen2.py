@@ -82,6 +82,42 @@ def test_logits_parity_padded_batch(hf_model, jx_params):
         np.testing.assert_allclose(got[i, :l], ref, atol=2e-4, rtol=2e-3)
 
 
+def test_logits_parity_yi_llama_path():
+    """Bias-free (Yi-34B class) geometry vs HF LlamaForCausalLM: the
+    Oryx-34B backbone's parity path — GQA, no qkv bias, rms 1e-5 — at
+    tiny scale. Validates model math AND the Llama-family importer."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    yi = cfg_lib.LLMConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        rope_theta=5_000_000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=512, attention_bias=False,
+    )
+    torch.manual_seed(2)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=yi.vocab_size, hidden_size=yi.hidden_size,
+        intermediate_size=yi.intermediate_size,
+        num_hidden_layers=yi.num_layers,
+        num_attention_heads=yi.num_heads,
+        num_key_value_heads=yi.num_kv_heads,
+        head_dim=yi.head_dim, rope_theta=yi.rope_theta,
+        rms_norm_eps=yi.rms_norm_eps,
+        max_position_embeddings=yi.max_position_embeddings,
+        tie_word_embeddings=False, attention_bias=False,
+        attention_dropout=0.0,
+    )).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = import_hf.import_qwen2(sd, yi)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, yi.vocab_size, size=(2, 13))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got, _ = qwen2.forward(params, yi, input_ids=jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-4, rtol=2e-3)
+
+
 def test_kv_cache_decode_matches_full_forward(jx_params):
     """Prefill + single-token cached decode == one uncached forward."""
     rng = np.random.default_rng(2)

@@ -171,6 +171,22 @@ def test_generate_stop_sequences(tiny_model):
     assert bool(np.asarray(fin2)[0])  # ended by stop, not by max_new
 
 
+def test_ring_trained_model_serves(tiny_model):
+    """A model whose saved config says attn_impl='ring' (long-video
+    training) must still decode: serving swaps in the dense kernel."""
+    import dataclasses
+
+    cfg, params = tiny_model
+    ring_cfg = dataclasses.replace(cfg, attn_impl="ring")
+    pipe = OryxInference(FakeTokenizer(), params, ring_cfg)
+    assert pipe.cfg.attn_impl in ("xla", "pallas")
+    ref = OryxInference(FakeTokenizer(), params, cfg)
+    assert (
+        pipe.chat("hello there", max_new_tokens=3)
+        == ref.chat("hello there", max_new_tokens=3)
+    )
+
+
 def test_finish_reasons(tiny_model):
     """Rows cut off by max_new_tokens report "length" (the tiny vocab
     never contains the Qwen EOS id, so decode always truncates)."""
