@@ -181,16 +181,20 @@ def _bench_cfg(backend: str, hbm_bytes: int):
     pol = os.environ.get(
         "BENCH_REMAT_POLICY", "attn" if cfg.attn_impl == "pallas" else ""
     )
+    chunk = os.environ.get("BENCH_LOSS_CHUNK")  # scripts/bench_sweep.py
+    train_updates = {}
     if pol:
+        train_updates.update(
+            remat=pol != "none",
+            remat_policy=pol if pol != "none" else "block",
+        )
+    if chunk:
+        train_updates.update(loss_chunk=int(chunk))
+    if train_updates:
         import dataclasses
 
         cfg = dataclasses.replace(
-            cfg,
-            train=dataclasses.replace(
-                cfg.train,
-                remat=pol != "none",
-                remat_policy=pol if pol != "none" else "block",
-            ),
+            cfg, train=dataclasses.replace(cfg.train, **train_updates)
         )
     return geo_name, cfg, batch_size, seq_bucket, img_patches_side
 

@@ -1,0 +1,71 @@
+"""Perf-knob sweeps over the north-star bench, one subprocess per
+configuration (kernel tile sizes and remat policy are baked at trace
+time, so in-process sweeps would read stale settings).
+
+    python scripts/bench_sweep.py remat          # none|block|attn (dots OOMs)
+    python scripts/bench_sweep.py loss_chunk     # CE chunk 64..512
+    python scripts/bench_sweep.py bwd_blocks     # flash backward tiles
+
+Prints one JSON line per configuration (the bench's own schema) plus a
+final best-by-tok/s line. Run on the real chip; each configuration pays
+one compile (cache via JAX_COMPILATION_CACHE_DIR). Measured v5e results
+live in TPU_VALIDATION.md — re-run after kernel or remat changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SWEEPS = {
+    "remat": [{"BENCH_REMAT_POLICY": p} for p in ("none", "block", "attn")],
+    "loss_chunk": [{"BENCH_LOSS_CHUNK": str(c)} for c in (64, 128, 256, 512)],
+    "bwd_blocks": [
+        {"ORYX_FLASH_BWD_BLOCK_Q": q, "ORYX_FLASH_BWD_BLOCK_K": k}
+        for q, k in (("0", "0"), ("512", "1024"), ("1024", "1024"),
+                     ("1024", "2048"))
+    ],
+}
+
+
+def run_one(extra_env: dict[str, str], timeout: int) -> dict | None:
+    env = {**os.environ, "BENCH_NO_LATENCY": "1", **extra_env}
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"config": extra_env, "error": "timeout"}))
+        return None
+    line = next(
+        (l for l in reversed(out.stdout.splitlines())
+         if l.startswith("{")), None,
+    )
+    if out.returncode != 0 or line is None:
+        print(json.dumps({
+            "config": extra_env, "error": (out.stderr or out.stdout)[-400:],
+        }))
+        return None
+    rec = {"config": extra_env, **json.loads(line)}
+    print(json.dumps(rec))
+    return rec
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "remat"
+    if which not in SWEEPS:
+        raise SystemExit(f"unknown sweep {which!r}; have {sorted(SWEEPS)}")
+    timeout = int(os.environ.get("SWEEP_TIMEOUT_S", "600"))
+    results = [r for e in SWEEPS[which] if (r := run_one(e, timeout))]
+    if results:
+        best = max(results, key=lambda r: r.get("value", 0.0))
+        print(json.dumps({"best": best["config"], "value": best["value"]}))
+
+
+if __name__ == "__main__":
+    main()
