@@ -50,24 +50,18 @@ def build_hybrid_mesh(cfg: MeshConfig, *, num_slices: int) -> Mesh:
     if cfg.dp % num_slices != 0:
         raise ValueError(f"dp={cfg.dp} not divisible by {num_slices} slices")
     per_slice_dp = cfg.dp // num_slices
-    devices = jax.devices()
-    if not hasattr(devices[0], "slice_index"):
-        # Forced-CPU test platform (no slice topology): contiguous
-        # device blocks stand in for slices. Same layout contract as the
-        # TPU path — the dp axis is slice-major, so indices
-        # s*per_slice_dp + d land on "slice" s and fsdp/tp/sp
-        # collectives never cross a slice boundary. Real TPUs never take
-        # this path: their topology errors must surface loudly.
-        if cfg.num_devices != len(devices):
-            raise ValueError(
-                f"mesh {cfg.num_devices} devices != {len(devices)} available"
-            )
-        dev = np.asarray(devices).reshape(cfg.dp, cfg.fsdp, cfg.tp, cfg.sp)
-    else:
-        dev = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(per_slice_dp, cfg.fsdp, cfg.tp, cfg.sp),
-            dcn_mesh_shape=(num_slices, 1, 1, 1),
-        )
+    if jax.default_backend() == "cpu":
+        # Forced-CPU test platform (no slice topology): build_mesh's
+        # contiguous layout already IS the hybrid contract there — the
+        # dp axis is slice-major, so indices s*per_slice_dp + d land on
+        # "slice" s and fsdp/tp/sp collectives never cross a simulated
+        # slice boundary. Real accelerators always go through
+        # create_hybrid_device_mesh so genuine topology errors surface.
+        return build_mesh(cfg)
+    dev = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_slice_dp, cfg.fsdp, cfg.tp, cfg.sp),
+        dcn_mesh_shape=(num_slices, 1, 1, 1),
+    )
     return Mesh(dev, AXES)
 
 

@@ -1,2 +1,5 @@
-from oryx_tpu.serve.builder import load_pretrained_model  # noqa: F401
-from oryx_tpu.serve.pipeline import OryxInference  # noqa: F401
+from oryx_tpu.serve.builder import (  # noqa: F401
+    load_pipeline,
+    load_pretrained_model,
+)
+from oryx_tpu.serve.pipeline import ChatSession, OryxInference  # noqa: F401

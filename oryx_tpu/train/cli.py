@@ -143,9 +143,12 @@ def main(argv: list[str] | None = None) -> None:
 
         # All processes participate: orbax coordinates the multi-host
         # sharded write (a proc-0-only save would deadlock on remote
-        # shards).
+        # shards). Export WEIGHTS only — the optimizer moments are 2/3
+        # of a TrainState's bytes and cfg.train.checkpoint_dir already
+        # holds the resumable full state.
         builder.save_pretrained(
-            args.output_dir, cfg, state, step=int(jax.device_get(state.step))
+            args.output_dir, cfg, state.params,
+            step=int(jax.device_get(state.step)),
         )
         rank0_print(f"saved model to {args.output_dir}")
 

@@ -86,3 +86,78 @@ def test_train_cli_argparser():
         "--tokenizer-path", "tok", "--num-steps", "5",
     ])
     assert args.sharding == "fsdp" and args.num_steps == 5
+
+
+def test_train_cli_end_to_end(tmp_path, monkeypatch):
+    """The SFT entry point runs a step on real (tiny, synthetic) data
+    and exports a LOADABLE weights-only model dir — the exported tree
+    must not drag the optimizer moments along (2/3 of a TrainState)."""
+    import json
+
+    import numpy as np
+    from PIL import Image
+
+    import dataclasses
+
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.serve import builder
+    from oryx_tpu.train import cli as train_cli
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh (conftest)")
+
+    class FakeTok:
+        def encode(self, text, add_special_tokens=False):
+            return [min(ord(c), 500) for c in text]
+
+        def decode(self, ids, skip_special_tokens=True):
+            return "".join(chr(i) for i in ids if 0 < i < 500)
+
+    import transformers
+
+    monkeypatch.setattr(
+        transformers.AutoTokenizer, "from_pretrained",
+        staticmethod(lambda *a, **k: FakeTok()),
+    )
+
+    cfg = cfg_lib.oryx_tiny()
+    cfg = dataclasses.replace(
+        cfg,
+        mesh=cfg_lib.MeshConfig(dp=2, fsdp=4),
+        train=dataclasses.replace(
+            cfg.train, global_batch_size=8, num_train_steps=1,
+            checkpoint_dir=str(tmp_path / "ckpt"), log_every=1,
+        ),
+    )
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(cfg.to_json())
+
+    img = tmp_path / "img.png"
+    Image.fromarray(
+        np.random.default_rng(0).integers(0, 255, (28, 28, 3), dtype=np.uint8)
+    ).save(img)
+    records = [
+        {"id": i, "image": img.name, "conversations": [
+            {"from": "human", "value": "<image>\nwhat?"},
+            {"from": "gpt", "value": "thing"},
+        ]}
+        for i in range(8)
+    ]
+    data_path = tmp_path / "data.json"
+    data_path.write_text(json.dumps(records))
+    out_dir = tmp_path / "model"
+
+    train_cli.main([
+        "--config", str(cfg_path), "--data", str(data_path),
+        "--media-root", str(tmp_path), "--tokenizer-path", "unused",
+        "--output-dir", str(out_dir), "--num-steps", "1",
+    ])
+
+    _, params, cfg2 = builder.load_pretrained_model(
+        str(out_dir), tokenizer=FakeTok()
+    )
+    assert cfg2.llm == cfg.llm
+    # Weights-only export: model subtrees, no TrainState wrapper.
+    assert set(params) == {"llm", "vit", "compressor"}
