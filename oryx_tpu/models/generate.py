@@ -104,18 +104,50 @@ def generate(
     by max_new_tokens (the OpenAI "length" finish reason) rather than by
     EOS/stop.
     """
-    B, T, _ = inputs_embeds.shape
-    assert cache_len >= T + max_new_tokens, (cache_len, T, max_new_tokens)
+    assert cache_len >= inputs_embeds.shape[1] + max_new_tokens, (
+        cache_len, inputs_embeds.shape[1], max_new_tokens
+    )
     if key is None:
         key = jax.random.key(0)
+    carry, key = _prefill_carry(
+        params, cfg, gen_cfg, inputs_embeds, lengths, key,
+        cache_len=cache_len, attn_impl=attn_impl,
+        compute_dtype=compute_dtype,
+        stop_L=0 if stop_sequences is None else stop_sequences.shape[1],
+    )
+    step = _make_decode_step(
+        params, cfg, gen_cfg, stop_sequences,
+        cache_len=cache_len, attn_impl=attn_impl,
+        compute_dtype=compute_dtype,
+    )
+    _, (toks, fin) = jax.lax.scan(
+        init=carry, f=step, xs=jax.random.split(key, max_new_tokens)
+    )
+    toks = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
+    fin = jnp.moveaxis(fin, 0, 1)  # fin[b, t]: row b ended at/before tok t
+    # num generated = tokens up to and including the finishing token (EOS
+    # or the last token of a stop sequence).
+    num = jnp.where(
+        jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
+    )
+    return toks, num.astype(jnp.int32), jnp.any(fin, axis=1)
 
+
+def _prefill_carry(
+    params, cfg: LLMConfig, gen_cfg: GenerationConfig, inputs_embeds,
+    lengths, key, *, cache_len: int, attn_impl: str, compute_dtype,
+    stop_L: int,
+):
+    """Prefill + first sampled token → the decode-scan carry
+    (cache, next token, per-row lengths, finished flags, rolling
+    stop-match window). Shared by `generate` and the streaming path."""
+    B, T, _ = inputs_embeds.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
     kv_mask = (slot_ar < lengths[:, None]).astype(jnp.int32)
 
     cache = qwen2.init_kv_cache(
-        cfg, B, cache_len,
-        dtype=compute_dtype or jnp.float32,
+        cfg, B, cache_len, dtype=compute_dtype or jnp.float32
     )
     logits, cache = qwen2.forward(
         params, cfg,
@@ -127,17 +159,24 @@ def generate(
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]
-
     key, sk = jax.random.split(key)
     tok0 = sample_token(
         last, sk, temperature=gen_cfg.temperature, top_p=gen_cfg.top_p,
         top_k=gen_cfg.top_k,
     )
-
     # Rolling last-L-token window per row for stop-sequence matching; -2
     # init can match neither real ids nor the -1 stop padding.
-    stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
     recent0 = jnp.full((B, stop_L), -2, jnp.int32)
+    return (cache, tok0, lengths, jnp.zeros((B,), bool), recent0), key
+
+
+def _make_decode_step(
+    params, cfg: LLMConfig, gen_cfg: GenerationConfig, stop_sequences,
+    *, cache_len: int, attn_impl: str, compute_dtype,
+):
+    """One decode-scan step over the `_prefill_carry` state — the single
+    definition both `generate` and `_stream_chunk` scan over."""
+    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
 
     def stop_hit(recent):
         if stop_sequences is None:
@@ -163,7 +202,7 @@ def generate(
             logits[:, 0], step_key, temperature=gen_cfg.temperature,
             top_p=gen_cfg.top_p, top_k=gen_cfg.top_k,
         )
-        if stop_L:
+        if recent.shape[1]:
             recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
         finished = (
             finished | (tok == gen_cfg.eos_token_id) | stop_hit(recent)
@@ -171,17 +210,7 @@ def generate(
         nxt = jnp.where(finished, gen_cfg.eos_token_id, nxt)
         return (cache, nxt, cur_len + 1, finished, recent), (tok, finished)
 
-    init = (cache, tok0, lengths, jnp.zeros((B,), bool), recent0)
-    step_keys = jax.random.split(key, max_new_tokens)
-    _, (toks, fin) = jax.lax.scan(init=init, f=step, xs=step_keys)
-    toks = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
-    fin = jnp.moveaxis(fin, 0, 1)  # fin[b, t]: row b ended at/before tok t
-    # num generated = tokens up to and including the finishing token (EOS
-    # or the last token of a stop sequence).
-    num = jnp.where(
-        jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
-    )
-    return toks, num.astype(jnp.int32), jnp.any(fin, axis=1)
+    return step
 
 
 # ---------------------------------------------------------------------------
@@ -201,29 +230,11 @@ def _stream_prefill(
     lengths, key, *, cache_len: int, attn_impl: str, compute_dtype,
     stop_L: int,
 ):
-    B, T, _ = inputs_embeds.shape
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
-    kv_mask = (slot_ar < lengths[:, None]).astype(jnp.int32)
-    cache = qwen2.init_kv_cache(
-        cfg, B, cache_len, dtype=compute_dtype or jnp.float32
+    return _prefill_carry(
+        params, cfg, gen_cfg, inputs_embeds, lengths, key,
+        cache_len=cache_len, attn_impl=attn_impl,
+        compute_dtype=compute_dtype, stop_L=stop_L,
     )
-    logits, cache = qwen2.forward(
-        params, cfg,
-        inputs_embeds=inputs_embeds, positions=positions,
-        kv_cache=cache, write_slots=jnp.zeros((B,), jnp.int32),
-        kv_mask=kv_mask, attn_impl=attn_impl, compute_dtype=compute_dtype,
-    )
-    last = jnp.take_along_axis(
-        logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0]
-    key, sk = jax.random.split(key)
-    tok0 = sample_token(
-        last, sk, temperature=gen_cfg.temperature, top_p=gen_cfg.top_p,
-        top_k=gen_cfg.top_k,
-    )
-    recent0 = jnp.full((B, stop_L), -2, jnp.int32)
-    return (cache, tok0, lengths, jnp.zeros((B,), bool), recent0), key
 
 
 @partial(
@@ -239,39 +250,11 @@ def _stream_chunk(
     stop_sequences, *, cache_len: int, attn_impl: str, compute_dtype,
     chunk: int,
 ):
-    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
-
-    def stop_hit(recent):
-        if stop_sequences is None:
-            return jnp.zeros((recent.shape[0],), bool)
-        m = (stop_sequences[None] == -1) | (
-            recent[:, None, :] == stop_sequences[None]
-        )
-        return jnp.any(jnp.all(m, axis=-1), axis=-1)
-
-    def step(carry, step_key):
-        cache, tok, cur_len, finished, recent = carry
-        pos = cur_len[:, None]
-        kv_mask = (slot_ar <= cur_len[:, None]).astype(jnp.int32)
-        logits, cache = qwen2.forward(
-            params, cfg,
-            input_ids=tok[:, None], positions=pos,
-            kv_cache=cache, write_slots=cur_len,
-            kv_mask=kv_mask, attn_impl=attn_impl,
-            compute_dtype=compute_dtype,
-        )
-        nxt = sample_token(
-            logits[:, 0], step_key, temperature=gen_cfg.temperature,
-            top_p=gen_cfg.top_p, top_k=gen_cfg.top_k,
-        )
-        if recent.shape[1]:
-            recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
-        finished = (
-            finished | (tok == gen_cfg.eos_token_id) | stop_hit(recent)
-        )
-        nxt = jnp.where(finished, gen_cfg.eos_token_id, nxt)
-        return (cache, nxt, cur_len + 1, finished, recent), (tok, finished)
-
+    step = _make_decode_step(
+        params, cfg, gen_cfg, stop_sequences,
+        cache_len=cache_len, attn_impl=attn_impl,
+        compute_dtype=compute_dtype,
+    )
     key, sub = jax.random.split(key)
     carry, (toks, fin) = jax.lax.scan(
         init=carry, f=step, xs=jax.random.split(sub, chunk)
