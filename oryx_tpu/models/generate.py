@@ -218,23 +218,15 @@ def _make_decode_step(
 # ---------------------------------------------------------------------------
 
 
-@partial(
+# The streaming path jits the shared prefill directly (generate traces
+# it inline inside its own jit).
+_stream_prefill = partial(
     jax.jit,
     static_argnames=(
         "cfg", "gen_cfg", "cache_len", "attn_impl", "compute_dtype",
         "stop_L",
     ),
-)
-def _stream_prefill(
-    params, cfg: LLMConfig, gen_cfg: GenerationConfig, inputs_embeds,
-    lengths, key, *, cache_len: int, attn_impl: str, compute_dtype,
-    stop_L: int,
-):
-    return _prefill_carry(
-        params, cfg, gen_cfg, inputs_embeds, lengths, key,
-        cache_len=cache_len, attn_impl=attn_impl,
-        compute_dtype=compute_dtype, stop_L=stop_L,
-    )
+)(_prefill_carry)
 
 
 @partial(

@@ -187,6 +187,22 @@ def test_ring_trained_model_serves(tiny_model):
     )
 
 
+def test_gradio_gate(tiny_model):
+    """Without gradio installed, build_app fails with the actionable
+    message (not an ImportError traceback); with it, the app builds."""
+    from oryx_tpu.serve import gradio_app
+
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    try:
+        import gradio  # noqa: F401
+    except ImportError:
+        with pytest.raises(RuntimeError, match="pip install gradio"):
+            gradio_app.build_app(pipe)
+    else:
+        assert gradio_app.build_app(pipe) is not None
+
+
 def test_finish_reasons(tiny_model):
     """Rows cut off by max_new_tokens report "length" (the tiny vocab
     never contains the Qwen EOS id, so decode always truncates)."""
