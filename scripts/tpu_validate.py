@@ -1,0 +1,202 @@
+"""On-chip Pallas kernel validation: parity vs the XLA attention path
+plus a forward/backward timing probe. Reproduces the evidence recorded
+in TPU_VALIDATION.md with one command:
+
+    python scripts/tpu_validate.py            # real chip (or CPU interpret)
+    python scripts/tpu_validate.py --fast     # parity only, no timings
+
+Case shapes match the TPU_VALIDATION.md tables at the default --seq
+(1024 on TPU): causal GQA B2 T<seq> Hq8 Hk2 D128, segment-packed
+B1 T<3*seq/4> H4 D64, KV-cache decode B4 Tq8 S<2*seq>; the timing probe
+runs B4 T<timing-seq=4096> Hq8 Hk2 D128 with on-device reduction sync.
+Prints one JSON line per case and EXITS NONZERO if any diff exceeds its
+tolerance, so a CI smoke run (CPU interpret mode; use small --seq)
+actually fails on kernel regressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _qkv(jax, jnp, key, B, Tq, Tk, Hq, Hk, D, dtype):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (B, Tq, Hq, D), dtype),
+        jax.random.normal(kk, (B, Tk, Hk, D), dtype),
+        jax.random.normal(kv, (B, Tk, Hk, D), dtype),
+    )
+
+
+def parity_cases(args) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.attention import attention as xla_attention
+    from oryx_tpu.ops.pallas.flash_attention import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    # Outputs are O(1): bf16 forward diffs land ~2 ulp (TPU_VALIDATION.md
+    # measured 1.6e-2); gradients are O(10s) so the backward bound is
+    # absolute-loose / relatively tight.
+    fwd_tol = 3e-2 if on_tpu else 1e-3
+    bwd_tol = 5e-1 if on_tpu else 1e-3
+    T = args.seq
+    ok = True
+
+    def record(name, got, ref, tol):
+        nonlocal ok
+        diff = float(
+            jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)))
+        )
+        passed = diff <= tol
+        ok = ok and passed
+        print(json.dumps({
+            "case": name, "max_abs_diff": round(diff, 6), "tol": tol,
+            "pass": passed,
+        }))
+
+    # 1. Causal GQA prefill forward + backward (B2 T<seq> Hq8 Hk2 D128).
+    q, k, v = _qkv(jax, jnp, jax.random.key(0), 2, T, T, 8, 2, 128, dtype)
+    record(
+        "causal_gqa_fwd",
+        flash_attention(q, k, v, causal=True),
+        xla_attention(q, k, v, causal=True),
+        fwd_tol,
+    )
+
+    def loss(attn):
+        return lambda q, k, v: jnp.sum(
+            attn(q, k, v, causal=True).astype(jnp.float32) ** 2
+        )
+
+    gp = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss(xla_attention), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), gp, gx):
+        record(f"causal_gqa_bwd_{name}", a, b, bwd_tol)
+
+    # 2. Segment-packed (ViT varlen) forward: B1 T<3*seq/4> H4 D64,
+    #    uneven segments.
+    P = max(3 * T // 4, 16)
+    seg = np.zeros(P, np.int32)
+    bounds = [0, P // 5, P // 2, (3 * P) // 4, P]
+    for s, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:]), start=1):
+        seg[lo:hi] = s
+    seg = jnp.asarray(seg)[None]
+    q2, k2, v2 = _qkv(jax, jnp, jax.random.key(1), 1, P, P, 4, 4, 64, dtype)
+    record(
+        "segment_packed_fwd",
+        flash_attention(
+            q2, k2, v2, causal=False, q_segment_ids=seg, kv_segment_ids=seg
+        ),
+        xla_attention(q2, k2, v2, causal=False,
+                      q_segment_ids=seg, kv_segment_ids=seg),
+        fwd_tol,
+    )
+
+    # 3. KV-cache decode layout: B4 Tq8 S<2*seq>, arbitrary q positions
+    #    (the regression case for the causal DMA-clamp fix).
+    S = 2 * T
+    base = jnp.asarray([T - 9, T + 3, 5 + 7, S - 9], jnp.int32)
+    qpos = base[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+    q3, k3, v3 = _qkv(jax, jnp, jax.random.key(2), 4, 8, S, 8, 2, 128, dtype)
+    kv_mask = (
+        jnp.arange(S)[None, :] <= qpos[:, -1:]
+    ).astype(jnp.int32)
+    kw = dict(causal=True, q_positions=qpos, kv_positions=None,
+              kv_mask=kv_mask)
+    record(
+        "kv_cache_decode_fwd",
+        flash_attention(q3, k3, v3, **kw),
+        xla_attention(q3, k3, v3, **kw),
+        fwd_tol,
+    )
+    return ok
+
+
+def timing_probe(args):
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.attention import attention as xla_attention
+    from oryx_tpu.ops.pallas.flash_attention import flash_attention
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    q, k, v = _qkv(
+        jax, jnp, jax.random.key(3), args.batch, args.timing_seq,
+        args.timing_seq, 8, 2, 128, dtype,
+    )
+
+    def timed(fn, reps):
+        # fn reduces ON DEVICE to a scalar, so the sync fetch is 4 bytes —
+        # the axon tunnel's per-fetch latency amortizes over `reps`
+        # instead of inflating every rep (TPU_VALIDATION.md methodology).
+        out = fn(q, k, v)
+        float(jax.device_get(out))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(q, k, v)
+        float(jax.device_get(out))
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    for name, attn in (("flash", flash_attention), ("xla", xla_attention)):
+        def fwd(q, k, v, attn=attn):
+            return jnp.sum(attn(q, k, v, causal=True).astype(jnp.float32))
+
+        def fwdbwd(q, k, v, attn=attn):
+            grads = jax.grad(
+                lambda *a: jnp.sum(
+                    attn(*a, causal=True).astype(jnp.float32) ** 2
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            return sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+
+        print(json.dumps({
+            "timing": name,
+            "fwd_ms": round(timed(jax.jit(fwd), args.reps), 2),
+            "fwdbwd_ms": round(timed(jax.jit(fwdbwd), args.reps), 2),
+            "shape": [args.batch, args.timing_seq, 8, 2, 128],
+        }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seq", type=int, default=None,
+                    help="parity sequence length (default: 1024 on TPU, "
+                    "128 on CPU)")
+    ap.add_argument("--timing-seq", type=int, default=4096,
+                    help="timing-probe sequence length (the MD table's)")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--fast", action="store_true", help="parity only")
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    if args.seq is None:
+        args.seq = 1024 if backend == "tpu" else 128
+    print(json.dumps({
+        "backend": backend,
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "seq": args.seq,
+    }))
+    ok = parity_cases(args)
+    if not args.fast and backend == "tpu":
+        timing_probe(args)
+    if not ok:
+        raise SystemExit("kernel parity FAILED (see cases above)")
+
+
+if __name__ == "__main__":
+    main()
