@@ -32,12 +32,48 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 PLACEHOLDER_BASELINE_TOK_S_CHIP = 2000.0
 BASELINE_SOURCE = "placeholder_2000_tok_s_chip_unverified"
+
+# ---- tunnel defense (parent supervisor) -----------------------------------
+# The axon TPU tunnel degrades for hours at a time; a bare
+# jax.default_backend() then dies with a raw traceback and the round's
+# perf artifact records nothing (BENCH_r01/r03). The parent process below
+# NEVER imports jax (so it never dials the tunnel or holds a chip claim);
+# it probes the backend in a throwaway subprocess with a hard timeout,
+# retries across a bounded backoff window, runs the real bench in a
+# second subprocess, and — whatever happens — always prints ONE parseable
+# JSON line (a metric or {"error": ...}) as its last stdout line.
+_BENCH_CHILD_ENV = "ORYX_TPU_BENCH_CHILD"
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+PROBE_BACKOFF_S = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "300"))
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "3600"))
+
+# Sync via device_get: block_until_ready is a no-op over the axon
+# remote-chip transport.
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "v = float(jax.device_get(jnp.sum(jnp.ones((256, 256), jnp.float32)))); "
+    "assert v == 65536.0, v; "
+    "print('BENCH_PROBE_OK', jax.default_backend(), flush=True)"
+)
+
+# Substrings in child stderr that mean "infrastructure, retry" rather
+# than "repo bug, fail fast".
+_TUNNEL_ERR_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "Unable to initialize backend",
+    "Connection reset",
+    "Socket closed",
+)
 
 WARMUP_STEPS = 2
 TIMED_STEPS = 5
@@ -302,6 +338,100 @@ def bench_video_latency(params, cfg) -> float | None:
     return float(np.percentile(times, 50))
 
 
+def _probe_once() -> tuple[bool, str]:
+    """Touch the default backend in a throwaway subprocess with a hard
+    timeout. Returns (ok, tail-of-output)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {PROBE_TIMEOUT_S}s"
+    out = (proc.stdout or "") + (proc.stderr or "")
+    ok = proc.returncode == 0 and "BENCH_PROBE_OK" in out
+    return ok, "\n".join(out.strip().splitlines()[-8:])
+
+
+def _run_bench_child() -> tuple[int | None, str, str]:
+    """Run the real bench in a subprocess → (rc, stdout, stderr); rc None
+    means killed on timeout."""
+    env = dict(os.environ)
+    env[_BENCH_CHILD_ENV] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired as e:
+        def txt(x):
+            return x.decode() if isinstance(x, bytes) else (x or "")
+        return None, txt(e.stdout), (
+            txt(e.stderr) + f"\n# bench child killed after {CHILD_TIMEOUT_S}s"
+        )
+    return proc.returncode, proc.stdout or "", proc.stderr or ""
+
+
+def _find_json_line(out: str) -> str | None:
+    """Last stdout line that parses as the bench's JSON contract."""
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and ("metric" in d or "error" in d):
+            return line
+    return None
+
+
+def _emit_error(kind: str, detail: str, attempts: int) -> None:
+    print(json.dumps({
+        "error": kind,
+        "detail": detail[-2000:],
+        "attempts": attempts,
+        "probe_timeout_s": PROBE_TIMEOUT_S,
+        "probe_backoff_s": PROBE_BACKOFF_S,
+    }))
+    sys.exit(1)
+
+
+def _supervise() -> None:
+    """Parent: probe → bench child → retry across tunnel flaps. Never
+    imports jax; never exits without a parseable JSON line."""
+    last = ""
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        ok, tail = _probe_once()
+        print(f"# probe attempt {attempt}/{PROBE_ATTEMPTS}: "
+              f"{'ok' if ok else 'FAILED'}", flush=True)
+        if ok:
+            rc, out, err = _run_bench_child()
+            line = _find_json_line(out)
+            if rc == 0 and line:
+                # Pass the child's stdout through (latency notes etc.),
+                # then re-print the JSON line so it is LAST on stdout.
+                body = "\n".join(
+                    ln for ln in out.strip().splitlines() if ln.strip() != line
+                )
+                if body:
+                    print(body)
+                print(line)
+                return
+            both = out + err
+            last = "\n".join(both.strip().splitlines()[-15:])
+            infra = rc is None or any(m in both for m in _TUNNEL_ERR_MARKERS)
+            if not infra:
+                _emit_error("bench_failed", last, attempt)
+        else:
+            last = tail
+        if attempt < PROBE_ATTEMPTS:
+            print(f"# backing off {PROBE_BACKOFF_S}s before retry", flush=True)
+            time.sleep(PROBE_BACKOFF_S)
+    _emit_error("tpu_unavailable", last, PROBE_ATTEMPTS)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -373,4 +503,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # CPU-pinned runs (CI, smoke) don't dial the tunnel — no defense
+    # needed; run in-process. Everything else goes through the supervisor.
+    if (
+        os.environ.get(_BENCH_CHILD_ENV) == "1"
+        or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    ):
+        main()
+    else:
+        _supervise()

@@ -56,6 +56,12 @@ def make_stop_sequences(
     (SURVEY.md §2 "MM utils") encodes each keyword once and compares the
     trailing generated ids — here the comparison happens inside the jitted
     decode scan so multi-token stops end rows without burning decode steps.
+
+    Shapes are bucketed (S to a power of two, L to a multiple of 4) so
+    per-request stop lists share compiled programs: -1 left-padding is a
+    wildcard (matches any id), and filler ROWS are -3 throughout — -3
+    equals neither real ids (>= 0), the -2 window init, nor the -1
+    wildcard, so a filler row can never fire.
     """
     seqs = []
     for s in stop_strs:
@@ -66,9 +72,11 @@ def make_stop_sequences(
             seqs.append(np.asarray(ids, np.int32))
     if not seqs:
         return None
-    L = max(len(s) for s in seqs)
-    out = np.full((len(seqs), L), -1, np.int32)
+    L = -(-max(len(s) for s in seqs) // 4) * 4
+    S = 1 << (len(seqs) - 1).bit_length()
+    out = np.full((S, L), -3, np.int32)
     for i, s in enumerate(seqs):
+        out[i, : L - len(s)] = -1
         out[i, L - len(s):] = s
     return jnp.asarray(out)
 
@@ -233,25 +241,20 @@ _stream_prefill = partial(
     jax.jit,
     static_argnames=(
         "cfg", "gen_cfg", "cache_len", "attn_impl", "compute_dtype",
-        "chunk",
     ),
     donate_argnames=("carry",),
 )
 def _stream_chunk(
-    params, cfg: LLMConfig, gen_cfg: GenerationConfig, carry, key,
+    params, cfg: LLMConfig, gen_cfg: GenerationConfig, carry, step_keys,
     stop_sequences, *, cache_len: int, attn_impl: str, compute_dtype,
-    chunk: int,
 ):
     step = _make_decode_step(
         params, cfg, gen_cfg, stop_sequences,
         cache_len=cache_len, attn_impl=attn_impl,
         compute_dtype=compute_dtype,
     )
-    key, sub = jax.random.split(key)
-    carry, (toks, fin) = jax.lax.scan(
-        init=carry, f=step, xs=jax.random.split(sub, chunk)
-    )
-    return carry, jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1), key
+    carry, (toks, fin) = jax.lax.scan(init=carry, f=step, xs=step_keys)
+    return carry, jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1)
 
 
 def generate_stream(
@@ -271,7 +274,10 @@ def generate_stream(
 ):
     """Streaming twin of `generate` (HF TextIteratorStreamer parity):
     yields np int32 token blocks [B, <=chunk] as they decode, with the
-    same semantics (EOS fill after finish, stop sequences end rows).
+    same semantics (EOS fill after finish, stop sequences end rows) AND
+    the same RNG stream — the post-prefill key is pre-split into one key
+    per step (jax.random.split is prefix-stable), so sampled outputs
+    match `generate` token-for-token at any temperature.
     The decode runs WHOLE `chunk`-token compiled dispatches (a shrunken
     final chunk would compile a second decode program); overshoot
     tokens past max_new_tokens are computed and dropped, so cache_len
@@ -293,11 +299,12 @@ def generate_stream(
         params, cfg, gen_cfg, inputs_embeds, lengths, key,
         stop_L=stop_L, **common,
     )
+    step_keys = jax.random.split(key, padded_new)
     done = 0
     while done < max_new_tokens:
-        carry, toks, fin, key = _stream_chunk(
-            params, cfg, gen_cfg, carry, key, stop_sequences,
-            chunk=chunk, **common,
+        carry, toks, fin = _stream_chunk(
+            params, cfg, gen_cfg, carry, step_keys[done:done + chunk],
+            stop_sequences, **common,
         )
         n = min(chunk, max_new_tokens - done)
         toks, fin = np.asarray(toks)[:, :n], np.asarray(fin)[:, :n]

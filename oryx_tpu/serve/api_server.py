@@ -15,8 +15,11 @@ web-framework dependency.
 Content may be a plain string or OpenAI content-part lists; image parts
 (`{"type": "image_url", "image_url": {"url": "data:image/...;base64,..."
 | "file:///path" | "/path"}}`) attach media to the turn. Multi-turn
-history maps onto the conversation template (media pinned to the first
-turn, as everywhere in this framework).
+history maps onto the conversation template; media bind to the FIRST
+user turn (as everywhere in this framework) and are rejected elsewhere
+with a 400. `temperature`, `top_p`, `stop` and `seed` are honored per
+request (requests batch together only when they match); `n > 1` and
+`logprobs` are rejected with a 400 rather than silently ignored.
 
 Dynamic batching: non-streaming requests arriving within `batch_window`
 seconds are decoded as ONE `chat_batch` program (the TPU batching win);
@@ -87,6 +90,7 @@ def parse_messages(
         if role not in ("system", "user", "assistant"):
             raise ValueError(f"unsupported message role {role!r}")
         text_parts: list[str] = []
+        msg_images: list[np.ndarray] = []
         if isinstance(content, str):
             text_parts.append(content)
         else:
@@ -94,10 +98,27 @@ def parse_messages(
                 if part.get("type") == "text":
                     text_parts.append(part.get("text", ""))
                 elif part.get("type") == "image_url":
-                    images.append(_decode_image(
+                    msg_images.append(_decode_image(
                         part["image_url"]["url"],
                         allow_local_files=allow_local_files,
                     ))
+        if msg_images:
+            # The conversation template binds media to the FIRST user
+            # turn; accepting them elsewhere would silently re-pin them
+            # (diverging from OpenAI's attach-to-carrier semantics), so
+            # reject instead.
+            if role != "user":
+                raise ValueError(
+                    f"image parts are only supported on user messages "
+                    f"(got {role!r})"
+                )
+            if turns:
+                raise ValueError(
+                    "images must attach to the FIRST user message: this "
+                    "model binds all media to the conversation's opening "
+                    "turn"
+                )
+            images.extend(msg_images)
         text = "\n".join(t for t in text_parts if t)
         if role == "system":
             # Multiple system messages concatenate (never overwrite).
@@ -125,13 +146,32 @@ def parse_messages(
 
 
 class _Pending:
-    def __init__(self, request: dict[str, Any], max_new: int):
+    def __init__(
+        self, request: dict[str, Any], max_new: int,
+        sampling: dict[str, Any] | None = None,
+    ):
         self.request = request
         self.max_new = max_new
+        # Decode-program parameters: requests batch together only when
+        # ALL of these match (they share one compiled decode).
+        self.sampling = sampling or {}
         self.done = threading.Event()
         self.reply: str | None = None
         self.finish_reason: str = "stop"
         self.error: str | None = None
+
+    @property
+    def batch_key(self) -> tuple:
+        s = self.sampling
+        # A sampled row's draw depends on its ROW INDEX in the batch
+        # (per-row Gumbel noise), so an explicitly seeded request only
+        # reproduces at a fixed row — run it solo (unique key) instead
+        # of batching it with look-alikes.
+        solo = id(self) if "seed" in s else None
+        return (
+            self.max_new, s.get("temperature"), s.get("top_p"),
+            tuple(s.get("stop") or ()), s.get("seed"), solo,
+        )
 
 
 class Batcher:
@@ -139,9 +179,10 @@ class Batcher:
 
     A single worker thread drains the queue: it waits `window` seconds
     after the first pending request for company (requests with the same
-    max_tokens batch together), then runs the whole group as one
-    compiled decode. `device_lock` serializes the device against
-    concurrent streaming requests; HTTP threads only enqueue and wait.
+    max_tokens AND sampling parameters batch together), then runs the
+    whole group as one compiled decode. `device_lock` serializes the
+    device against concurrent streaming requests; HTTP threads only
+    enqueue and wait.
     """
 
     def __init__(
@@ -164,8 +205,11 @@ class Batcher:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
-    def submit(self, request: dict[str, Any], max_new: int) -> _Pending:
-        p = _Pending(request, max_new)
+    def submit(
+        self, request: dict[str, Any], max_new: int,
+        sampling: dict[str, Any] | None = None,
+    ) -> _Pending:
+        p = _Pending(request, max_new, sampling)
         self.q.put(p)
         return p
 
@@ -183,17 +227,22 @@ class Batcher:
                     nxt = self.q.get(timeout=left)
                 except queue.Empty:
                     break
-                if nxt.max_new != first.max_new:
-                    # Different decode length → it LEADS the next group.
+                if nxt.batch_key != first.batch_key:
+                    # Different decode program → it LEADS the next group.
                     self._carry = nxt
                     break
                 group.append(nxt)
+            s = first.sampling
             try:
                 with self.device_lock:
                     replies, reasons = self.pipe.chat_batch(
                         [p.request for p in group],
                         max_new_tokens=first.max_new,
                         return_finish_reasons=True,
+                        temperature=s.get("temperature"),
+                        top_p=s.get("top_p"),
+                        stop=s.get("stop"),
+                        seed=s.get("seed") or 0,
                     )
                 for p, r, why in zip(group, replies, reasons):
                     p.reply, p.finish_reason = r, why
@@ -202,6 +251,43 @@ class Batcher:
                     p.error = f"{type(e).__name__}: {e}"
             for p in group:
                 p.done.set()
+
+
+def _parse_sampling(req: dict[str, Any]) -> dict[str, Any]:
+    """Validate OpenAI sampling fields → kwargs for chat_batch /
+    chat_stream. Unsupported values raise (→ 400) instead of being
+    silently ignored."""
+    if int(req.get("n", 1)) != 1:
+        raise ValueError("n > 1 is not supported")
+    if req.get("logprobs"):
+        raise ValueError("logprobs is not supported")
+    out: dict[str, Any] = {}
+    # temperature/top_p become STATIC jit arguments downstream (one
+    # compiled decode per distinct value) — quantize to 2 decimals so a
+    # client sweeping arbitrary floats can't force unbounded recompiles.
+    if (t := req.get("temperature")) is not None:
+        t = float(t)
+        if not 0.0 <= t <= 2.0:
+            raise ValueError(f"temperature must be in [0, 2], got {t}")
+        out["temperature"] = round(t, 2)
+    if (p := req.get("top_p")) is not None:
+        p = float(p)
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {p}")
+        out["top_p"] = round(p, 2)
+    if (stop := req.get("stop")) is not None:
+        if isinstance(stop, str):
+            stop = [stop]
+        if not (
+            isinstance(stop, list)
+            and all(isinstance(s, str) for s in stop)
+            and len(stop) <= 8
+        ):
+            raise ValueError("stop must be a string or <=8 strings")
+        out["stop"] = [s for s in stop if s]
+    if (seed := req.get("seed")) is not None:
+        out["seed"] = int(seed)
+    return out
 
 
 def _completion_body(
@@ -301,6 +387,7 @@ def build_server(
                         raise ValueError(
                             f"max_tokens must be >= 1, got {max_new}"
                         )
+                sampling = _parse_sampling(req)
             except Exception as e:
                 self._json(400, {"error": {
                     "message": f"{type(e).__name__}: {e}",
@@ -313,47 +400,68 @@ def build_server(
                 # A producer thread owns the device (and the lock); this
                 # handler thread only writes to the socket, so a slow or
                 # stalled client can never block the device for others.
-                deltas: queue.Queue[tuple[str, str | None]] = queue.Queue()
+                # The queue is bounded and `gone` signals a dead client:
+                # the producer then stops decoding between chunks instead
+                # of holding stream_lock for up to max_tokens of decode.
+                deltas: queue.Queue[tuple[str, str | None]] = queue.Queue(
+                    maxsize=64
+                )
+                gone = threading.Event()
+
+                def put(item) -> bool:
+                    while not gone.is_set():
+                        try:
+                            deltas.put(item, timeout=0.5)
+                            return True
+                        except queue.Full:
+                            continue
+                    return False
 
                 def produce():
                     gen = pipe.chat_stream(
                         question, images=images or None,
                         is_video=is_video, history=history,
-                        max_new_tokens=max_new,
+                        max_new_tokens=max_new, **sampling,
                     )
                     try:
                         with stream_lock:
-                            while True:
+                            while not gone.is_set():
                                 try:
                                     d = next(gen)
                                 except StopIteration as s:
                                     # Generator return value = reason.
-                                    deltas.put(("end", s.value or "stop"))
+                                    put(("end", s.value or "stop"))
                                     return
-                                deltas.put(("delta", d))
+                                if not put(("delta", d)):
+                                    return
                     except Exception as e:
-                        deltas.put(("error", f"{type(e).__name__}: {e}"))
+                        put(("error", f"{type(e).__name__}: {e}"))
+                    finally:
+                        gen.close()
 
                 threading.Thread(target=produce, daemon=True).start()
                 cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.end_headers()
-                while True:
-                    kind, payload = deltas.get()
-                    if kind == "delta":
-                        self._sse(_chunk_body(model_name, cid, payload))
-                    elif kind == "error":
-                        self._sse({"error": {"message": payload}})
-                        break
-                    else:
-                        self._sse(
-                            _chunk_body(model_name, cid, None, payload)
-                        )
-                        break
-                self.wfile.write(b"data: [DONE]\n\n")
-                self.wfile.flush()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    while True:
+                        kind, payload = deltas.get()
+                        if kind == "delta":
+                            self._sse(_chunk_body(model_name, cid, payload))
+                        elif kind == "error":
+                            self._sse({"error": {"message": payload}})
+                            break
+                        else:
+                            self._sse(
+                                _chunk_body(model_name, cid, None, payload)
+                            )
+                            break
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    gone.set()  # stop the producer at its next chunk
                 return
 
             pending = batcher.submit(
@@ -362,6 +470,7 @@ def build_server(
                     "is_video": is_video, "history": history,
                 },
                 max_new,
+                sampling,
             )
             pending.done.wait()
             if pending.error is not None:

@@ -155,6 +155,9 @@ class OryxInference:
         history: Sequence[tuple[str, str]] | None = None,
         max_new_tokens: int | None = None,
         seed: int = 0,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        stop: Sequence[str] | None = None,
     ) -> str:
         """QA over optional images / video frames. history: prior
         (user, assistant) turns of the same conversation (media stay
@@ -168,7 +171,40 @@ class OryxInference:
             }],
             max_new_tokens=max_new_tokens,
             seed=seed,
+            temperature=temperature,
+            top_p=top_p,
+            stop=stop,
         )[0]
+
+    def _sampling_cfg(
+        self, temperature: float | None, top_p: float | None
+    ) -> OryxConfig:
+        """Config with per-request sampling overrides. The returned cfg is
+        a static jit argument — equal values hit the same compiled
+        program, so overrides cost at most one compile per distinct
+        (temperature, top_p) pair."""
+        if temperature is None and top_p is None:
+            return self.cfg
+        import dataclasses
+
+        gen = self.cfg.generation
+        updates = {}
+        if temperature is not None:
+            updates["temperature"] = float(temperature)
+        if top_p is not None:
+            updates["top_p"] = float(top_p)
+        return dataclasses.replace(
+            self.cfg, generation=dataclasses.replace(gen, **updates)
+        )
+
+    def _stop_for(self, stop: Sequence[str] | None):
+        """Stop-id matrix for the template stop plus request stops."""
+        if not stop:
+            return self.stop_sequences
+        strs = [self.conv.stop_str] if self.conv.stop_str else []
+        return generate_lib.make_stop_sequences(
+            strs + list(stop), self.tokenizer
+        )
 
     def _prepare_request(
         self, req: dict[str, Any]
@@ -212,6 +248,9 @@ class OryxInference:
         max_new_tokens: int | None = None,
         seed: int = 0,
         return_finish_reasons: bool = False,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        stop: Sequence[str] | None = None,
     ) -> list[str] | tuple[list[str], list[str]]:
         """Batched single-turn QA: one ViT + compressor + decode scan for
         the whole batch (the batching win the reference gets from varlen
@@ -222,8 +261,12 @@ class OryxInference:
         Mixed text-only / image / multi-image / video rows are fine.
         return_finish_reasons: also return per-row "stop" (EOS or stop
         string) vs "length" (cut off by max_new_tokens).
+        temperature/top_p override the config defaults for this call;
+        stop adds request stop strings on top of the template's.
         """
-        max_new = max_new_tokens or self.cfg.generation.max_new_tokens
+        cfg = self._sampling_cfg(temperature, top_p)
+        stop_seqs = self._stop_for(stop)
+        max_new = max_new_tokens or cfg.generation.max_new_tokens
         key = jax.random.key(seed)
         all_images: list[np.ndarray] = []
         side_factors: list[int] = []
@@ -237,12 +280,14 @@ class OryxInference:
             max_patches.extend(caps)
 
         if not all_images:
-            toks, num, fin = self._text_batch(ids_rows, max_new, key)
+            toks, num, fin = self._text_batch(
+                ids_rows, max_new, key, cfg=cfg, stop_seqs=stop_seqs
+            )
         else:
             packed = packing.pack_raw_images(
                 all_images,
-                patch_size=self.cfg.vision.patch_size,
-                base_grid=self.cfg.vision.base_grid,
+                patch_size=cfg.vision.patch_size,
+                base_grid=cfg.vision.base_grid,
                 side_factors=side_factors,
                 max_patches=max_patches,
             )
@@ -251,19 +296,23 @@ class OryxInference:
             )
             with self._mesh_scope():
                 toks, num, fin = oryx.mm_generate(
-                    self.params, self.cfg, packed, batch,
+                    self.params, cfg, packed, batch,
                     max_new_tokens=max_new, key=key,
-                    stop_sequences=self.stop_sequences,
+                    stop_sequences=stop_seqs,
                 )
         replies = [
-            self._decode(toks[b], int(num[b])) for b in range(len(toks))
+            self._decode(toks[b], int(num[b]), extra_stops=stop)
+            for b in range(len(toks))
         ]
         if not return_finish_reasons:
             return replies
         reasons = ["stop" if bool(f) else "length" for f in fin]
         return replies, reasons
 
-    def _text_batch(self, ids_rows, max_new: int, key):
+    def _text_batch(self, ids_rows, max_new: int, key, *, cfg=None,
+                    stop_seqs=None):
+        cfg = cfg or self.cfg
+        stop_seqs = stop_seqs if stop_seqs is not None else self.stop_sequences
         B = len(ids_rows)
         T = packing.round_up_bucket(max(len(r) for r in ids_rows))
         rows = np.zeros((B, T), np.int32)
@@ -274,9 +323,9 @@ class OryxInference:
         cache_len = packing.round_up_bucket(T + max_new)
         with self._mesh_scope():
             toks, num, fin = _jit_text_generate(
-                self.params, self.cfg, jnp.asarray(rows),
+                self.params, cfg, jnp.asarray(rows),
                 jnp.asarray(lengths), max_new, cache_len, key,
-                self.stop_sequences,
+                stop_seqs,
             )
         return np.asarray(toks), np.asarray(num), np.asarray(fin)
 
@@ -290,6 +339,9 @@ class OryxInference:
         max_new_tokens: int | None = None,
         seed: int = 0,
         chunk: int = 8,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        stop: Sequence[str] | None = None,
     ):
         """Streaming `chat` (HF TextIteratorStreamer parity): yields text
         DELTAS as tokens decode; ''.join(deltas) equals chat()'s reply
@@ -298,10 +350,13 @@ class OryxInference:
         Single request; decode runs `chunk` tokens per device dispatch.
         The generator's RETURN value (StopIteration.value) is the finish
         reason: "stop" (EOS/stop string) or "length" (max_new_tokens).
+        temperature/top_p/stop override per request as in `chat_batch`.
         """
-        max_new = max_new_tokens or self.cfg.generation.max_new_tokens
+        cfg = self._sampling_cfg(temperature, top_p)
+        stop_seqs = self._stop_for(stop)
+        max_new = max_new_tokens or cfg.generation.max_new_tokens
         key = jax.random.key(seed)
-        cfgv = self.cfg.vision
+        cfgv = cfg.vision
         ids, images, factors, caps = self._prepare_request({
             "question": question, "images": list(images or []),
             "is_video": is_video, "history": list(history or []),
@@ -327,7 +382,7 @@ class OryxInference:
                 "is_visual": jnp.asarray(batch.is_visual),
             }
             with self._mesh_scope():
-                embeds = oryx.mm_embeds(self.params, self.cfg, arrays)
+                embeds = oryx.mm_embeds(self.params, cfg, arrays)
             lengths = jnp.asarray(batch.lengths)
         else:
             T = packing.round_up_bucket(len(ids))
@@ -344,37 +399,50 @@ class OryxInference:
         # and the cache is sized for the padded length.
         padded_new = -(-max_new // chunk) * chunk
         cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
-        eos = self.cfg.generation.eos_token_id
-        stop = self.conv.stop_str
+        eos = cfg.generation.eos_token_id
+        stops = ([self.conv.stop_str] if self.conv.stop_str else []) + [
+            s for s in (stop or []) if s  # "" would truncate everything
+        ]
         emitted: list[int] = []
         text_done = ""
         finished = False
 
+        def trim_stops(text: str) -> tuple[str, bool]:
+            """Cut at the earliest full stop-string occurrence."""
+            cut = min(
+                (i for s in stops if (i := text.find(s)) >= 0),
+                default=-1,
+            )
+            return (text[:cut], True) if cut >= 0 else (text, False)
+
         def stable_prefix(text: str) -> str:
             """The prefix of `text` that can never change as more tokens
             decode: hold back an incomplete UTF-8 tail (U+FFFD), any
-            suffix that could grow into the stop string, and leading/
+            suffix that could grow into a stop string, and leading/
             trailing whitespace (chat() strips both ends; lstrip is
             consistent across calls, rstripped text re-emits once
             non-whitespace follows)."""
             text = text.lstrip()
             while text.endswith("�"):
                 text = text[:-1]
-            if stop:
-                for i in range(len(stop) - 1, 0, -1):
-                    if text.endswith(stop[:i]):
-                        text = text[: len(text) - i]
+            held = 0
+            for s in stops:
+                for i in range(len(s) - 1, 0, -1):
+                    if text.endswith(s[:i]):
+                        held = max(held, i)
                         break
+            if held:
+                text = text[: len(text) - held]
             return text.rstrip()
 
         with self._mesh_scope():
             for block in generate_lib.generate_stream(
-                self.params["llm"], self.cfg.llm, self.cfg.generation,
+                self.params["llm"], cfg.llm, cfg.generation,
                 inputs_embeds=embeds, lengths=lengths,
                 max_new_tokens=max_new, cache_len=cache_len, key=key,
-                attn_impl=self.cfg.attn_impl,
-                compute_dtype=oryx.compute_dtype(self.cfg),
-                stop_sequences=self.stop_sequences, chunk=chunk,
+                attn_impl=cfg.attn_impl,
+                compute_dtype=oryx.compute_dtype(cfg),
+                stop_sequences=stop_seqs, chunk=chunk,
             ):
                 for t in block[0]:
                     if int(t) == eos:
@@ -384,8 +452,8 @@ class OryxInference:
                 text = self.tokenizer.decode(
                     emitted, skip_special_tokens=True
                 )
-                if stop and stop in text:
-                    text, finished = text.split(stop)[0], True
+                text, hit = trim_stops(text)
+                finished = finished or hit
                 safe = text.strip() if finished else stable_prefix(text)
                 if len(safe) > len(text_done):
                     yield safe[len(text_done):]
@@ -414,15 +482,23 @@ class OryxInference:
             frames = [frames[i] for i in idx]
         return self.chat(question, images=frames, is_video=True, **kw)
 
-    def _decode(self, tokens: np.ndarray, num: int) -> str:
+    def _decode(
+        self, tokens: np.ndarray, num: int,
+        extra_stops: Sequence[str] | None = None,
+    ) -> str:
         ids = [int(t) for t in tokens[:num]]
         eos = self.cfg.generation.eos_token_id
         while ids and ids[-1] == eos:
             ids.pop()
         text = self.tokenizer.decode(ids, skip_special_tokens=True)
-        stop = self.conv.stop_str
-        if stop and stop in text:
-            text = text.split(stop)[0]
+        stops = ([self.conv.stop_str] if self.conv.stop_str else []) + [
+            s for s in (extra_stops or []) if s  # "" would match at 0
+        ]
+        cut = min(
+            (i for s in stops if (i := text.find(s)) >= 0), default=-1
+        )
+        if cut >= 0:
+            text = text[:cut]
         return text.strip()
 
 

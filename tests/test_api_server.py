@@ -158,7 +158,7 @@ def test_batcher_groups_and_fifo():
 
     class StubPipe:
         def chat_batch(self, requests, max_new_tokens,
-                       return_finish_reasons=False):
+                       return_finish_reasons=False, **sampling):
             calls.append(
                 ([r["question"] for r in requests], max_new_tokens)
             )
@@ -288,3 +288,109 @@ def test_server_bad_request(server):
     except urllib.error.HTTPError as e:
         assert e.code == 400
         assert "invalid_request_error" in e.read().decode()
+
+
+def test_parse_sampling_validation():
+    assert api_server._parse_sampling({}) == {}
+    s = api_server._parse_sampling({
+        "temperature": 0.7, "top_p": 0.9, "stop": "###", "seed": 3,
+    })
+    assert s == {
+        "temperature": 0.7, "top_p": 0.9, "stop": ["###"], "seed": 3,
+    }
+    # stop list normalizes, empties dropped
+    assert api_server._parse_sampling({"stop": ["a", "", "b"]})["stop"] == [
+        "a", "b"
+    ]
+    for bad in (
+        {"n": 2},
+        {"logprobs": True},
+        {"temperature": -0.1},
+        {"temperature": 2.5},
+        {"top_p": 0.0},
+        {"top_p": 1.5},
+        {"stop": [1, 2]},
+        {"stop": ["x"] * 9},
+    ):
+        with pytest.raises((ValueError, TypeError)):
+            api_server._parse_sampling(bad)
+
+
+def test_parse_messages_rejects_misplaced_images():
+    img = np.zeros((8, 8, 3), np.uint8)
+    part = {"type": "image_url", "image_url": {"url": _data_uri(img)}}
+    # Image on an assistant message.
+    with pytest.raises(ValueError, match="user messages"):
+        api_server.parse_messages([
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "content": [
+                {"type": "text", "text": "a"}, part,
+            ]},
+            {"role": "user", "content": "q2"},
+        ])
+    # Image on a non-first user turn (would silently re-pin to turn 1).
+    with pytest.raises(ValueError, match="FIRST user message"):
+        api_server.parse_messages([
+            {"role": "user", "content": "q"},
+            {"role": "assistant", "content": "a"},
+            {"role": "user", "content": [
+                {"type": "text", "text": "and this?"}, part,
+            ]},
+        ])
+    # First-turn image stays accepted.
+    _, _, images = api_server.parse_messages([
+        {"role": "user", "content": [
+            {"type": "text", "text": "what?"}, part,
+        ]},
+        {"role": "assistant", "content": "a"},
+        {"role": "user", "content": "why?"},
+    ])
+    assert len(images) == 1
+
+
+def test_batcher_splits_on_sampling_params():
+    calls = []
+
+    class StubPipe:
+        def chat_batch(self, requests, max_new_tokens,
+                       return_finish_reasons=False, **sampling):
+            calls.append((
+                [r["question"] for r in requests],
+                sampling.get("temperature"),
+            ))
+            replies = [r["question"].upper() for r in requests]
+            return replies, ["stop"] * len(replies)
+
+    b = api_server.Batcher(StubPipe(), window=2.0, max_batch=8)
+    pending = [
+        b.submit({"question": "a"}, 4, {"temperature": 0.5}),
+        b.submit({"question": "b"}, 4, {"temperature": 0.5}),
+        b.submit({"question": "c"}, 4, {}),  # different program
+    ]
+    for p in pending:
+        assert p.done.wait(timeout=30)
+    assert [p.reply for p in pending] == ["A", "B", "C"]
+    assert calls == [(["a", "b"], 0.5), (["c"], None)], calls
+
+
+def test_server_sampling_roundtrip(server):
+    url, pipe = server
+    body = {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5, "temperature": 0.9, "top_p": 0.95, "seed": 7,
+    }
+    with _post(url, body) as resp:
+        reply = json.load(resp)["choices"][0]["message"]["content"]
+    # Same params through the pipeline directly -> identical sample.
+    assert reply == pipe.chat(
+        "hello there", max_new_tokens=5, temperature=0.9, top_p=0.95,
+        seed=7,
+    )
+    # Unsupported n > 1 is a 400, not a silent ignore.
+    try:
+        _post(url, {
+            "messages": [{"role": "user", "content": "q"}], "n": 2,
+        })
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

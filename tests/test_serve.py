@@ -387,3 +387,44 @@ def test_sharded_restore_from_checkpoint(tmp_path, tiny_model):
     assert all(
         l.dtype == jnp.bfloat16 for l in jax.tree_util.tree_leaves(bf16)
     )
+
+
+def test_chat_stream_sampled_matches_chat(tiny_model):
+    """RNG parity at temperature > 0: the stream pre-splits the
+    post-prefill key into per-step keys (prefix-stable split), so
+    sampled streams match chat() token-for-token for the same seed —
+    including when the chunk size does not divide max_new_tokens."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    for seed in (0, 3):
+        kw = dict(
+            question="hello there", max_new_tokens=6, seed=seed,
+            temperature=0.9, top_p=0.95,
+        )
+        ref = pipe.chat(**kw)
+        for chunk in (2, 4):
+            streamed = "".join(pipe.chat_stream(chunk=chunk, **kw))
+            assert streamed == ref, (seed, chunk, streamed, ref)
+
+
+def test_chat_request_stop_strings(tiny_model):
+    """Per-request stop strings end decode (finish_reason 'stop') and
+    are trimmed from the reply, on top of the template stop."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    base = pipe.chat("hello there", max_new_tokens=8)
+    if len(base) < 2:
+        pytest.skip("tiny model emitted too little text to split on")
+    stop = base[1]  # a character the greedy reply surely contains
+    replies, reasons = pipe.chat_batch(
+        [{"question": "hello there"}], max_new_tokens=8,
+        stop=[stop], return_finish_reasons=True,
+    )
+    assert stop not in replies[0]
+    assert base.startswith(replies[0])
+    assert reasons[0] == "stop"
+    # The streaming path honors the same request stop.
+    streamed = "".join(
+        pipe.chat_stream("hello there", max_new_tokens=8, stop=[stop])
+    )
+    assert streamed == replies[0]
