@@ -5,7 +5,13 @@ Prints ONE JSON line with the north-star metric (BASELINE.md rows 1-2):
     {"metric": "sft_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
      "vs_baseline": R, "chip": ..., "hbm_gb": ..., "mfu": ...,
      "geometry": ..., "params_b": ..., "latency_video64_p50_s": ...,
+     "latency_video64": {"device_p50_s": ..., "device_spread": ...,
+     "e2e_p50_s": ..., ...}, "latency_video256": {...},
      "baseline_source": ...}
+
+On an unreachable TPU the line instead is
+    {"error": "tpu_unavailable", "attempts": N, "probe_timeout_s": ...}
+(and the exit code is nonzero) — never a raw traceback.
 
 Throughput: the full multimodal SFT step (OryxViT → Dynamic Compressor →
 splice → decoder fwd, masked CE, bwd, AdamW; Pallas flash attention on
@@ -314,28 +320,83 @@ class _CharTokenizer:
         return "".join(chr(i) for i in ids if 0 < i < 50000)
 
 
-def bench_video_latency(params, cfg) -> float | None:
-    """64-frame video-QA p50 end-to-end latency (s) through the serving
-    pipeline: preprocess + pack + ViT + compressor + splice + prefill +
-    32-token greedy decode."""
+def bench_video_latency(params, cfg, num_frames: int = 64) -> dict:
+    """Video-QA latency through the serving pipeline, split into two
+    components (VERDICT r3 #4 — the tunnel-noise fix):
+
+      device_p50_s  — the compiled ViT+compressor+splice+prefill+decode
+                      program, inputs pre-placed on device, synced by
+                      fetching the tiny num_generated vector. Over the
+                      axon transport this still pays ONE round trip per
+                      rep, but none of the host preprocessing or frame
+                      upload — run-to-run spread is reported so the
+                      number is auditable as a regression gate.
+      e2e_p50_s     — full pipe.chat_video wall clock (preprocess + pack
+                      + upload + decode + detokenize), what a user sees.
+
+    num_frames=64 is BASELINE config 3; 256 is the north-star long-video
+    case (16x compression, shared patch budget across frames)."""
+    import jax
+
+    from oryx_tpu.models import oryx, splice
+    from oryx_tpu.ops import packing
     from oryx_tpu.serve.pipeline import OryxInference
 
     pipe = OryxInference(_CharTokenizer(), params, cfg)
     rng = np.random.default_rng(0)
     frames = [
         rng.integers(0, 255, size=(224, 224, 3), dtype=np.uint8)
-        for _ in range(64)
+        for _ in range(num_frames)
     ]
-    # Warmup (compile prefill + decode programs).
-    pipe.chat_video(frames, "what happens?",
-                    max_new_tokens=LATENCY_NEW_TOKENS)
-    times = []
+    question = "what happens?"
+
+    # --- device-only component ------------------------------------------
+    ids, images, factors, caps = pipe._prepare_request({
+        "question": question, "images": frames, "is_video": True,
+    })
+    packed = packing.pack_raw_images(
+        images, patch_size=cfg.vision.patch_size,
+        base_grid=cfg.vision.base_grid, side_factors=factors,
+        max_patches=caps,
+    )
+    batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+    cache_len = packing.round_up_bucket(
+        batch.token_ids.shape[1] + LATENCY_NEW_TOKENS
+    )
+    arrays = oryx.stage_mm_arrays(packed, batch)
+    key = jax.random.key(0)
+    run = lambda: oryx._jit_mm_generate(
+        params, cfg, arrays, LATENCY_NEW_TOKENS, cache_len, key,
+        pipe.stop_sequences,
+    )
+    _, num, _ = run()
+    jax.device_get(num)  # warmup compile + one sync
+    dev = []
     for _ in range(LATENCY_REPEATS):
         t0 = time.perf_counter()
-        pipe.chat_video(frames, "what happens?",
-                        max_new_tokens=LATENCY_NEW_TOKENS)
-        times.append(time.perf_counter() - t0)
-    return float(np.percentile(times, 50))
+        _, num, _ = run()
+        jax.device_get(num)
+        dev.append(time.perf_counter() - t0)
+
+    # --- end-to-end component -------------------------------------------
+    pipe.chat_video(frames, question, max_new_tokens=LATENCY_NEW_TOKENS)
+    e2e = []
+    for _ in range(max(3, LATENCY_REPEATS // 2)):
+        t0 = time.perf_counter()
+        pipe.chat_video(frames, question, max_new_tokens=LATENCY_NEW_TOKENS)
+        e2e.append(time.perf_counter() - t0)
+
+    dev, e2e = np.asarray(dev), np.asarray(e2e)
+    return {
+        "device_p50_s": round(float(np.percentile(dev, 50)), 4),
+        "device_spread": round(
+            float((dev.max() - dev.min()) / max(np.percentile(dev, 50), 1e-9)),
+            3,
+        ),
+        "e2e_p50_s": round(float(np.percentile(e2e, 50)), 4),
+        "patch_bucket": int(packed.patches.shape[0]),
+        "seq_bucket": int(batch.token_ids.shape[1]),
+    }
 
 
 def _probe_once() -> tuple[bool, str]:
@@ -477,14 +538,26 @@ def main() -> None:
         mfu = round(flops / step_time / (n_chips * peak), 4)
 
     del state, metrics, batch  # free HBM for the inference latency bench
-    latency = None
+    lat64 = lat256 = None
     if not os.environ.get("BENCH_NO_LATENCY"):
         try:
             # Fresh params: the originals were donated into train_step.
             params = oryx.init_params(cfg, jax.random.key(0))
-            latency = round(bench_video_latency(params, cfg), 3)
+            lat64 = bench_video_latency(params, cfg, 64)
         except Exception as e:  # keep the primary metric even if this fails
             print(f"# latency bench failed: {e!r}")
+        # 256-frame north-star case (BASELINE config 3): real chips only
+        # by default (256 frames through the tiny CPU config is all
+        # compile time); BENCH_VIDEO256=1 forces, =0 skips.
+        want256 = os.environ.get(
+            "BENCH_VIDEO256", "1" if backend == "tpu" else "0"
+        ) == "1"
+        if want256 and lat64 is not None:
+            try:
+                lat256 = bench_video_latency(params, cfg, 256)
+            except Exception as e:  # OOM here is itself a finding
+                print(f"# 256-frame latency bench failed: {e!r}")
+                lat256 = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     print(json.dumps({
         "metric": "sft_tokens_per_sec_per_chip",
@@ -498,7 +571,9 @@ def main() -> None:
         "params_b": round(n_llm / 1e9, 2),
         "step_time_s": round(step_time, 3),
         "mfu": mfu,
-        "latency_video64_p50_s": latency,
+        "latency_video64_p50_s": lat64 and lat64["e2e_p50_s"],
+        "latency_video64": lat64,
+        "latency_video256": lat256,
     }))
 
 

@@ -121,10 +121,11 @@ class TrainConfig:
     remat_policy: str = "block"
 
     def __post_init__(self):
-        if self.remat_policy not in ("block", "dots", "attn"):
+        if self.remat_policy not in ("block", "dots", "attn", "attn_qkv"):
             raise ValueError(
-                f"remat_policy={self.remat_policy!r}: use block|dots|attn "
-                "(disable checkpointing with remat=False, not a policy)"
+                f"remat_policy={self.remat_policy!r}: use "
+                "block|dots|attn|attn_qkv (disable checkpointing with "
+                "remat=False, not a policy)"
             )
     # Sequence-chunk size for the memory-efficient CE loss (0 = dense
     # [B, T, V] logits). At 152k vocab the dense path needs ~10 GB fp32
@@ -193,7 +194,8 @@ class OryxConfig:
             # (flash_out/flash_lse) which only the Pallas kernel's vjp
             # emits; on xla/ring attention it silently degrades to plain
             # block remat. Warn rather than raise: CPU tests deliberately
-            # run TPU-tuned configs with attn_impl="xla".
+            # run TPU-tuned configs with attn_impl="xla". ("attn_qkv"
+            # still saves the q/k/v tags on any impl, so no warning.)
             import warnings
 
             warnings.warn(

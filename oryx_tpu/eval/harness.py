@@ -112,6 +112,34 @@ def score_record(rec: dict[str, Any], reply: str) -> bool:
     return _norm(reply) == _norm(str(ans))
 
 
+def eval_length_proxy(rec: dict[str, Any]) -> int:
+    """Cheap per-record length proxy WITHOUT loading media. Delegates to
+    train/data.length_estimate (the single owner of the per-visual token
+    allowances) over a synthesized training-shaped record, so eval batch
+    grouping can never drift from the training sampler's notion of
+    length."""
+    from oryx_tpu.train.data import length_estimate
+
+    return length_estimate({
+        "conversations": [{"value": format_question(rec)}],
+        "image": rec.get("image"),
+        "video": rec.get("video"),
+    })
+
+
+def _modality_key(rec: dict[str, Any]) -> str:
+    """Batch-composition key: video / multi-image / image / text rows
+    have wildly different visual-buffer shapes — keeping them apart means
+    batches share patch buckets, not just sequence buckets. Text-only
+    gets its own bucket on top of train/data.record_modality (training
+    records always carry media; eval ones may not)."""
+    if not rec.get("video") and not rec.get("image"):
+        return "text"
+    from oryx_tpu.train.data import record_modality
+
+    return record_modality(rec)
+
+
 @dataclasses.dataclass
 class EvalResult:
     accuracy: float
@@ -135,6 +163,7 @@ def evaluate(
     process_count: int = 1,
     log_every: int = 25,
     batch_size: int = 8,
+    length_group: bool = True,
 ) -> EvalResult:
     """Run the inference stack over a record shard and score it.
 
@@ -145,6 +174,12 @@ def evaluate(
     (one ViT/compressor/decode program per batch). Host memory holds the
     whole batch's raw frames at once (batch_size × num_frames ×
     native-resolution); lower batch_size for high-res long-video tasks.
+
+    length_group (default on) sorts the shard by (modality, length proxy)
+    before batching — chat_batch pads every row to the batch-max bucket,
+    so mixed-length batches otherwise pay worst-row padding (the
+    training side's LengthGroupedSampler, applied to eval). Record
+    ORDER in the output changes but ids/scoring don't.
     """
     t0 = time.perf_counter()
     out: list[dict[str, Any]] = []
@@ -152,14 +187,17 @@ def evaluate(
     # Fallback ids use the GLOBAL record index so merged per-process
     # results stay distinguishable.
     mine = [
-        (i, r) for i, r in enumerate(records)
+        (i, r, eval_length_proxy(r)) for i, r in enumerate(records)
         if i % process_count == process_index
     ]
+    if length_group:
+        mine.sort(key=lambda t: (_modality_key(t[1]), t[2]))
+    pad_waste = 0  # proxy tokens spent on per-batch padding
     batch_size = max(1, batch_size)
     for b0 in range(0, len(mine), batch_size):
         group = mine[b0 : b0 + batch_size]
         requests = []
-        for gi, rec in group:
+        for gi, rec, _ in group:
             frames, is_video = media.load_record_media(
                 rec, media_root=media_root, num_frames=num_frames
             )
@@ -168,8 +206,10 @@ def evaluate(
                 "images": frames,
                 "is_video": is_video,
             })
+        proxies = [p for _, _, p in group]
+        pad_waste += sum(max(proxies) - p for p in proxies)
         replies = pipe.chat_batch(requests, max_new_tokens=max_new_tokens)
-        for (gi, rec), reply in zip(group, replies):
+        for (gi, rec, _), reply in zip(group, replies):
             ok = score_record(rec, reply)
             correct += ok
             row = {"id": rec.get("id", gi), "reply": reply, "correct": ok}
@@ -182,6 +222,10 @@ def evaluate(
         if log_every and (n % log_every < len(group) or n == len(mine)):
             print(f"[eval] {n}/{len(mine)} acc={correct / n:.4f}", flush=True)
     dt = time.perf_counter() - t0
+    if log_every and mine:
+        print(f"[eval] pad_waste={pad_waste} proxy tokens "
+              f"(length_group={'on' if length_group else 'off'})",
+              flush=True)
     acc = correct / max(len(mine), 1)
     return EvalResult(acc, correct, len(mine), dt, out)
 
@@ -283,6 +327,11 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--output", default=None, help="results json path")
     ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument(
+        "--no-length-group", action="store_true",
+        help="keep dataset order instead of sorting batches by "
+        "(modality, length) — more padding, reproducible order",
+    )
     ap.add_argument("--process-index", type=int, default=0)
     ap.add_argument("--process-count", type=int, default=1)
     ap.add_argument(
@@ -311,6 +360,7 @@ def main(argv: list[str] | None = None) -> None:
         media_root=args.media_root, num_frames=args.num_frames,
         max_new_tokens=args.max_new_tokens, batch_size=args.batch_size,
         process_index=args.process_index, process_count=args.process_count,
+        length_group=not args.no_length_group,
     )
     _print_summary(result, by=args.by)
     if args.output:

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from oryx_tpu.config import CompressorConfig, LLMConfig, VisionConfig
 from oryx_tpu.ops.attention import attention
 from oryx_tpu.ops.norms import layer_norm
+from oryx_tpu.parallel.sharding import constrain
 
 Params = dict[str, Any]
 
@@ -103,10 +104,21 @@ def forward(
     )
     pooled = sums[q_region_ids] / jnp.maximum(counts[q_region_ids], 1.0)[:, None]
     pooled = pooled.astype(features.dtype)  # [Q, Hv]
+    # The query axis shards over the data width exactly like the packing
+    # axis upstream (oryx_vit pins [1, P, H]); without the pin GSPMD
+    # guesses the [Q, Hv] intermediates' shardings on meshes that also
+    # carry tp, and the backward pays involuntary-remat reshards.
+    q_spec = (("dp", "fsdp"), None)
+    pooled = constrain(pooled, *q_spec)
 
     # Region cross-attention: query = pooled token, keys/values = its s×s
     # source region (segment-id mask on region equality).
-    nq = layer_norm(pooled, params["norm_q"]["weight"], params["norm_q"]["bias"], eps)
+    nq = constrain(
+        layer_norm(
+            pooled, params["norm_q"]["weight"], params["norm_q"]["bias"], eps
+        ),
+        *q_spec,
+    )
     nkv = layer_norm(
         features, params["norm_kv"]["weight"], params["norm_kv"]["bias"], eps
     )
@@ -126,11 +138,15 @@ def forward(
             q_segment_ids=q_region_ids[None],
             kv_segment_ids=region_ids[None],
         ).reshape(Q, Hv)
-    x = pooled + _linear(o, params["o_proj"])
+    x = constrain(pooled + _linear(o, params["o_proj"]), *q_spec)
 
     # MLP projector into LLM embedding space (mlp2x_gelu-equivalent).
+    # fc1's kernel is P('fsdp','tp') — pin the intermediate to the tp
+    # column sharding the matmul produces so the backward agrees.
     x = jax.nn.gelu(_linear(x, params["projector"]["fc1"]), approximate=True)
+    x = constrain(x, ("dp", "fsdp"), "tp")
     x = _linear(x, params["projector"]["fc2"])
 
     valid_q = (q_region_ids > 0)[:, None]
-    return jnp.where(valid_q, x, 0).astype(features.dtype)
+    out = jnp.where(valid_q, x, 0).astype(features.dtype)
+    return constrain(out, *q_spec)

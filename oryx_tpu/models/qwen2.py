@@ -24,6 +24,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from oryx_tpu.config import LLMConfig
 from oryx_tpu.ops.attention import attention
@@ -200,6 +201,11 @@ def _block(
     k = _linear(x, lp["k_proj"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = _linear(x, lp["v_proj"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     q, k = apply_rope(q, k, cos, sin)
+    # Post-rope tags for the "attn_qkv" remat policy (utils/remat.py):
+    # saving here spares the backward both the projections and the rope.
+    q = checkpoint_name(q, "attn_q")
+    k = checkpoint_name(k, "attn_k")
+    v = checkpoint_name(v, "attn_v")
 
     if cache_k is not None:
         cache_k = _cache_write(cache_k, k, write_slots)

@@ -43,7 +43,12 @@ LOGICAL_RULES: tuple[tuple[str, tuple[str | None, ...]], ...] = (
     # Vision tower
     ("vit/patch_embed/kernel", (None, "embed")),
     ("vit/patch_embed/bias", ("embed",)),
-    ("vit/pos_embed/weight", (None, "embed")),
+    # Replicated on purpose: interp_pos_embed gathers 4 corners per patch
+    # and its backward scatter-adds into the table; with the table
+    # embed-sharded GSPMD pays involuntary-remat reshards between the
+    # data-sharded patch axis and the sharded table on every step. The
+    # table is ~3.4 MB fp32 at SigLIP scale — replication is free.
+    ("vit/pos_embed/weight", (None, None)),
     ("vit/layers/norm*/weight", ("layer", None)),
     ("vit/layers/norm*/bias", ("layer", None)),
     ("vit/layers/?_proj/kernel", ("layer", "embed", "heads")),

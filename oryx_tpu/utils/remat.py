@@ -21,13 +21,22 @@ Policies:
     a thin slice of "dots" costing ~2 bytes/token/layer/head-dim that
     spares the backward from re-running the forward attention kernel —
     the most expensive single op in a block recompute.
+  * "attn_qkv" — "attn" plus the post-rope q/k/v projections (named
+    "attn_q"/"attn_k"/"attn_v" in models/qwen2._block): the backward
+    additionally skips the three projection matmuls and the rope —
+    ~3 bytes/token/layer/(q+2kv head-dim) more HBM than "attn".
 """
 
 from __future__ import annotations
 
 import jax
 
-POLICIES = ("none", "block", "dots", "attn")
+POLICIES = ("none", "block", "dots", "attn", "attn_qkv")
+
+_SAVED_NAMES = {
+    "attn": ("flash_out", "flash_lse"),
+    "attn_qkv": ("flash_out", "flash_lse", "attn_q", "attn_k", "attn_v"),
+}
 
 
 def wrap_remat(body, remat: bool | str):
@@ -42,12 +51,12 @@ def wrap_remat(body, remat: bool | str):
             prevent_cse=False,
             policy=jax.checkpoint_policies.checkpoint_dots,
         )
-    if remat == "attn":
+    if remat in _SAVED_NAMES:
         return jax.checkpoint(
             body,
             prevent_cse=False,
             policy=jax.checkpoint_policies.save_only_these_names(
-                "flash_out", "flash_lse"
+                *_SAVED_NAMES[remat]
             ),
         )
     raise ValueError(f"unknown remat policy {remat!r}; have {POLICIES}")

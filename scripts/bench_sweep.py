@@ -22,12 +22,20 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SWEEPS = {
-    "remat": [{"BENCH_REMAT_POLICY": p} for p in ("none", "block", "attn")],
+    "remat": [
+        {"BENCH_REMAT_POLICY": p}
+        for p in ("none", "block", "attn", "attn_qkv")
+    ],
     "loss_chunk": [{"BENCH_LOSS_CHUNK": str(c)} for c in (64, 128, 256, 512)],
     "bwd_blocks": [
         {"ORYX_FLASH_BWD_BLOCK_Q": q, "ORYX_FLASH_BWD_BLOCK_K": k}
         for q, k in (("0", "0"), ("512", "1024"), ("1024", "1024"),
                      ("1024", "2048"))
+    ],
+    "fwd_blocks": [
+        {"ORYX_FLASH_BLOCK_Q": q, "ORYX_FLASH_BLOCK_K": k}
+        for q, k in (("512", "512"), ("512", "1024"), ("1024", "512"),
+                     ("1024", "1024"))
     ],
 }
 

@@ -1,0 +1,146 @@
+"""Generate (and optionally run) the self-contained smoke eval benchmark.
+
+The L7 eval harness (oryx_tpu/eval/harness.py) mirrors the reference's
+lmms-eval flow (SURVEY.md §3.5) but no real benchmark data exists on this
+box, so this script builds a tiny fully-offline one: synthetic frames with
+a VISUALLY decidable answer (a solid colored square on gray), MCQ records
+in the native task schema, and — with --run — the whole real pipeline:
+build a model dir + byte-level HF tokenizer on disk, then invoke
+`eval.harness.main` exactly as a user would from the CLI.
+
+    python scripts/make_smoke_eval.py --out assets/smoke_eval
+    python scripts/make_smoke_eval.py --out /tmp/smoke --run \
+        --result assets/smoke_eval/result_cpu.json
+
+Accuracy with random weights is chance-level by construction (4 options);
+the committed result JSON documents the harness producing a real accuracy
+from the real decode path, not the model's skill.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COLORS = {
+    "red": (200, 40, 40),
+    "green": (40, 180, 60),
+    "blue": (40, 70, 200),
+    "yellow": (220, 200, 40),
+}
+OPTIONS = list(COLORS)
+
+
+def _frame(color: str, offset: int = 0, size: int = 64) -> np.ndarray:
+    img = np.full((size, size, 3), 128, np.uint8)
+    s = size // 3
+    y = x = size // 2 - s // 2 + offset
+    img[y : y + s, x : x + s] = COLORS[color]
+    return img
+
+
+def build_task(out_dir: str) -> str:
+    """Write media + task.jsonl under out_dir; returns the task path."""
+    from PIL import Image
+
+    media = os.path.join(out_dir, "media")
+    os.makedirs(media, exist_ok=True)
+    rng = np.random.default_rng(0)
+    records = []
+    for i in range(8):
+        color = OPTIONS[i % len(OPTIONS)]
+        video = i >= 4
+        if video:
+            d = os.path.join(media, f"vid{i}")
+            os.makedirs(d, exist_ok=True)
+            for f in range(4):
+                Image.fromarray(_frame(color, offset=2 * f - 3)).save(
+                    os.path.join(d, f"frame_{f}.png")
+                )
+            media_key = {"video": f"media/vid{i}"}
+            q = "What color is the moving square in the video?"
+        else:
+            p = os.path.join(media, f"img{i}.png")
+            Image.fromarray(_frame(color)).save(p)
+            media_key = {"image": f"media/img{i}.png"}
+            q = "What color is the square?"
+        opts = list(OPTIONS)
+        rng.shuffle(opts)
+        records.append({
+            "id": f"smoke-{i}",
+            "question": q,
+            "options": opts,
+            "answer": "ABCD"[opts.index(color)],
+            "meta": {"kind": "video" if video else "image"},
+            **media_key,
+        })
+    task = os.path.join(out_dir, "task.jsonl")
+    with open(task, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return task
+
+
+def build_model_dir(out_dir: str) -> str:
+    """Tiny random-weight model + a real on-disk HF tokenizer (byte-level
+    BPE built offline — ids < 300 fit the tiny 512 vocab), loadable by
+    serve.builder.load_pipeline with no network."""
+    import jax
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders
+    from transformers import PreTrainedTokenizerFast
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve import builder
+
+    d = os.path.join(out_dir, "model")
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    builder.save_pretrained(d, cfg, params)
+
+    alphabet = pre_tokenizers.ByteLevel.alphabet()
+    vocab = {ch: i for i, ch in enumerate(sorted(alphabet))}
+    tk = Tokenizer(models.BPE(vocab=vocab, merges=[]))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    PreTrainedTokenizerFast(tokenizer_object=tk).save_pretrained(d)
+    return d
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="benchmark output dir")
+    ap.add_argument(
+        "--run", action="store_true",
+        help="also build a tiny model dir and run eval.harness.main",
+    )
+    ap.add_argument("--result", default=None, help="result json path")
+    ap.add_argument("--num-frames", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    task = build_task(args.out)
+    print(f"task written: {task}")
+    if not args.run:
+        return
+    model_dir = build_model_dir(args.out)
+    from oryx_tpu.eval import harness
+
+    harness.main([
+        "--model-path", model_dir,
+        "--task", task,
+        "--media-root", args.out,
+        "--num-frames", str(args.num_frames),
+        "--max-new-tokens", "4",
+        "--by", "kind",
+        *( ["--output", args.result] if args.result else [] ),
+    ])
+
+
+if __name__ == "__main__":
+    main()

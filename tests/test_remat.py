@@ -27,11 +27,11 @@ def _loss_and_grads(cfg, params, host_batch):
     return float(loss), grads
 
 
-@pytest.mark.parametrize("policy", ["none", "dots", "attn"])
+@pytest.mark.parametrize("policy", ["none", "dots", "attn", "attn_qkv"])
 def test_remat_policies_match_block(policy):
     base = cfg_lib.oryx_tiny()
-    if policy == "attn":
-        # The saved names exist only in the Pallas kernel's vjp
+    if policy.startswith("attn"):
+        # The flash saved names exist only in the Pallas kernel's vjp
         # (interpret mode on CPU); compare block-vs-attn on that path.
         base = dataclasses.replace(base, attn_impl="pallas")
     params = oryx.init_params(base, jax.random.key(0))
