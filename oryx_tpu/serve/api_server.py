@@ -145,6 +145,15 @@ def parse_messages(
     return question, history, images
 
 
+def _decode_bucket(max_new: int) -> int:
+    """Decode-length bucket: next power of two, floor 16. Requests whose
+    max_tokens fall in the same bucket batch TOGETHER — the group decodes
+    the bucket length and each row trims to its own cap
+    (pipeline.chat_batch per_row_max). Also bounds the compiled-program
+    count: one decode program per bucket, not per distinct max_tokens."""
+    return max(16, 1 << (max_new - 1).bit_length())
+
+
 class _Pending:
     def __init__(
         self, request: dict[str, Any], max_new: int,
@@ -169,8 +178,9 @@ class _Pending:
         # of batching it with look-alikes.
         solo = id(self) if "seed" in s else None
         return (
-            self.max_new, s.get("temperature"), s.get("top_p"),
-            tuple(s.get("stop") or ()), s.get("seed"), solo,
+            _decode_bucket(self.max_new), s.get("temperature"),
+            s.get("top_p"), tuple(s.get("stop") or ()), s.get("seed"),
+            solo,
         )
 
 
@@ -178,11 +188,12 @@ class Batcher:
     """Groups concurrent non-streaming requests into one chat_batch call.
 
     A single worker thread drains the queue: it waits `window` seconds
-    after the first pending request for company (requests with the same
-    max_tokens AND sampling parameters batch together), then runs the
-    whole group as one compiled decode. `device_lock` serializes the
-    device against concurrent streaming requests; HTTP threads only
-    enqueue and wait.
+    after the first pending request for company (requests batch together
+    when their max_tokens share a decode-length BUCKET and their
+    sampling parameters match — each row trims to its own cap), then
+    runs the whole group as one compiled decode. `device_lock`
+    serializes the device against concurrent streaming requests; HTTP
+    threads only enqueue and wait.
     """
 
     def __init__(
@@ -237,7 +248,8 @@ class Batcher:
                 with self.device_lock:
                     replies, reasons = self.pipe.chat_batch(
                         [p.request for p in group],
-                        max_new_tokens=first.max_new,
+                        max_new_tokens=_decode_bucket(first.max_new),
+                        per_row_max=[p.max_new for p in group],
                         return_finish_reasons=True,
                         temperature=s.get("temperature"),
                         top_p=s.get("top_p"),
@@ -329,6 +341,7 @@ def build_server(
     batch_window: float = 0.02,
     max_batch: int = 8,
     allow_local_files: bool = False,
+    max_tokens_limit: int = 2048,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline."""
     # chat_stream is not thread-safe against itself or chat_batch (one
@@ -386,6 +399,14 @@ def build_server(
                     if max_new < 1:
                         raise ValueError(
                             f"max_tokens must be >= 1, got {max_new}"
+                        )
+                    # Decode length is a compiled-program dimension and
+                    # the decode runs under the device lock — an
+                    # unbounded client value is a denial of service.
+                    if max_new > max_tokens_limit:
+                        raise ValueError(
+                            f"max_tokens must be <= {max_tokens_limit}, "
+                            f"got {max_new}"
                         )
                 sampling = _parse_sampling(req)
             except Exception as e:
@@ -502,6 +523,11 @@ def main(argv: list[str] | None = None) -> None:
         "default: any network client could read arbitrary images)",
     )
     ap.add_argument(
+        "--max-tokens-limit", type=int, default=2048,
+        help="reject requests asking for more than this many new tokens "
+        "(decode length is a compiled-program dimension)",
+    )
+    ap.add_argument(
         "--shard", default=None, metavar="MODE=N",
         help="multi-chip serving (tp=N | fsdp=N over all visible devices)",
     )
@@ -522,6 +548,7 @@ def main(argv: list[str] | None = None) -> None:
         pipe, model_name=args.model_name, host=args.host, port=args.port,
         batch_window=args.batch_window, max_batch=args.max_batch,
         allow_local_files=args.allow_local_files,
+        max_tokens_limit=args.max_tokens_limit,
     )
     print(f"serving {args.model_name} on http://{args.host}:{args.port}")
     srv.serve_forever()

@@ -251,6 +251,7 @@ class OryxInference:
         temperature: float | None = None,
         top_p: float | None = None,
         stop: Sequence[str] | None = None,
+        per_row_max: Sequence[int] | None = None,
     ) -> list[str] | tuple[list[str], list[str]]:
         """Batched single-turn QA: one ViT + compressor + decode scan for
         the whole batch (the batching win the reference gets from varlen
@@ -263,10 +264,26 @@ class OryxInference:
         string) vs "length" (cut off by max_new_tokens).
         temperature/top_p override the config defaults for this call;
         stop adds request stop strings on top of the template's.
+        per_row_max caps each row's OUTPUT length individually while the
+        batch decodes max_new_tokens steps together (how the API server
+        batches mixed-max_tokens traffic): a row's reply trims to its
+        cap, and its finish reason reflects the cap, not the shared
+        decode window. Greedy/sampled tokens are unchanged by the longer
+        window (the step-key split is prefix-stable).
         """
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
         max_new = max_new_tokens or cfg.generation.max_new_tokens
+        if per_row_max is not None:
+            if len(per_row_max) != len(requests):
+                raise ValueError(
+                    f"per_row_max has {len(per_row_max)} entries for "
+                    f"{len(requests)} requests"
+                )
+            if any(m < 1 or m > max_new for m in per_row_max):
+                raise ValueError(
+                    f"per_row_max entries must be in [1, {max_new}]"
+                )
         key = jax.random.key(seed)
         all_images: list[np.ndarray] = []
         side_factors: list[int] = []
@@ -300,13 +317,20 @@ class OryxInference:
                     max_new_tokens=max_new, key=key,
                     stop_sequences=stop_seqs,
                 )
+        caps = per_row_max or [max_new] * len(toks)
         replies = [
-            self._decode(toks[b], int(num[b]), extra_stops=stop)
+            self._decode(
+                toks[b], min(int(num[b]), caps[b]), extra_stops=stop
+            )
             for b in range(len(toks))
         ]
         if not return_finish_reasons:
             return replies
-        reasons = ["stop" if bool(f) else "length" for f in fin]
+        # A row "stopped" only if its EOS/stop landed within ITS cap.
+        reasons = [
+            "stop" if bool(f) and int(n) <= c else "length"
+            for f, n, c in zip(fin, num, caps)
+        ]
         return replies, reasons
 
     def _text_batch(self, ids_rows, max_new: int, key, *, cfg=None,

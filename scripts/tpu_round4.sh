@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-shot on-chip perf/validation agenda (run when the axon tunnel is
+# up): default bench (throughput + 64/256-frame latency split), the
+# three perf sweeps, and the smoke eval on the real chip. Each step is
+# its own python process (the chip claim frees between steps); a dead
+# tunnel surfaces as the bench supervisor's structured error, not a
+# hang. Results land under $1 (default /tmp/r4_onchip).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/r4_onchip}
+mkdir -p "$OUT"
+
+if ps -eo pid,comm | awk '$2=="python"{found=1} END{exit !found}'; then
+  echo "live python process holds the chip claim; aborting" >&2
+  exit 1
+fi
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
+
+echo "== bench (defaults) =="
+python bench.py 2>"$OUT/bench_default.err" | tee "$OUT/bench_default.out"
+
+echo "== sweep: loss_chunk =="
+BENCH_NO_LATENCY=1 python scripts/bench_sweep.py loss_chunk \
+  | tee "$OUT/sweep_loss_chunk.jsonl"
+
+echo "== sweep: fwd_blocks =="
+BENCH_NO_LATENCY=1 python scripts/bench_sweep.py fwd_blocks \
+  | tee "$OUT/sweep_fwd_blocks.jsonl"
+
+echo "== sweep: remat (incl attn_qkv) =="
+BENCH_NO_LATENCY=1 python scripts/bench_sweep.py remat \
+  | tee "$OUT/sweep_remat.jsonl"
+
+echo "== smoke eval on chip =="
+python scripts/make_smoke_eval.py --out /tmp/smoke_tpu --run \
+  --result "$OUT/smoke_result_tpu.json" | tee "$OUT/smoke_eval.out"
+
+echo "== done; results in $OUT =="

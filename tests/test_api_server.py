@@ -151,17 +151,21 @@ def test_server_reports_length_finish_reason(server):
 
 
 def test_batcher_groups_and_fifo():
-    """Dynamic batcher: same-max_tokens requests group into one
-    chat_batch call; a mismatched request is carried to LEAD the next
-    group (FIFO, no starvation) rather than re-queued to the tail."""
+    """Dynamic batcher: requests whose max_tokens share a decode bucket
+    group into one chat_batch call (decoding the bucket, each row capped
+    individually); a request from a DIFFERENT bucket is carried to LEAD
+    the next group (FIFO, no starvation) rather than re-queued to the
+    tail."""
     calls = []
 
     class StubPipe:
         def chat_batch(self, requests, max_new_tokens,
-                       return_finish_reasons=False, **sampling):
-            calls.append(
-                ([r["question"] for r in requests], max_new_tokens)
-            )
+                       return_finish_reasons=False, per_row_max=None,
+                       **sampling):
+            calls.append((
+                [r["question"] for r in requests], max_new_tokens,
+                list(per_row_max or []),
+            ))
             replies = [r["question"].upper() for r in requests]
             if return_finish_reasons:
                 return replies, ["stop"] * len(replies)
@@ -173,9 +177,9 @@ def test_batcher_groups_and_fifo():
     b = api_server.Batcher(StubPipe(), window=2.0, max_batch=8)
     pending = [
         b.submit({"question": "a"}, 4),
-        b.submit({"question": "b"}, 4),
-        b.submit({"question": "c"}, 9),  # mismatch -> leads next group
-        b.submit({"question": "d"}, 9),
+        b.submit({"question": "b"}, 9),   # same bucket (16) as a
+        b.submit({"question": "c"}, 60),  # bucket 64 -> leads next group
+        b.submit({"question": "d"}, 40),
     ]
     for p in pending:
         assert p.done.wait(timeout=30)
@@ -183,8 +187,12 @@ def test_batcher_groups_and_fifo():
     assert all(p.finish_reason == "stop" for p in pending)
     # calls is complete here: Batcher._run appends inside chat_batch
     # strictly before setting each done event. Two device calls:
-    # [a, b]@4 then the carried-over [c, d]@9 (c led, was not lost).
-    assert calls == [(["a", "b"], 4), (["c", "d"], 9)], calls
+    # [a, b] decoding bucket 16 with per-row caps 4/9, then the
+    # carried-over [c, d] decoding bucket 64 (c led, was not lost).
+    assert calls == [
+        (["a", "b"], 16, [4, 9]),
+        (["c", "d"], 64, [60, 40]),
+    ], calls
 
 
 @pytest.fixture(scope="module")
@@ -390,6 +398,72 @@ def test_server_sampling_roundtrip(server):
     try:
         _post(url, {
             "messages": [{"role": "user", "content": "q"}], "n": 2,
+        })
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_mixed_max_tokens_batch_matches_solo(server):
+    """Requests with different max_tokens in one bucket batch into ONE
+    device call and still return exactly what a solo call with that cap
+    returns (greedy decode is prefix-stable across the longer shared
+    window). A dedicated server with a wide batch window + a chat_batch
+    spy makes the co-batching assertion deterministic."""
+    _, pipe = server
+    orig = pipe.chat_batch
+    calls = []
+
+    def spy(requests, **kw):
+        calls.append((len(requests), kw.get("max_new_tokens"),
+                      sorted(kw.get("per_row_max") or [])))
+        return orig(requests, **kw)
+
+    pipe.chat_batch = spy
+    srv = api_server.build_server(pipe, port=0, batch_window=1.0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        qs_caps = [("hello there", 3), ("what now?", 6),
+                   ("tell me more", 9)]
+        refs = [orig([{"question": q}], max_new_tokens=c)[0]
+                for q, c in qs_caps]
+        calls.clear()
+        results = [None] * len(qs_caps)
+
+        def call(i):
+            q, c = qs_caps[i]
+            with _post(url, {
+                "max_tokens": c,
+                "messages": [{"role": "user", "content": q}],
+            }) as resp:
+                results[i] = json.load(
+                    resp
+                )["choices"][0]["message"]["content"]
+
+        threads = [
+            threading.Thread(target=call, args=(i,))
+            for i in range(len(qs_caps))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == refs
+        # All three shared one decode of the bucket (16) with their own
+        # caps — not three solo batches.
+        assert (3, 16, [3, 6, 9]) in calls, calls
+    finally:
+        pipe.chat_batch = orig
+        srv.shutdown()
+
+
+def test_server_rejects_excessive_max_tokens(server):
+    url, _ = server
+    try:
+        _post(url, {
+            "max_tokens": 10**9,
+            "messages": [{"role": "user", "content": "q"}],
         })
         raise AssertionError("expected HTTP 400")
     except urllib.error.HTTPError as e:

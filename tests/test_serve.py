@@ -428,3 +428,23 @@ def test_chat_request_stop_strings(tiny_model):
         pipe.chat_stream("hello there", max_new_tokens=8, stop=[stop])
     )
     assert streamed == replies[0]
+
+
+def test_per_row_max_validation_and_reasons(tiny_model):
+    """chat_batch per_row_max: caps trim rows individually and finish
+    reasons reflect the per-row cap, not the shared decode window."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    reqs = [{"question": "hello there"}, {"question": "what now?"}]
+    replies, reasons = pipe.chat_batch(
+        reqs, max_new_tokens=8, per_row_max=[2, 8],
+        return_finish_reasons=True,
+    )
+    solo0 = pipe.chat("hello there", max_new_tokens=2)
+    assert replies[0] == solo0
+    # Tiny vocab never emits EOS: both rows are length-cut at their cap.
+    assert reasons == ["length", "length"]
+    with pytest.raises(ValueError, match="per_row_max"):
+        pipe.chat_batch(reqs, max_new_tokens=8, per_row_max=[2])
+    with pytest.raises(ValueError, match="per_row_max"):
+        pipe.chat_batch(reqs, max_new_tokens=8, per_row_max=[2, 9])
