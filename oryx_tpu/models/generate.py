@@ -2,10 +2,12 @@
 
 Reference parity: HF `generate()` as driven by `OryxQwenForCausalLM`
 (SURVEY.md §3.2): greedy or sampled decoding with a KV cache, stopping on
-EOS. TPU-first: the whole decode loop is ONE compiled program (`lax.scan`
-over steps, no host round-trip per token); right-padded batches advance
-with per-row positions, so mixed-length multimodal prefills need no
-left-padding shuffle.
+EOS. TPU-first: the whole decode loop is ONE compiled program with no
+host round-trip per token — a `lax.while_loop` over the step body that
+exits as soon as every row has finished (`_decode_while`; the streaming
+path scans fixed-size chunks instead and exits between chunks);
+right-padded batches advance with per-row positions, so mixed-length
+multimodal prefills need no left-padding shuffle.
 """
 
 from __future__ import annotations
@@ -128,17 +130,46 @@ def generate(
         cache_len=cache_len, attn_impl=attn_impl,
         compute_dtype=compute_dtype,
     )
-    _, (toks, fin) = jax.lax.scan(
-        init=carry, f=step, xs=jax.random.split(key, max_new_tokens)
+    toks, fin = _decode_while(
+        step, carry, jax.random.split(key, max_new_tokens),
+        max_new_tokens, gen_cfg.eos_token_id,
     )
-    toks = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
-    fin = jnp.moveaxis(fin, 0, 1)  # fin[b, t]: row b ended at/before tok t
     # num generated = tokens up to and including the finishing token (EOS
     # or the last token of a stop sequence).
     num = jnp.where(
         jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
     )
     return toks, num.astype(jnp.int32), jnp.any(fin, axis=1)
+
+
+def _decode_while(step, carry, step_keys, max_new_tokens: int, eos: int):
+    """Run the decode step to completion OR until every row finished —
+    a `lax.while_loop` over the scan body, so a batch of short answers
+    inside a long decode window (bucketed serving, MCQ eval) stops
+    paying for the unused steps. Unexecuted slots keep the same values
+    the scan would have produced (tokens: EOS fill; finished: True —
+    the loop only exits early when ALL rows are finished).
+
+    Returns (toks [B, max_new], fin [B, max_new])."""
+    nB = carry[1].shape[0]  # carry = (cache, tok, lengths, finished, recent)
+    toks0 = jnp.full((nB, max_new_tokens), eos, jnp.int32)
+    fin0 = jnp.ones((nB, max_new_tokens), bool)
+
+    def cond(state):
+        i, c, _, _ = state
+        return (i < max_new_tokens) & ~jnp.all(c[3])  # c[3] = finished
+
+    def body(state):
+        i, c, toks, fin = state
+        c, (tok, f) = step(c, step_keys[i])
+        toks = jax.lax.dynamic_update_index_in_dim(toks, tok, i, axis=1)
+        fin = jax.lax.dynamic_update_index_in_dim(fin, f, i, axis=1)
+        return i + 1, c, toks, fin
+
+    _, _, toks, fin = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), carry, toks0, fin0)
+    )
+    return toks, fin
 
 
 def _prefill_carry(

@@ -448,3 +448,60 @@ def test_per_row_max_validation_and_reasons(tiny_model):
         pipe.chat_batch(reqs, max_new_tokens=8, per_row_max=[2])
     with pytest.raises(ValueError, match="per_row_max"):
         pipe.chat_batch(reqs, max_new_tokens=8, per_row_max=[2, 9])
+
+
+def test_decode_early_exit_skips_dead_steps(tiny_model):
+    """generate()'s while-loop decode stops once every row is finished:
+    a batch whose EOS lands on step ~1 of a 512-step window must run
+    far faster than one that never finishes (both identical programs,
+    same compile). Functional equality is covered elsewhere; this pins
+    the early exit itself."""
+    import time
+
+    import jax.numpy as jnp
+
+    from oryx_tpu.models import generate as generate_lib
+
+    cfg, params = tiny_model
+    llm_p = params["llm"]
+    embeds = jnp.asarray(
+        np.random.default_rng(0).standard_normal(
+            (1, 16, cfg.llm.hidden_size)
+        ),
+        jnp.float32,
+    )
+    lengths = jnp.asarray([16], np.int32)
+
+    def run(gen_cfg):
+        toks, num, fin = generate_lib.generate(
+            llm_p, cfg.llm, gen_cfg,
+            inputs_embeds=embeds, lengths=lengths,
+            max_new_tokens=512, cache_len=1024,
+        )
+        return np.asarray(toks), np.asarray(num), np.asarray(fin)
+
+    import dataclasses
+
+    # The row's greedy first token becomes the EOS id -> the (single-row)
+    # batch finishes within two steps.
+    base = dataclasses.replace(cfg.generation, temperature=0.0)
+    probe = dataclasses.replace(base, eos_token_id=10**9)  # never fires
+    toks, _, _ = run(probe)  # also the compile warmup for shape (1,16)
+    eager = dataclasses.replace(base, eos_token_id=int(toks[0, 0]))
+    run(eager)  # compile for the new static gen_cfg
+
+    def median_time(g):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = run(g)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), out
+
+    t_eager, (_, num_e, fin_e) = median_time(eager)
+    t_full, _ = median_time(probe)
+    assert fin_e.all()
+    assert num_e.max() <= 4
+    # 512 steps vs <=4; medians over 5 reps + a loose 3x margin keep
+    # this robust to CI scheduler noise.
+    assert t_full > 3 * t_eager, (t_full, t_eager)
