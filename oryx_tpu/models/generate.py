@@ -87,7 +87,7 @@ def make_stop_sequences(
     jax.jit,
     static_argnames=(
         "cfg", "gen_cfg", "max_new_tokens", "cache_len", "attn_impl",
-        "compute_dtype",
+        "compute_dtype", "return_cache",
     ),
 )
 def generate(
@@ -96,16 +96,19 @@ def generate(
     gen_cfg: GenerationConfig,
     *,
     inputs_embeds: jnp.ndarray,  # [B, T, H] (pre-spliced; right-padded)
-    lengths: jnp.ndarray,  # [B] real prompt lengths
+    lengths: jnp.ndarray,  # [B] real TOTAL lengths (incl. cached prefix)
     max_new_tokens: int,
     cache_len: int,
     key: jax.Array | None = None,
     attn_impl: str = "xla",
     compute_dtype=None,
     stop_sequences: jnp.ndarray | None = None,  # [S, L], left-pad -1
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    kv_cache: dict | None = None,
+    start: jnp.ndarray | None = None,  # [] int32 first slot to write
+    return_cache: bool = False,
+):
     """Returns (tokens [B, max_new_tokens] int32, num_generated [B] int32,
-    finished [B] bool).
+    finished [B] bool) — plus the KV cache when return_cache.
 
     Slots after EOS are filled with eos_token_id. cache_len must be a bucket
     >= T + max_new_tokens. A row also finishes when its trailing tokens
@@ -113,10 +116,17 @@ def generate(
     the caller trims the decoded text). finished=False marks a row cut off
     by max_new_tokens (the OpenAI "length" finish reason) rather than by
     EOS/stop.
+
+    kv_cache/start (prefix reuse, serve/pipeline.ChatSession): a cache
+    whose slots [0, start) already hold a previous turn's K/V — only the
+    suffix embeds are prefilled (written at `start`, positions absolute)
+    and `lengths` counts prefix + suffix. The caller guarantees
+    cache_len >= lengths + max_new_tokens.
     """
-    assert cache_len >= inputs_embeds.shape[1] + max_new_tokens, (
-        cache_len, inputs_embeds.shape[1], max_new_tokens
-    )
+    if kv_cache is None:
+        assert cache_len >= inputs_embeds.shape[1] + max_new_tokens, (
+            cache_len, inputs_embeds.shape[1], max_new_tokens
+        )
     if key is None:
         key = jax.random.key(0)
     carry, key = _prefill_carry(
@@ -124,13 +134,14 @@ def generate(
         cache_len=cache_len, attn_impl=attn_impl,
         compute_dtype=compute_dtype,
         stop_L=0 if stop_sequences is None else stop_sequences.shape[1],
+        kv_cache=kv_cache, start=start,
     )
     step = _make_decode_step(
         params, cfg, gen_cfg, stop_sequences,
         cache_len=cache_len, attn_impl=attn_impl,
         compute_dtype=compute_dtype,
     )
-    toks, fin = _decode_while(
+    carry, toks, fin = _decode_while(
         step, carry, jax.random.split(key, max_new_tokens),
         max_new_tokens, gen_cfg.eos_token_id,
     )
@@ -139,7 +150,8 @@ def generate(
     num = jnp.where(
         jnp.any(fin, axis=1), jnp.argmax(fin, axis=1) + 1, max_new_tokens
     )
-    return toks, num.astype(jnp.int32), jnp.any(fin, axis=1)
+    out = (toks, num.astype(jnp.int32), jnp.any(fin, axis=1))
+    return out + (carry[0],) if return_cache else out
 
 
 def _decode_while(step, carry, step_keys, max_new_tokens: int, eos: int):
@@ -150,7 +162,7 @@ def _decode_while(step, carry, step_keys, max_new_tokens: int, eos: int):
     the scan would have produced (tokens: EOS fill; finished: True —
     the loop only exits early when ALL rows are finished).
 
-    Returns (toks [B, max_new], fin [B, max_new])."""
+    Returns (final carry, toks [B, max_new], fin [B, max_new])."""
     nB = carry[1].shape[0]  # carry = (cache, tok, lengths, finished, recent)
     toks0 = jnp.full((nB, max_new_tokens), eos, jnp.int32)
     fin0 = jnp.ones((nB, max_new_tokens), bool)
@@ -166,37 +178,48 @@ def _decode_while(step, carry, step_keys, max_new_tokens: int, eos: int):
         fin = jax.lax.dynamic_update_index_in_dim(fin, f, i, axis=1)
         return i + 1, c, toks, fin
 
-    _, _, toks, fin = jax.lax.while_loop(
+    _, carry, toks, fin = jax.lax.while_loop(
         cond, body, (jnp.zeros((), jnp.int32), carry, toks0, fin0)
     )
-    return toks, fin
+    return carry, toks, fin
 
 
 def _prefill_carry(
     params, cfg: LLMConfig, gen_cfg: GenerationConfig, inputs_embeds,
     lengths, key, *, cache_len: int, attn_impl: str, compute_dtype,
-    stop_L: int,
+    stop_L: int, kv_cache: dict | None = None,
+    start: jnp.ndarray | None = None,
 ):
     """Prefill + first sampled token → the decode-scan carry
     (cache, next token, per-row lengths, finished flags, rolling
-    stop-match window). Shared by `generate` and the streaming path."""
+    stop-match window). Shared by `generate` and the streaming path.
+
+    With kv_cache/start, only the suffix embeds are prefilled into an
+    existing cache at slot `start` (absolute positions; `lengths` counts
+    prefix + suffix) — the prefix-reuse path."""
     B, T, _ = inputs_embeds.shape
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    start_vec = (
+        jnp.zeros((B,), jnp.int32)
+        if start is None
+        else jnp.broadcast_to(start.astype(jnp.int32), (B,))
+    )
+    positions = start_vec[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
     kv_mask = (slot_ar < lengths[:, None]).astype(jnp.int32)
 
-    cache = qwen2.init_kv_cache(
+    cache = kv_cache if kv_cache is not None else qwen2.init_kv_cache(
         cfg, B, cache_len, dtype=compute_dtype or jnp.float32
     )
     logits, cache = qwen2.forward(
         params, cfg,
         inputs_embeds=inputs_embeds, positions=positions,
-        kv_cache=cache, write_slots=jnp.zeros((B,), jnp.int32),
+        kv_cache=cache, write_slots=start_vec,
         kv_mask=kv_mask, attn_impl=attn_impl, compute_dtype=compute_dtype,
     )
-    # Last real logit per row (right padding ⇒ index lengths-1).
+    # Last real logit per row: suffix-local index of the final token.
     last = jnp.take_along_axis(
-        logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+        logits, (lengths - 1 - start_vec)[:, None, None].astype(jnp.int32),
+        axis=1,
     )[:, 0]
     key, sk = jax.random.split(key)
     tok0 = sample_token(

@@ -11,6 +11,7 @@ plain numpy glue.
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Any, Sequence
 
@@ -30,7 +31,7 @@ from oryx_tpu.constants import (
 from oryx_tpu.conversation import conv_templates
 from oryx_tpu.data import mm_utils
 from oryx_tpu.models import generate as generate_lib
-from oryx_tpu.models import oryx, splice
+from oryx_tpu.models import oryx, qwen2, splice
 from oryx_tpu.ops import packing
 
 Params = dict[str, Any]
@@ -491,6 +492,143 @@ class OryxInference:
             yield tail[len(text_done):]
         return "length"
 
+    def chat_cached(
+        self,
+        state: "PrefixCacheState",
+        question: str,
+        *,
+        images: Sequence[np.ndarray] | None = None,
+        is_video: bool = False,
+        history: Sequence[tuple[str, str]] | None = None,
+        max_new_tokens: int | None = None,
+        seed: int = 0,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        stop: Sequence[str] | None = None,
+    ) -> tuple[str, "PrefixCacheState"]:
+        """`chat` for one conversation with cross-turn KV prefix reuse:
+        the longest token-id prefix shared with `state.ids` is NOT
+        re-prefilled — only the new suffix runs through the model, at
+        absolute positions, writing into the session's cache. Matching
+        is on ids (vLLM-style), so a tokenizer boundary merge or a
+        template quirk just shortens the reuse, never changes the reply;
+        a visual token inside the unshared suffix falls back to a full
+        multimodal prefill. Returns (reply, new state)."""
+        cfg = self._sampling_cfg(temperature, top_p)
+        stop_seqs = self._stop_for(stop)
+        max_new = max_new_tokens or cfg.generation.max_new_tokens
+        key = jax.random.key(seed)
+        ids, imgs, factors, caps = self._prepare_request({
+            "question": question, "images": list(images or []),
+            "is_video": is_video, "history": list(history or []),
+        })
+        cfgv = cfg.vision
+        ids = np.asarray(ids, np.int64)
+
+        # A turn that merely EXTENDS the previous prompt (the normal
+        # multi-turn case: same media, appended history) reuses the
+        # stored post-splice stream — no host-side image re-packing.
+        packed = batch = None
+        np_prev = state.prompt_ids
+        extend = (
+            state.cache is not None
+            and 0 < len(np_prev) < len(ids)
+            and np.array_equal(ids[: len(np_prev)], np_prev)
+            and not np.any(ids[len(np_prev):] == IMAGE_TOKEN_INDEX)
+        )
+        if extend:
+            flat = np.concatenate([state.prompt_flat, ids[len(np_prev):]])
+            L = len(flat)
+        elif imgs:
+            packed = packing.pack_raw_images(
+                imgs, patch_size=cfgv.patch_size, base_grid=cfgv.base_grid,
+                side_factors=factors, max_patches=caps,
+            )
+            batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+            L = int(batch.lengths[0])
+            row = np.asarray(batch.token_ids[0][:L], np.int64)
+            isv = np.asarray(batch.is_visual[0][:L])
+            flat = np.where(isv, -7, row)
+        else:
+            L = len(ids)
+            flat = ids
+
+        # Longest shared prefix with the cache's token stream. Keep at
+        # least one token in the suffix (the prefill must produce the
+        # next-token logit), and never split a visual region (-7 marks
+        # visual slots in the flat stream).
+        common = 0
+        if state.cache is not None and len(state.ids):
+            m = min(len(state.ids), L - 1)
+            neq = flat[:m] != state.ids[:m]
+            common = int(np.argmax(neq)) if neq.any() else m
+        if np.any(flat[common:] == -7):
+            if extend:  # shouldn't happen (visuals live in the prefix)
+                raise RuntimeError("visual slot escaped the shared prefix")
+            common = 0  # visual tokens in the suffix -> full mm prefill
+
+        suffix = flat[common:]
+        s_buck = packing.round_up_bucket(len(suffix))
+        # Never shrink below the live cache's capacity: generate's masks
+        # are built at cache_len and must span every slot the reused
+        # cache actually has.
+        cache_len = max(
+            packing.round_up_bucket(max(L + max_new, common + s_buck)),
+            state.cache_len,
+        )
+        dtype = oryx.compute_dtype(cfg)
+        with self._mesh_scope():
+            if common == 0 and packed is not None:
+                arrays = oryx.stage_mm_arrays(packed, batch)
+                embeds = oryx.mm_embeds(self.params, cfg, arrays)
+                s_buck = embeds.shape[1]
+                cache_len = max(
+                    packing.round_up_bucket(max(L + max_new, s_buck)),
+                    state.cache_len,
+                )
+            else:
+                rows = np.zeros((1, s_buck), np.int32)
+                rows[0, : len(suffix)] = np.where(
+                    suffix == -7, 0, suffix
+                )  # (-7 never reaches here: common==0 has no cache hits)
+                embeds = self.params["llm"]["embed"]["weight"][
+                    jnp.asarray(rows)
+                ]
+            cache = state.cache
+            if cache is None or state.cache_len < cache_len:
+                fresh = qwen2.init_kv_cache(
+                    cfg.llm, 1, cache_len, dtype=dtype
+                )
+                if cache is not None:
+                    # Grow: carry the existing slots into the new buffer.
+                    fresh = jax.tree.map(
+                        lambda f, c: jax.lax.dynamic_update_slice(
+                            f, c.astype(f.dtype), (0, 0, 0, 0, 0)
+                        ),
+                        fresh, cache,
+                    )
+                cache = fresh
+            toks, num, fin, cache = generate_lib.generate(
+                self.params["llm"], cfg.llm, cfg.generation,
+                inputs_embeds=embeds,
+                lengths=jnp.asarray([L], np.int32),
+                max_new_tokens=max_new, cache_len=cache_len, key=key,
+                attn_impl=cfg.attn_impl, compute_dtype=dtype,
+                stop_sequences=stop_seqs,
+                kv_cache=cache,
+                start=jnp.asarray(common, jnp.int32),
+                return_cache=True,
+            )
+        toks, num = np.asarray(toks), np.asarray(num)
+        reply = self._decode(toks[0], int(num[0]), extra_stops=stop)
+        new_ids = np.concatenate(
+            [flat, toks[0][: int(num[0])].astype(np.int64)]
+        )
+        return reply, PrefixCacheState(
+            ids=new_ids, cache=cache, cache_len=cache_len,
+            prompt_ids=ids, prompt_flat=flat,
+        )
+
     def chat_video(
         self,
         frames: Sequence[np.ndarray],
@@ -526,10 +664,40 @@ class OryxInference:
         return text.strip()
 
 
+@dataclasses.dataclass
+class PrefixCacheState:
+    """Cross-turn KV prefix cache for a single conversation: `ids` is
+    the token stream whose K/V currently occupy cache slots [0, len)
+    (visual slots marked -7 — they match positionally, never by id),
+    `cache` the device K/V, `cache_len` its slot capacity.
+    `prompt_ids`/`prompt_flat` record the previous turn's pre-splice and
+    post-splice prompt streams so a turn that merely EXTENDS the prompt
+    skips the host-side image packing entirely."""
+
+    ids: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int64)
+    )
+    cache: dict | None = None
+    cache_len: int = 0
+    prompt_ids: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int64)
+    )
+    prompt_flat: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int64)
+    )
+
+
 class ChatSession:
     """Stateful multi-turn conversation over one media context (the
-    reference's interactive CLI loop: media attach to the first turn,
-    every later question re-prefills against the accumulated history)."""
+    reference's interactive CLI loop: media attach to the first turn).
+
+    With cache=True (default) the session keeps the KV cache across
+    turns and each `ask` prefills only the token suffix the cache has
+    not seen (vLLM-style longest-common-prefix matching over token ids
+    — robust to tokenizer boundary effects, and the expensive video/
+    image prefill happens once per session instead of every turn).
+    Replies are identical either way; `ask_stream` always uses the
+    uncached streaming path."""
 
     def __init__(
         self,
@@ -537,17 +705,25 @@ class ChatSession:
         *,
         images: Sequence[np.ndarray] | None = None,
         is_video: bool = False,
+        cache: bool = True,
     ) -> None:
         self.pipe = pipe
         self.images = list(images or [])
         self.is_video = is_video and bool(self.images)
         self.history: list[tuple[str, str]] = []
+        self._cache_state = PrefixCacheState() if cache else None
 
     def ask(self, question: str, **kw) -> str:
-        reply = self.pipe.chat(
-            question, images=self.images, is_video=self.is_video,
-            history=self.history, **kw,
-        )
+        if self._cache_state is not None:
+            reply, self._cache_state = self.pipe.chat_cached(
+                self._cache_state, question, images=self.images,
+                is_video=self.is_video, history=self.history, **kw,
+            )
+        else:
+            reply = self.pipe.chat(
+                question, images=self.images, is_video=self.is_video,
+                history=self.history, **kw,
+            )
         self.history.append((question, reply))
         return reply
 
@@ -565,3 +741,5 @@ class ChatSession:
 
     def reset(self) -> None:
         self.history.clear()
+        if self._cache_state is not None:
+            self._cache_state = PrefixCacheState()

@@ -505,3 +505,84 @@ def test_decode_early_exit_skips_dead_steps(tiny_model):
     # 512 steps vs <=4; medians over 5 reps + a loose 3x margin keep
     # this robust to CI scheduler noise.
     assert t_full > 3 * t_eager, (t_full, t_eager)
+
+
+def test_chat_session_prefix_cache_matches_uncached(tiny_model, monkeypatch):
+    """ChatSession with the KV prefix cache returns the same replies as
+    the uncached path across multi-turn text AND image conversations —
+    and the expensive visual prefill runs ONCE per image session, not
+    once per turn."""
+    from oryx_tpu.models import oryx as oryx_lib
+    from oryx_tpu.serve.pipeline import ChatSession
+
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    img = np.random.default_rng(7).integers(
+        0, 255, size=(30, 44, 3), dtype=np.uint8
+    )
+    questions = ["what is this?", "why?", "are you sure about that?"]
+
+    mm_calls = []
+    real_mm_embeds = oryx_lib.mm_embeds
+    monkeypatch.setattr(
+        oryx_lib, "mm_embeds",
+        lambda *a, **k: (mm_calls.append(1), real_mm_embeds(*a, **k))[1],
+    )
+
+    for media in ({}, {"images": [img]}):
+        plain = ChatSession(pipe, cache=False, **media)
+        cached = ChatSession(pipe, cache=True, **media)
+        mm_calls.clear()
+        for q in questions:
+            a_plain = plain.ask(q, max_new_tokens=6)
+            a_cached = cached.ask(q, max_new_tokens=6)
+            assert a_cached == a_plain, (media.keys(), q, a_cached, a_plain)
+        if media:
+            # The cached session runs mm_embeds exactly ONCE (turn 1);
+            # turns 2-3 prefill only their text suffix. (The uncached
+            # twin encodes inside _jit_mm_generate, not mm_embeds, so it
+            # doesn't show up in this counter at all.)
+            assert len(mm_calls) == 1, len(mm_calls)
+        st = cached._cache_state
+        assert st is not None and len(st.ids) > 0 and st.cache is not None
+        # ids stream grows monotonically with the conversation.
+        assert len(st.ids) > len(questions[0])
+        cached.reset()
+        assert cached._cache_state.cache is None
+
+
+def test_chat_session_cache_grows_across_buckets(tiny_model):
+    """A turn that pushes the total past the cache bucket reallocates a
+    larger cache and keeps prior K/V (replies still match uncached)."""
+    from oryx_tpu.serve.pipeline import ChatSession
+
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    plain = ChatSession(pipe, cache=False)
+    cached = ChatSession(pipe, cache=True)
+    lens = []
+    for q in ("hi", "tell me a considerably longer question " * 3, "ok?"):
+        a_p = plain.ask(q, max_new_tokens=5)
+        a_c = cached.ask(q, max_new_tokens=5)
+        assert a_c == a_p
+        lens.append(cached._cache_state.cache_len)
+    assert lens[-1] >= lens[0]
+    assert lens == sorted(lens)  # never shrinks mid-session
+
+
+def test_chat_session_cache_shrinking_max_new(tiny_model):
+    """A later turn with a much smaller max_new_tokens must not shrink
+    the live cache's mask width (regression: cache_len < allocated slots
+    crashed generate / corrupted the state bookkeeping)."""
+    from oryx_tpu.serve.pipeline import ChatSession
+
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    plain = ChatSession(pipe, cache=False)
+    cached = ChatSession(pipe, cache=True)
+    for q, mx in (("hello there", 200), ("and now?", 4), ("more?", 4)):
+        a_p = plain.ask(q, max_new_tokens=mx)
+        a_c = cached.ask(q, max_new_tokens=mx)
+        assert a_c == a_p, (q, a_c, a_p)
+    st = cached._cache_state
+    assert st.cache_len >= 256  # held at the turn-1 bucket
