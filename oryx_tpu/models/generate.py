@@ -325,6 +325,9 @@ def generate_stream(
     compute_dtype=None,
     stop_sequences: jnp.ndarray | None = None,
     chunk: int = 8,
+    kv_cache: dict | None = None,
+    start: jnp.ndarray | None = None,
+    yield_cache: bool = False,
 ):
     """Streaming twin of `generate` (HF TextIteratorStreamer parity):
     yields np int32 token blocks [B, <=chunk] as they decode, with the
@@ -337,11 +340,17 @@ def generate_stream(
     tokens past max_new_tokens are computed and dropped, so cache_len
     must cover T + ceil(max_new/chunk)*chunk. Larger chunks amortize
     host round-trips, smaller ones lower first-token latency.
+
+    kv_cache/start: prefix reuse as in `generate`. With yield_cache the
+    generator yields (block, cache) pairs — the cache reference is valid
+    until the NEXT block is requested (the chunk dispatch donates it),
+    so a consumer breaking out of the loop may keep the last one.
     """
     padded_new = -(-max_new_tokens // chunk) * chunk
-    assert cache_len >= inputs_embeds.shape[1] + padded_new, (
-        cache_len, inputs_embeds.shape[1], padded_new
-    )
+    if kv_cache is None:
+        assert cache_len >= inputs_embeds.shape[1] + padded_new, (
+            cache_len, inputs_embeds.shape[1], padded_new
+        )
     if key is None:
         key = jax.random.key(0)
     stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
@@ -351,7 +360,7 @@ def generate_stream(
     )
     carry, key = _stream_prefill(
         params, cfg, gen_cfg, inputs_embeds, lengths, key,
-        stop_L=stop_L, **common,
+        stop_L=stop_L, kv_cache=kv_cache, start=start, **common,
     )
     step_keys = jax.random.split(key, padded_new)
     done = 0
@@ -362,7 +371,7 @@ def generate_stream(
         )
         n = min(chunk, max_new_tokens - done)
         toks, fin = np.asarray(toks)[:, :n], np.asarray(fin)[:, :n]
-        yield toks
+        yield (toks, carry[0]) if yield_cache else toks
         done += n
         if fin[:, -1].all():
             break

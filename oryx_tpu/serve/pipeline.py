@@ -367,6 +367,7 @@ class OryxInference:
         temperature: float | None = None,
         top_p: float | None = None,
         stop: Sequence[str] | None = None,
+        cache_state: "PrefixCacheState | None" = None,
     ):
         """Streaming `chat` (HF TextIteratorStreamer parity): yields text
         DELTAS as tokens decode; ''.join(deltas) equals chat()'s reply
@@ -376,6 +377,12 @@ class OryxInference:
         The generator's RETURN value (StopIteration.value) is the finish
         reason: "stop" (EOS/stop string) or "length" (max_new_tokens).
         temperature/top_p/stop override per request as in `chat_batch`.
+
+        With cache_state (ChatSession.ask_stream), the shared token
+        prefix is served from the session's KV cache (_prefix_plan) and
+        the RETURN value becomes (reason, new PrefixCacheState) — the
+        new state's ids cover the PROMPT only (streamed reply tokens are
+        re-prefilled next turn; the visual prefill is still one-time).
         """
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
@@ -387,7 +394,23 @@ class OryxInference:
             "is_video": is_video, "history": list(history or []),
         })
 
-        if images:
+        # Decode always runs whole chunks (a shrunken final chunk would
+        # compile a second decode program); overshoot tokens are dropped
+        # and the cache is sized for the padded length.
+        padded_new = -(-max_new // chunk) * chunk
+        kv_cache = start = flat = None
+        media_key = ()
+        if cache_state is not None:
+            with self._mesh_scope():
+                flat, L, common, embeds, kv_cache, cache_len, media_key = (
+                    self._prefix_plan(
+                        cache_state, cfg, ids, images, factors, caps,
+                        padded_new,
+                    )
+                )
+            lengths = jnp.asarray([L], np.int32)
+            start = jnp.asarray(common, jnp.int32)
+        elif images:
             packed = packing.pack_raw_images(
                 images,
                 patch_size=cfgv.patch_size,
@@ -396,19 +419,11 @@ class OryxInference:
                 max_patches=caps,
             )
             batch = splice.build_mm_batch([ids], splice.query_slots(packed))
-            arrays = {
-                "patches": jnp.asarray(packed.patches),
-                "segment_ids": jnp.asarray(packed.segment_ids),
-                "pos_coords": jnp.asarray(packed.pos_coords),
-                "region_ids": jnp.asarray(packed.region_ids),
-                "q_region_ids": jnp.asarray(packed.q_region_ids),
-                "token_ids": jnp.asarray(batch.token_ids),
-                "visual_idx": jnp.asarray(batch.visual_idx),
-                "is_visual": jnp.asarray(batch.is_visual),
-            }
+            arrays = oryx.stage_mm_arrays(packed, batch)
             with self._mesh_scope():
                 embeds = oryx.mm_embeds(self.params, cfg, arrays)
             lengths = jnp.asarray(batch.lengths)
+            cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
         else:
             T = packing.round_up_bucket(len(ids))
             rows = np.zeros((1, T), np.int32)
@@ -418,12 +433,7 @@ class OryxInference:
                     jnp.asarray(rows)
                 ]
             lengths = jnp.asarray([len(ids)], np.int32)
-
-        # Decode always runs whole chunks (a shrunken final chunk would
-        # compile a second decode program); overshoot tokens are dropped
-        # and the cache is sized for the padded length.
-        padded_new = -(-max_new // chunk) * chunk
-        cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
+            cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
         eos = cfg.generation.eos_token_id
         stops = ([self.conv.stop_str] if self.conv.stop_str else []) + [
             s for s in (stop or []) if s  # "" would truncate everything
@@ -460,6 +470,19 @@ class OryxInference:
                 text = text[: len(text) - held]
             return text.rstrip()
 
+        final_cache = None
+
+        def result(reason):
+            """Return value: bare reason, or (reason, new state) when the
+            caller passed a cache_state."""
+            if cache_state is None:
+                return reason
+            return reason, PrefixCacheState(
+                ids=flat, cache=final_cache, cache_len=cache_len,
+                prompt_ids=np.asarray(ids, np.int64), prompt_flat=flat,
+                media_key=media_key,
+            )
+
         with self._mesh_scope():
             for block in generate_lib.generate_stream(
                 self.params["llm"], cfg.llm, cfg.generation,
@@ -468,7 +491,11 @@ class OryxInference:
                 attn_impl=cfg.attn_impl,
                 compute_dtype=oryx.compute_dtype(cfg),
                 stop_sequences=stop_seqs, chunk=chunk,
+                kv_cache=kv_cache, start=start,
+                yield_cache=cache_state is not None,
             ):
+                if cache_state is not None:
+                    block, final_cache = block
                 for t in block[0]:
                     if int(t) == eos:
                         finished = True
@@ -484,13 +511,114 @@ class OryxInference:
                     yield safe[len(text_done):]
                     text_done = safe
                 if finished:
-                    return "stop"
+                    return result("stop")
         # Decode window exhausted without EOS/stop: flush the held-back
         # tail (chat() would return it) and report the truncation.
         tail = text.strip() if emitted else ""
         if len(tail) > len(text_done):
             yield tail[len(text_done):]
-        return "length"
+        return result("length")
+
+    def _prefix_plan(
+        self, state: "PrefixCacheState", cfg, ids, imgs, factors, caps,
+        new_budget: int,
+    ):
+        """Host-side half of prefix-cached generation: match the new
+        prompt's post-splice token stream against the cache, build the
+        suffix embeds and a (possibly grown) cache. `new_budget` is the
+        number of decode slots to reserve past the prompt (max_new, or
+        the chunk-padded window for streaming).
+
+        Returns (flat, L, common, embeds, cache, cache_len, media_key)."""
+        cfgv = cfg.vision
+        ids = np.asarray(ids, np.int64)
+
+        # Visual slots match positionally, not by content — a cache built
+        # over DIFFERENT media must not be matched against at all.
+        media_key = _media_fingerprint(imgs)
+        reusable = state.cache is not None and state.media_key == media_key
+
+        # A turn that merely EXTENDS the previous prompt (the normal
+        # multi-turn case: same media, appended history) reuses the
+        # stored post-splice stream — no host-side image re-packing.
+        packed = batch = None
+        np_prev = state.prompt_ids
+        extend = (
+            reusable
+            and 0 < len(np_prev) < len(ids)
+            and np.array_equal(ids[: len(np_prev)], np_prev)
+            and not np.any(ids[len(np_prev):] == IMAGE_TOKEN_INDEX)
+        )
+        if extend:
+            flat = np.concatenate([state.prompt_flat, ids[len(np_prev):]])
+            L = len(flat)
+        elif imgs:
+            packed = packing.pack_raw_images(
+                imgs, patch_size=cfgv.patch_size, base_grid=cfgv.base_grid,
+                side_factors=factors, max_patches=caps,
+            )
+            batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+            L = int(batch.lengths[0])
+            row = np.asarray(batch.token_ids[0][:L], np.int64)
+            isv = np.asarray(batch.is_visual[0][:L])
+            flat = np.where(isv, -7, row)
+        else:
+            L = len(ids)
+            flat = ids
+
+        # Longest shared prefix with the cache's token stream. Keep at
+        # least one token in the suffix (the prefill must produce the
+        # next-token logit), and never split a visual region (-7 marks
+        # visual slots in the flat stream).
+        common = 0
+        if reusable and len(state.ids):
+            m = min(len(state.ids), L - 1)
+            neq = flat[:m] != state.ids[:m]
+            common = int(np.argmax(neq)) if neq.any() else m
+        if np.any(flat[common:] == -7):
+            if extend:  # shouldn't happen (visuals live in the prefix)
+                raise RuntimeError("visual slot escaped the shared prefix")
+            common = 0  # visual tokens in the suffix -> full mm prefill
+
+        suffix = flat[common:]
+        s_buck = packing.round_up_bucket(len(suffix))
+        # Never shrink below the live cache's capacity: generate's masks
+        # are built at cache_len and must span every slot the reused
+        # cache actually has.
+        cache_len = max(
+            packing.round_up_bucket(max(L + new_budget, common + s_buck)),
+            state.cache_len,
+        )
+        dtype = oryx.compute_dtype(cfg)
+        if common == 0 and packed is not None:
+            arrays = oryx.stage_mm_arrays(packed, batch)
+            embeds = oryx.mm_embeds(self.params, cfg, arrays)
+            s_buck = embeds.shape[1]
+            cache_len = max(
+                packing.round_up_bucket(max(L + new_budget, s_buck)),
+                state.cache_len,
+            )
+        else:
+            rows = np.zeros((1, s_buck), np.int32)
+            rows[0, : len(suffix)] = np.where(
+                suffix == -7, 0, suffix
+            )  # (-7 never reaches here: common==0 has no cache hits)
+            embeds = self.params["llm"]["embed"]["weight"][
+                jnp.asarray(rows)
+            ]
+        cache = state.cache
+        if cache is None or state.cache_len < cache_len:
+            fresh = qwen2.init_kv_cache(cfg.llm, 1, cache_len, dtype=dtype)
+            if cache is not None:
+                # Grow: carry the existing slots into the new buffer.
+                fresh = jax.tree.map(
+                    lambda f, c: jax.lax.dynamic_update_slice(
+                        f, c.astype(f.dtype), (0, 0, 0, 0, 0)
+                    ),
+                    fresh, cache,
+                )
+            cache = fresh
+        return flat, L, common, embeds, cache, cache_len, media_key
 
     def chat_cached(
         self,
@@ -522,98 +650,19 @@ class OryxInference:
             "question": question, "images": list(images or []),
             "is_video": is_video, "history": list(history or []),
         })
-        cfgv = cfg.vision
-        ids = np.asarray(ids, np.int64)
-
-        # A turn that merely EXTENDS the previous prompt (the normal
-        # multi-turn case: same media, appended history) reuses the
-        # stored post-splice stream — no host-side image re-packing.
-        packed = batch = None
-        np_prev = state.prompt_ids
-        extend = (
-            state.cache is not None
-            and 0 < len(np_prev) < len(ids)
-            and np.array_equal(ids[: len(np_prev)], np_prev)
-            and not np.any(ids[len(np_prev):] == IMAGE_TOKEN_INDEX)
-        )
-        if extend:
-            flat = np.concatenate([state.prompt_flat, ids[len(np_prev):]])
-            L = len(flat)
-        elif imgs:
-            packed = packing.pack_raw_images(
-                imgs, patch_size=cfgv.patch_size, base_grid=cfgv.base_grid,
-                side_factors=factors, max_patches=caps,
-            )
-            batch = splice.build_mm_batch([ids], splice.query_slots(packed))
-            L = int(batch.lengths[0])
-            row = np.asarray(batch.token_ids[0][:L], np.int64)
-            isv = np.asarray(batch.is_visual[0][:L])
-            flat = np.where(isv, -7, row)
-        else:
-            L = len(ids)
-            flat = ids
-
-        # Longest shared prefix with the cache's token stream. Keep at
-        # least one token in the suffix (the prefill must produce the
-        # next-token logit), and never split a visual region (-7 marks
-        # visual slots in the flat stream).
-        common = 0
-        if state.cache is not None and len(state.ids):
-            m = min(len(state.ids), L - 1)
-            neq = flat[:m] != state.ids[:m]
-            common = int(np.argmax(neq)) if neq.any() else m
-        if np.any(flat[common:] == -7):
-            if extend:  # shouldn't happen (visuals live in the prefix)
-                raise RuntimeError("visual slot escaped the shared prefix")
-            common = 0  # visual tokens in the suffix -> full mm prefill
-
-        suffix = flat[common:]
-        s_buck = packing.round_up_bucket(len(suffix))
-        # Never shrink below the live cache's capacity: generate's masks
-        # are built at cache_len and must span every slot the reused
-        # cache actually has.
-        cache_len = max(
-            packing.round_up_bucket(max(L + max_new, common + s_buck)),
-            state.cache_len,
-        )
-        dtype = oryx.compute_dtype(cfg)
         with self._mesh_scope():
-            if common == 0 and packed is not None:
-                arrays = oryx.stage_mm_arrays(packed, batch)
-                embeds = oryx.mm_embeds(self.params, cfg, arrays)
-                s_buck = embeds.shape[1]
-                cache_len = max(
-                    packing.round_up_bucket(max(L + max_new, s_buck)),
-                    state.cache_len,
+            flat, L, common, embeds, cache, cache_len, media_key = (
+                self._prefix_plan(
+                    state, cfg, ids, imgs, factors, caps, max_new
                 )
-            else:
-                rows = np.zeros((1, s_buck), np.int32)
-                rows[0, : len(suffix)] = np.where(
-                    suffix == -7, 0, suffix
-                )  # (-7 never reaches here: common==0 has no cache hits)
-                embeds = self.params["llm"]["embed"]["weight"][
-                    jnp.asarray(rows)
-                ]
-            cache = state.cache
-            if cache is None or state.cache_len < cache_len:
-                fresh = qwen2.init_kv_cache(
-                    cfg.llm, 1, cache_len, dtype=dtype
-                )
-                if cache is not None:
-                    # Grow: carry the existing slots into the new buffer.
-                    fresh = jax.tree.map(
-                        lambda f, c: jax.lax.dynamic_update_slice(
-                            f, c.astype(f.dtype), (0, 0, 0, 0, 0)
-                        ),
-                        fresh, cache,
-                    )
-                cache = fresh
+            )
             toks, num, fin, cache = generate_lib.generate(
                 self.params["llm"], cfg.llm, cfg.generation,
                 inputs_embeds=embeds,
                 lengths=jnp.asarray([L], np.int32),
                 max_new_tokens=max_new, cache_len=cache_len, key=key,
-                attn_impl=cfg.attn_impl, compute_dtype=dtype,
+                attn_impl=cfg.attn_impl,
+                compute_dtype=oryx.compute_dtype(cfg),
                 stop_sequences=stop_seqs,
                 kv_cache=cache,
                 start=jnp.asarray(common, jnp.int32),
@@ -626,7 +675,8 @@ class OryxInference:
         )
         return reply, PrefixCacheState(
             ids=new_ids, cache=cache, cache_len=cache_len,
-            prompt_ids=ids, prompt_flat=flat,
+            prompt_ids=np.asarray(ids, np.int64), prompt_flat=flat,
+            media_key=media_key,
         )
 
     def chat_video(
@@ -685,6 +735,20 @@ class PrefixCacheState:
     prompt_flat: np.ndarray = dataclasses.field(
         default_factory=lambda: np.zeros((0,), np.int64)
     )
+    # Content fingerprint of the session's media: visual slots match
+    # POSITIONALLY in the id stream, so swapped same-shape images would
+    # otherwise silently reuse the old images' K/V.
+    media_key: tuple = ()
+
+
+def _media_fingerprint(imgs) -> tuple:
+    """Cheap content key for the media list (crc32 per image + shape)."""
+    import zlib
+
+    return tuple(
+        (im.shape, zlib.crc32(np.ascontiguousarray(im).tobytes()))
+        for im in imgs
+    )
 
 
 class ChatSession:
@@ -692,12 +756,13 @@ class ChatSession:
     reference's interactive CLI loop: media attach to the first turn).
 
     With cache=True (default) the session keeps the KV cache across
-    turns and each `ask` prefills only the token suffix the cache has
-    not seen (vLLM-style longest-common-prefix matching over token ids
-    — robust to tokenizer boundary effects, and the expensive video/
-    image prefill happens once per session instead of every turn).
-    Replies are identical either way; `ask_stream` always uses the
-    uncached streaming path."""
+    turns and each `ask` / `ask_stream` prefills only the token suffix
+    the cache has not seen (vLLM-style longest-common-prefix matching
+    over token ids — robust to tokenizer boundary effects, and the
+    expensive video/image prefill happens once per session instead of
+    every turn; a media-content fingerprint guards against positional
+    false matches). Replies and streamed deltas are identical either
+    way."""
 
     def __init__(
         self,
@@ -729,12 +794,21 @@ class ChatSession:
 
     def ask_stream(self, question: str, **kw):
         """Streamed `ask`: yields text deltas; records the turn in
-        history once the stream is consumed."""
+        history once the stream is consumed. With the session cache on,
+        the prompt prefix (including the visual prefill) is served from
+        the KV cache like `ask`."""
         parts: list[str] = []
-        for delta in self.pipe.chat_stream(
+        gen = self.pipe.chat_stream(
             question, images=self.images, is_video=self.is_video,
-            history=self.history, **kw,
-        ):
+            history=self.history, cache_state=self._cache_state, **kw,
+        )
+        while True:
+            try:
+                delta = next(gen)
+            except StopIteration as s:
+                if self._cache_state is not None and s.value is not None:
+                    _, self._cache_state = s.value
+                break
             parts.append(delta)
             yield delta
         self.history.append((question, "".join(parts).strip()))
