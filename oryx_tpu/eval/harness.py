@@ -340,7 +340,13 @@ def main(argv: list[str] | None = None) -> None:
         "one chip; combine with --process-* to also split the dataset "
         "across hosts",
     )
+    ap.add_argument(
+        "--quantize", default=None, choices=["int8"],
+        help="weight-only int8 for single-chip serving",
+    )
     args = ap.parse_args(argv)
+    if args.quantize and args.shard:
+        ap.error("--quantize is single-chip serving; drop --shard")
 
     from oryx_tpu.eval.adapters import adapt
     from oryx_tpu.parallel.mesh import parse_shard_arg
@@ -352,7 +358,7 @@ def main(argv: list[str] | None = None) -> None:
         ap.error(str(e))
     pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
-        mesh=mesh, sharding_mode=mode,
+        mesh=mesh, sharding_mode=mode, quantize=args.quantize,
     )
     records = adapt(args.format, load_task(args.task))
     result = evaluate(

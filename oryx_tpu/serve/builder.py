@@ -145,6 +145,7 @@ def load_pretrained_model(
     dtype=jnp.float32,
     mesh=None,
     sharding_mode: str = "tp",
+    quantize: str | None = None,
 ) -> tuple[Any, Params, OryxConfig]:
     """Load (tokenizer, params, cfg) from an oryx_tpu model directory.
 
@@ -155,7 +156,19 @@ def load_pretrained_model(
     `serving_param_shardings(mode=sharding_mode)` — required for models
     that exceed one chip (34B-class serving); pass the same mesh to
     `OryxInference`.
+
+    quantize="int8": weight-only per-channel int8 for single-chip
+    serving (utils/quant.py) — halves weight HBM so 7B-class models fit
+    one v5e. Mutually exclusive with mesh (sharded restore would need
+    Q8-aware specs).
     """
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize={quantize!r}: int8 or None")
+    if quantize and mesh is not None:
+        raise ValueError(
+            "quantize='int8' is single-chip serving; drop --shard "
+            "(sharded serving streams weights over ICI instead)"
+        )
     cfg_file = os.path.join(model_path, CONFIG_NAME)
     if cfg is None:
         if not os.path.exists(cfg_file):
@@ -189,7 +202,15 @@ def load_pretrained_model(
     # Both checkpoint shapes: take the weights subtree of a TrainState.
     if isinstance(restored, dict) and "params" in restored:
         restored = restored["params"]
-    params = jax.tree.map(cast, restored)
+    if quantize == "int8":
+        from oryx_tpu.utils.quant import quantize_params
+
+        # Quantize leaf-by-leaf straight off the host restore: the full
+        # float tree never lands on the device (it wouldn't fit the very
+        # chip --quantize targets).
+        params = quantize_params(restored, cast=cast)
+    else:
+        params = jax.tree.map(cast, restored)
 
     if tokenizer is None:
         tokenizer = load_tokenizer(tokenizer_path or model_path)
@@ -238,12 +259,14 @@ def load_pipeline(
     mesh=None,
     sharding_mode: str = "tp",
     template: str = "qwen",
+    quantize: str | None = None,
 ):
     """One-call serving setup shared by the serve/eval/API CLIs:
-    (optionally sharded) model load → OryxInference. Pass either a
-    `--shard`-style string (`shard="tp=8"`) or a pre-built mesh + mode
-    (CLIs parse the string themselves so malformed values surface as
-    argparse usage errors, not load failures)."""
+    (optionally sharded, optionally int8-quantized) model load →
+    OryxInference. Pass either a `--shard`-style string (`shard="tp=8"`)
+    or a pre-built mesh + mode (CLIs parse the string themselves so
+    malformed values surface as argparse usage errors, not load
+    failures)."""
     from oryx_tpu.serve.pipeline import OryxInference
 
     if shard is not None:
@@ -252,7 +275,7 @@ def load_pipeline(
         mesh, sharding_mode = parse_shard_arg(shard)
     tokenizer, params, cfg = load_pretrained_model(
         model_path, tokenizer_path=tokenizer_path, tokenizer=tokenizer,
-        mesh=mesh, sharding_mode=sharding_mode,
+        mesh=mesh, sharding_mode=sharding_mode, quantize=quantize,
     )
     return OryxInference(
         tokenizer, params, cfg, template=template, mesh=mesh,

@@ -39,9 +39,16 @@ def main(argv: list[str] | None = None) -> None:
         help="multi-chip serving over all visible devices, e.g. tp=8 or "
         "fsdp=8 (34B-class models; the reference's device_map analog)",
     )
+    ap.add_argument(
+        "--quantize", default=None, choices=["int8"],
+        help="weight-only int8 for single-chip serving (halves weight "
+        "HBM; mutually exclusive with --shard)",
+    )
     args = ap.parse_args(argv)
     if args.question is None and not args.interactive:
         ap.error("--question is required unless --interactive")
+    if args.quantize and args.shard:
+        ap.error("--quantize is single-chip serving; drop --shard")
 
     from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
@@ -54,6 +61,7 @@ def main(argv: list[str] | None = None) -> None:
     pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
         mesh=mesh, sharding_mode=mode, template=args.template,
+        quantize=args.quantize,
     )
 
     if args.video is not None:

@@ -87,7 +87,14 @@ def main(argv: list[str] | None = None) -> None:
         "--shard", default=None, metavar="MODE=N",
         help="multi-chip serving (tp=N | fsdp=N over all visible devices)",
     )
+    ap.add_argument(
+        "--quantize", default=None, choices=["int8"],
+        help="weight-only int8 for single-chip serving (halves weight "
+        "HBM; mutually exclusive with --shard)",
+    )
     args = ap.parse_args(argv)
+    if args.quantize and args.shard:
+        ap.error("--quantize is single-chip serving; drop --shard")
 
     from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
@@ -98,7 +105,7 @@ def main(argv: list[str] | None = None) -> None:
         ap.error(str(e))
     pipe = load_pipeline(
         args.model_path, tokenizer_path=args.tokenizer_path,
-        mesh=mesh, sharding_mode=mode,
+        mesh=mesh, sharding_mode=mode, quantize=args.quantize,
     )
     app = build_app(pipe, num_frames=args.num_frames)
     app.launch(server_port=args.port)

@@ -1,0 +1,131 @@
+"""Weight-only int8 quantization for serving.
+
+TPU-first rationale: single-chip decode is HBM-bandwidth-bound — every
+step streams the full weight set. Symmetric per-output-channel int8
+halves the bytes (Oryx-7B: ~15.2 GB bf16 → ~7.6 GB, fitting a 16 GB
+v5e WITH its KV cache), and XLA fuses the dequant (convert + scale
+multiply) into the matmul's operand read so int8 is what crosses HBM.
+The reference serves its 34B across 8 GPUs with `device_map` instead
+(SURVEY.md §2 "Model builder"); this is the one-chip alternative.
+
+`Q8Weight` is a registered pytree node that impersonates a weight array
+at its use sites: `.astype(dt)` dequantizes (matmul operands), `[idx]`
+gathers-then-dequantizes (embedding rows), `.T` transposes the
+dequantized tensor (tied lm_head). `lax.scan` over stacked-layer params
+slices its children's leading axis like any leaf, so the decoder scan
+needs no changes. Training never sees Q8Weight — quantization happens
+at serving load (`serve.builder.load_pipeline(quantize="int8")`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+# Leaves smaller than this stay in float (biases, norms, pos embeds):
+# no bandwidth win, and tiny tensors are precision-sensitive.
+MIN_QUANT_SIZE = 1 << 16
+
+
+@jax.tree_util.register_pytree_node_class
+class Q8Weight:
+    """Symmetric per-output-channel int8 weight + float scale.
+
+    q: int8 [..., in, out]; scale: [..., 1, out] (last axis = output
+    channels; leading axes, e.g. the stacked-layer axis, are preserved
+    so `lax.scan` can slice them)."""
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # ---- array impersonation at the weight-use sites -----------------
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):  # the LOGICAL dtype consumers see after dequant
+        return self.scale.dtype
+
+    def astype(self, dt):
+        return self.q.astype(dt) * self.scale.astype(dt)
+
+    def __getitem__(self, idx):
+        # Embedding-table gather: rows out of q, then per-column scale.
+        # 2-D tables share one scale row ([1, out]); stacked 3-D weights
+        # must gather the MATCHING per-layer scales.
+        s = self.scale[idx] if self.q.ndim > 2 else self.scale[0]
+        return self.q[idx].astype(self.scale.dtype) * s
+
+    @property
+    def T(self):
+        return self.astype(self.scale.dtype).T
+
+    def __repr__(self):
+        return f"Q8Weight(shape={self.q.shape}, scale={self.scale.shape})"
+
+
+def quantize_array(w: jnp.ndarray) -> Q8Weight:
+    """Symmetric int8 over the -2 (input) axis: one scale per output
+    channel (and per leading/stacked index)."""
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+    scale = (amax / 127.0 + jnp.finfo(jnp.float32).tiny).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return Q8Weight(q, scale)
+
+
+def _should_quantize(path: tuple[str, ...], leaf) -> bool:
+    name = path[-1] if path else ""
+    if getattr(leaf, "ndim", 0) < 2 or leaf.size < MIN_QUANT_SIZE:
+        return False
+    if name == "kernel":
+        return True
+    # The embedding table ([V, H], the single largest tensor) — but not
+    # norm weights or the interpolated pos-embed grid.
+    return name == "weight" and len(path) >= 2 and path[-2] == "embed"
+
+
+def quantize_params(params: Params, cast=None) -> Params:
+    """Quantize every large matmul/embedding weight in a param tree;
+    biases, norms and small tensors pass through `cast` (identity by
+    default). One leaf is processed at a time, so quantizing a
+    HOST-restored tree peaks device memory at int8-total + one float
+    leaf — a 7B model quantizes ON LOAD within a 16 GB chip (a
+    device-side full-precision tree would already be ~15-28 GB)."""
+    cast = cast or (lambda x: x)
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        if _should_quantize(path, node):
+            return quantize_array(node)
+        return cast(node)
+
+    return walk(params, ())
+
+
+def quantized_bytes(params: Params) -> int:
+    """Total serving bytes of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
