@@ -559,6 +559,23 @@ def main() -> None:
                 print(f"# 256-frame latency bench failed: {e!r}")
                 lat256 = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # int8 weight-only serving latency (utils/quant.py): decode is
+    # HBM-bandwidth-bound, so halving weight bytes should show directly
+    # in device_p50 — measured on the same 64-frame case.
+    lat64_q8 = None
+    want_q8 = os.environ.get(
+        "BENCH_INT8", "1" if backend == "tpu" else "0"
+    ) == "1"
+    if want_q8 and lat64 is not None:
+        try:
+            from oryx_tpu.utils.quant import quantize_params
+
+            params = quantize_params(params)
+            lat64_q8 = bench_video_latency(params, cfg, 64)
+        except Exception as e:  # attempted-and-failed must be auditable
+            print(f"# int8 latency bench failed: {e!r}")
+            lat64_q8 = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     print(json.dumps({
         "metric": "sft_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 2),
@@ -574,6 +591,7 @@ def main() -> None:
         "latency_video64_p50_s": lat64 and lat64["e2e_p50_s"],
         "latency_video64": lat64,
         "latency_video256": lat256,
+        "latency_video64_int8": lat64_q8,
     }))
 
 
