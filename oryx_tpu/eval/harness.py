@@ -164,6 +164,7 @@ def evaluate(
     log_every: int = 25,
     batch_size: int = 8,
     length_group: bool = True,
+    scoring: str = "generate",
 ) -> EvalResult:
     """Run the inference stack over a record shard and score it.
 
@@ -180,7 +181,16 @@ def evaluate(
     so mixed-length batches otherwise pay worst-row padding (the
     training side's LengthGroupedSampler, applied to eval). Record
     ORDER in the output changes but ids/scoring don't.
+
+    scoring="loglikelihood" (lmms-eval's second model API): MCQ records
+    are scored by the option LETTER with the highest teacher-forced
+    log-probability (`pipe.score_options` — one visual prefill + one
+    tiny forward per option, no sampling variance); records without
+    options still generate. "generate" (default) decodes a reply and
+    parses the letter, the lmms-eval `generate_until` protocol.
     """
+    if scoring not in ("generate", "loglikelihood"):
+        raise ValueError(f"scoring={scoring!r}: generate|loglikelihood")
     t0 = time.perf_counter()
     out: list[dict[str, Any]] = []
     correct = 0
@@ -208,7 +218,31 @@ def evaluate(
             })
         proxies = [p for _, _, p in group]
         pad_waste += sum(max(proxies) - p for p in proxies)
-        replies = pipe.chat_batch(requests, max_new_tokens=max_new_tokens)
+        if scoring == "loglikelihood":
+            replies: list[str | None] = [None] * len(group)
+            open_idx = [
+                i for i, (_, rec, _) in enumerate(group)
+                if not rec.get("options")
+            ]
+            if open_idx:  # optionless records still BATCH their decode
+                open_replies = pipe.chat_batch(
+                    [requests[i] for i in open_idx],
+                    max_new_tokens=max_new_tokens,
+                )
+                for i, r in zip(open_idx, open_replies):
+                    replies[i] = r
+            for i, (req, (_, rec, _)) in enumerate(zip(requests, group)):
+                opts = rec.get("options")
+                if opts:
+                    scores = pipe.score_options(
+                        req["question"], LETTERS[: len(opts)],
+                        images=req["images"], is_video=req["is_video"],
+                    )
+                    replies[i] = LETTERS[int(scores.argmax())]
+        else:
+            replies = pipe.chat_batch(
+                requests, max_new_tokens=max_new_tokens
+            )
         for (gi, rec, _), reply in zip(group, replies):
             ok = score_record(rec, reply)
             correct += ok
@@ -332,6 +366,13 @@ def main(argv: list[str] | None = None) -> None:
         help="keep dataset order instead of sorting batches by "
         "(modality, length) — more padding, reproducible order",
     )
+    ap.add_argument(
+        "--scoring", default="generate",
+        choices=["generate", "loglikelihood"],
+        help="MCQ protocol: decode-and-parse the letter (generate) or "
+        "pick the letter with the highest teacher-forced log-prob "
+        "(loglikelihood; lmms-eval's second model API)",
+    )
     ap.add_argument("--process-index", type=int, default=0)
     ap.add_argument("--process-count", type=int, default=1)
     ap.add_argument(
@@ -367,6 +408,7 @@ def main(argv: list[str] | None = None) -> None:
         max_new_tokens=args.max_new_tokens, batch_size=args.batch_size,
         process_index=args.process_index, process_count=args.process_count,
         length_group=not args.no_length_group,
+        scoring=args.scoring,
     )
     _print_summary(result, by=args.by)
     if args.output:

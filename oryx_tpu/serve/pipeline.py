@@ -60,6 +60,65 @@ def _jit_text_generate(
     )
 
 
+@partial(jax.jit, static_argnames=("cfg", "cache_len"))
+def _jit_ll_prefill(params, cfg: OryxConfig, embeds, length, cache_len: int):
+    """Prompt prefill for log-likelihood scoring → (log-softmax of the
+    next-token logits at the prompt's last real position, KV cache)."""
+    from oryx_tpu.models import qwen2 as qwen2_lib
+
+    B, T, _ = embeds.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+    kv_mask = (slot_ar < length).astype(jnp.int32)
+    cache = qwen2_lib.init_kv_cache(
+        cfg.llm, B, cache_len, dtype=oryx.compute_dtype(cfg)
+    )
+    logits, cache = qwen2_lib.forward(
+        params["llm"], cfg.llm,
+        inputs_embeds=embeds, positions=positions,
+        kv_cache=cache, write_slots=jnp.zeros((B,), jnp.int32),
+        kv_mask=kv_mask, attn_impl=cfg.attn_impl,
+        compute_dtype=oryx.compute_dtype(cfg),
+    )
+    last = jnp.take_along_axis(
+        logits, (length - 1)[None, None, None].astype(jnp.int32), axis=1
+    )[0, 0]
+    return jax.nn.log_softmax(last.astype(jnp.float32)), cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "cache_len"))
+def _jit_ll_suffix(params, cfg: OryxConfig, cache, cont_ids, length, k,
+                   cache_len: int):
+    """Teacher-force one option's tokens against the prompt cache →
+    (log-softmax over the suffix positions [Kb, V], cache)."""
+    from oryx_tpu.models import qwen2 as qwen2_lib
+
+    B, Kb = cont_ids.shape
+    embeds = params["llm"]["embed"]["weight"][cont_ids]
+    positions = length + jnp.broadcast_to(
+        jnp.arange(Kb, dtype=jnp.int32), (B, Kb)
+    )
+    slot_ar = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+    kv_mask = (slot_ar < length + k).astype(jnp.int32)
+    logits, cache = qwen2_lib.forward(
+        params["llm"], cfg.llm,
+        inputs_embeds=embeds, positions=positions,
+        kv_cache=cache,
+        write_slots=jnp.broadcast_to(length.astype(jnp.int32), (B,)),
+        kv_mask=kv_mask, attn_impl=cfg.attn_impl,
+        compute_dtype=oryx.compute_dtype(cfg),
+    )
+    # Gather ON DEVICE: position j's log-prob of continuation token j+1.
+    # Returning the full [Kb, V] log-softmax would ship ~Kb x vocab
+    # floats to the host per option just to read a handful of scalars.
+    lp = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+    nxt = jnp.concatenate(
+        [cont_ids[0, 1:], jnp.zeros((1,), cont_ids.dtype)]
+    )
+    vec = jnp.take_along_axis(lp, nxt[:, None].astype(jnp.int32), axis=1)
+    return vec[:, 0], cache
+
+
 class OryxInference:
     """Stateless-per-call chat interface over a loaded model.
 
@@ -678,6 +737,91 @@ class OryxInference:
             prompt_ids=np.asarray(ids, np.int64), prompt_flat=flat,
             media_key=media_key,
         )
+
+    def score_options(
+        self,
+        question: str,
+        options: Sequence[str],
+        *,
+        images: Sequence[np.ndarray] | None = None,
+        is_video: bool = False,
+        history: Sequence[tuple[str, str]] | None = None,
+    ) -> np.ndarray:
+        """Log-likelihood of each candidate continuation given the
+        prompt (lmms-eval's `loglikelihood` model API): the prompt —
+        including any visual prefill — runs ONCE into a KV cache, then
+        each option's tokens are teacher-forced against it, summing
+        next-token log-probs. Returns [len(options)] float64 sums.
+
+        One device prefill + one tiny suffix forward per option; options
+        longer than the suffix bucket share a compiled program.
+
+        Caveat (lmms-eval encodes context+continuation jointly and
+        splits): options are tokenized STANDALONE, so a BPE tokenizer
+        that would merge across the prompt/option boundary scores a
+        token split the model may never emit there. Single-letter or
+        newline-separated continuations (the harness's MCQ protocol)
+        are unaffected; for free-text options include any leading
+        space/punctuation in the option string itself."""
+        ids, imgs, factors, caps = self._prepare_request({
+            "question": question, "images": list(images or []),
+            "is_video": is_video, "history": list(history or []),
+        })
+        cfg = self.cfg
+        opt_ids = [
+            np.asarray(
+                self.tokenizer.encode(o, add_special_tokens=False),
+                np.int32,
+            )
+            for o in options
+        ]
+        if any(len(o) == 0 for o in opt_ids):
+            raise ValueError("every option must encode to >= 1 token")
+        kb = packing.round_up_bucket(max(len(o) for o in opt_ids))
+
+        with self._mesh_scope():
+            if imgs:
+                packed = packing.pack_raw_images(
+                    imgs, patch_size=cfg.vision.patch_size,
+                    base_grid=cfg.vision.base_grid,
+                    side_factors=factors, max_patches=caps,
+                )
+                batch = splice.build_mm_batch(
+                    [ids], splice.query_slots(packed)
+                )
+                embeds = oryx.mm_embeds(
+                    self.params, cfg, oryx.stage_mm_arrays(packed, batch)
+                )
+                L = int(batch.lengths[0])
+            else:
+                L = len(ids)
+                rows = np.zeros((1, packing.round_up_bucket(L)), np.int32)
+                rows[0, :L] = ids
+                embeds = self.params["llm"]["embed"]["weight"][
+                    jnp.asarray(rows)
+                ]
+            cache_len = packing.round_up_bucket(L + kb)
+            first_lp, cache = _jit_ll_prefill(
+                self.params, cfg, embeds, jnp.asarray(L, jnp.int32),
+                cache_len,
+            )
+            first_lp = np.asarray(first_lp, np.float64)
+            scores = np.zeros(len(options), np.float64)
+            for i, o in enumerate(opt_ids):
+                row = np.zeros((1, kb), np.int32)
+                row[0, : len(o)] = o
+                scores[i] = first_lp[int(o[0])]
+                if len(o) > 1:
+                    vec, cache = _jit_ll_suffix(
+                        self.params, cfg, cache, jnp.asarray(row),
+                        jnp.asarray(L, jnp.int32),
+                        jnp.asarray(len(o), jnp.int32), cache_len,
+                    )
+                    # vec[j] = log P(token j+1 | ... token j).
+                    scores[i] += float(
+                        np.asarray(vec, np.float64)[: len(o) - 1].sum()
+                    )
+        return scores
 
     def chat_video(
         self,
