@@ -216,14 +216,17 @@ def evaluate(
                 "images": frames,
                 "is_video": is_video,
             })
-        proxies = [p for _, _, p in group]
-        pad_waste += sum(max(proxies) - p for p in proxies)
         if scoring == "loglikelihood":
             replies: list[str | None] = [None] * len(group)
             open_idx = [
                 i for i, (_, rec, _) in enumerate(group)
                 if not rec.get("options")
             ]
+            # Only the decoded (optionless) rows pay batch padding here;
+            # MCQ rows score per-record with no padded batch at all.
+            open_prox = [group[i][2] for i in open_idx]
+            if open_prox:
+                pad_waste += sum(max(open_prox) - p for p in open_prox)
             if open_idx:  # optionless records still BATCH their decode
                 open_replies = pipe.chat_batch(
                     [requests[i] for i in open_idx],
@@ -240,6 +243,8 @@ def evaluate(
                     )
                     replies[i] = LETTERS[int(scores.argmax())]
         else:
+            proxies = [p for _, _, p in group]
+            pad_waste += sum(max(proxies) - p for p in proxies)
             replies = pipe.chat_batch(
                 requests, max_new_tokens=max_new_tokens
             )

@@ -86,7 +86,10 @@ def _jit_ll_prefill(params, cfg: OryxConfig, embeds, length, cache_len: int):
     return jax.nn.log_softmax(last.astype(jnp.float32)), cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "cache_len"))
+@partial(
+    jax.jit, static_argnames=("cfg", "cache_len"),
+    donate_argnames=("cache",),
+)
 def _jit_ll_suffix(params, cfg: OryxConfig, cache, cont_ids, length, k,
                    cache_len: int):
     """Teacher-force one option's tokens against the prompt cache →
@@ -469,29 +472,12 @@ class OryxInference:
                 )
             lengths = jnp.asarray([L], np.int32)
             start = jnp.asarray(common, jnp.int32)
-        elif images:
-            packed = packing.pack_raw_images(
-                images,
-                patch_size=cfgv.patch_size,
-                base_grid=cfgv.base_grid,
-                side_factors=factors,
-                max_patches=caps,
-            )
-            batch = splice.build_mm_batch([ids], splice.query_slots(packed))
-            arrays = oryx.stage_mm_arrays(packed, batch)
-            with self._mesh_scope():
-                embeds = oryx.mm_embeds(self.params, cfg, arrays)
-            lengths = jnp.asarray(batch.lengths)
-            cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
         else:
-            T = packing.round_up_bucket(len(ids))
-            rows = np.zeros((1, T), np.int32)
-            rows[0, : len(ids)] = ids
             with self._mesh_scope():
-                embeds = self.params["llm"]["embed"]["weight"][
-                    jnp.asarray(rows)
-                ]
-            lengths = jnp.asarray([len(ids)], np.int32)
+                embeds, L = self._prompt_embeds(
+                    cfg, ids, images, factors, caps
+                )
+            lengths = jnp.asarray([L], np.int32)
             cache_len = packing.round_up_bucket(embeds.shape[1] + padded_new)
         eos = cfg.generation.eos_token_id
         stops = ([self.conv.stop_str] if self.conv.stop_str else []) + [
@@ -738,6 +724,27 @@ class OryxInference:
             media_key=media_key,
         )
 
+    def _prompt_embeds(self, cfg, ids, imgs, factors, caps):
+        """One prompt row → (decoder input embeds [1, T_bucket, H], real
+        length). The single owner of the prompt prep policy for the
+        streaming, scoring and prefix-cache paths (call under
+        `_mesh_scope`)."""
+        if imgs:
+            packed = packing.pack_raw_images(
+                imgs, patch_size=cfg.vision.patch_size,
+                base_grid=cfg.vision.base_grid,
+                side_factors=factors, max_patches=caps,
+            )
+            batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+            embeds = oryx.mm_embeds(
+                self.params, cfg, oryx.stage_mm_arrays(packed, batch)
+            )
+            return embeds, int(batch.lengths[0])
+        L = len(ids)
+        rows = np.zeros((1, packing.round_up_bucket(L)), np.int32)
+        rows[0, :L] = ids
+        return self.params["llm"]["embed"]["weight"][jnp.asarray(rows)], L
+
     def score_options(
         self,
         question: str,
@@ -780,26 +787,7 @@ class OryxInference:
         kb = packing.round_up_bucket(max(len(o) for o in opt_ids))
 
         with self._mesh_scope():
-            if imgs:
-                packed = packing.pack_raw_images(
-                    imgs, patch_size=cfg.vision.patch_size,
-                    base_grid=cfg.vision.base_grid,
-                    side_factors=factors, max_patches=caps,
-                )
-                batch = splice.build_mm_batch(
-                    [ids], splice.query_slots(packed)
-                )
-                embeds = oryx.mm_embeds(
-                    self.params, cfg, oryx.stage_mm_arrays(packed, batch)
-                )
-                L = int(batch.lengths[0])
-            else:
-                L = len(ids)
-                rows = np.zeros((1, packing.round_up_bucket(L)), np.int32)
-                rows[0, :L] = ids
-                embeds = self.params["llm"]["embed"]["weight"][
-                    jnp.asarray(rows)
-                ]
+            embeds, L = self._prompt_embeds(cfg, ids, imgs, factors, caps)
             cache_len = packing.round_up_bucket(L + kb)
             first_lp, cache = _jit_ll_prefill(
                 self.params, cfg, embeds, jnp.asarray(L, jnp.int32),
