@@ -637,3 +637,34 @@ def test_prefix_cache_rejects_swapped_images(tiny_model):
     assert r_b_cached == r_b_fresh
     # Sanity: the two images do produce different replies on this model.
     assert r_a == pipe.chat(q, images=[img_a], max_new_tokens=6)
+
+
+def test_sharded_pipe_cached_session_matches_unsharded(tiny_model):
+    """ChatSession's default-on KV prefix cache must also hold on a
+    mesh-sharded serving pipe (GSPMD decode + replicated session cache):
+    replies equal the unsharded uncached reference."""
+    from oryx_tpu.config import MeshConfig
+    from oryx_tpu.parallel.mesh import build_mesh
+    from oryx_tpu.serve.pipeline import ChatSession
+
+    if jax.device_count() < 2:
+        pytest.skip("needs multiple (CPU) devices")
+    cfg, params = tiny_model
+    mesh = build_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    img = np.random.default_rng(5).integers(
+        0, 255, size=(40, 56, 3), dtype=np.uint8
+    )
+    ref = ChatSession(
+        OryxInference(FakeTokenizer(), params, cfg),
+        images=[img], cache=False,
+    )
+    cached = ChatSession(
+        OryxInference(
+            FakeTokenizer(), params, cfg, mesh=mesh, sharding_mode="tp"
+        ),
+        images=[img], cache=True,
+    )
+    for q in ("what is this?", "why?"):
+        assert cached.ask(q, max_new_tokens=4) == ref.ask(
+            q, max_new_tokens=4
+        )
