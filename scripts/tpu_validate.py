@@ -9,9 +9,13 @@ Case shapes match the TPU_VALIDATION.md tables at the default --seq
 (1024 on TPU): causal GQA B2 T<seq> Hq8 Hk2 D128, segment-packed
 B1 T<3*seq/4> H4 D64, KV-cache decode B4 Tq8 S<2*seq>; the timing probe
 runs B4 T<timing-seq=4096> Hq8 Hk2 D128 with on-device reduction sync.
-Prints one JSON line per case and EXITS NONZERO if any diff exceeds its
-tolerance, so a CI smoke run (CPU interpret mode; use small --seq)
-actually fails on kernel regressions.
+Case 4 certifies the END-TO-END decode (prefill kernel + cached decode
+under the early-exit while_loop, and the split-prefill prefix-cache
+path) by greedy token streams: those lines carry `prefix_agreement`
+(mean first-divergence fraction; 1.0 = bitwise) instead of
+`max_abs_diff`. Every line has `"pass"`; the script EXITS NONZERO if
+any case fails, so a CI smoke run (CPU interpret mode; small --seq
+shrinks every case) actually fails on regressions.
 """
 
 from __future__ import annotations
@@ -120,6 +124,94 @@ def parity_cases(args) -> bool:
         xla_attention(q3, k3, v3, **kw),
         fwd_tol,
     )
+
+    # 4. END-TO-END decode certification (round-4 surface): greedy
+    #    generate() — prefill kernel + cached decode under the early-exit
+    #    while_loop — Pallas vs XLA token agreement, plus split-prefill
+    #    (the ChatSession prefix-cache path: prefill a prefix into the
+    #    cache, continue with a suffix at start>0) vs one-shot generate,
+    #    which must agree with itself per impl.
+    from oryx_tpu.config import GenerationConfig, LLMConfig
+    from oryx_tpu.models import generate as generate_lib
+    from oryx_tpu.models import qwen2
+
+    lcfg = LLMConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=8, num_kv_heads=2, head_dim=64,
+        attention_bias=True,
+    )
+    gcfg = GenerationConfig(temperature=0.0, eos_token_id=10**9)
+    lp = qwen2.init_params(lcfg, jax.random.key(3), dtype=jnp.float32)
+    # Scales with --seq so small smoke runs stay small (floor keeps
+    # half > the 5-token length stagger below).
+    Tp = max(T // 8, 16)
+    emb_key = jax.random.key(4)
+    embeds = jax.random.normal(emb_key, (2, Tp, 256), dtype) * 0.2
+    lengths = jnp.asarray([Tp, Tp - 5], jnp.int32)
+    cache_len = 2 * Tp
+
+    def gen(impl, kv_cache=None, start=None, embeds_=None, lengths_=None):
+        toks, num, fin = generate_lib.generate(
+            lp, lcfg, gcfg,
+            inputs_embeds=embeds_ if embeds_ is not None else embeds,
+            lengths=lengths_ if lengths_ is not None else lengths,
+            max_new_tokens=16, cache_len=cache_len,
+            attn_impl=impl, compute_dtype=dtype,
+            kv_cache=kv_cache, start=start,
+        )
+        return np.asarray(toks)
+
+    def record_agreement(name, a, b, min_frac):
+        """Greedy decode is autoregressive: ONE near-tie argmax flip
+        diverges every later token, so raw agreement is misleading.
+        Score the FIRST-DIVERGENCE point instead: mean over rows of
+        (first mismatching step / steps), 1.0 = bitwise identical."""
+        nonlocal ok
+        steps = a.shape[1]
+        fracs = []
+        for ra, rb in zip(a, b):
+            neq = ra != rb
+            fracs.append(
+                (int(np.argmax(neq)) if neq.any() else steps) / steps
+            )
+        frac = float(np.mean(fracs))
+        passed = frac >= min_frac
+        ok = ok and passed
+        print(json.dumps({
+            "case": name, "prefix_agreement": round(frac, 4),
+            "min": min_frac, "pass": passed,
+        }))
+
+    impls = ("pallas", "xla")  # pallas interprets on CPU like cases 1-3
+    toks_by_impl = {i: gen(i) for i in impls}
+    # bf16 kernel-vs-XLA near-ties can flip a greedy argmax mid-stream;
+    # demand the first flip lands in the back half of the window.
+    record_agreement(
+        "generate_pallas_vs_xla",
+        toks_by_impl["pallas"], toks_by_impl["xla"], 0.5,
+    )
+    for impl in impls:
+        # Split prefill: rows share a Tp//2 prefix; continue with the
+        # remaining embeds at start=Tp//2. Same math, different
+        # schedule — tokens must match the one-shot run per impl.
+        half = Tp // 2
+        cache = qwen2.init_kv_cache(lcfg, 2, cache_len, dtype=dtype)
+        _, _, _, cache = generate_lib.generate(
+            lp, lcfg, gcfg, inputs_embeds=embeds[:, :half],
+            lengths=jnp.asarray([half, half], jnp.int32),
+            max_new_tokens=1, cache_len=cache_len, attn_impl=impl,
+            compute_dtype=dtype, kv_cache=cache,
+            start=jnp.asarray(0, jnp.int32), return_cache=True,
+        )
+        split = gen(
+            impl, kv_cache=cache, start=jnp.asarray(half, jnp.int32),
+            embeds_=embeds[:, half:], lengths_=lengths,
+        )
+        # Same math, different fp reduction schedule — a near-tie flip
+        # is legal even off-TPU, so bitwise identity is not demanded.
+        record_agreement(
+            f"split_prefill_{impl}", split, toks_by_impl[impl], 0.75,
+        )
     return ok
 
 
