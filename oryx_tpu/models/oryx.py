@@ -105,12 +105,16 @@ def forward(
     compute_dtype=None,
     logits_dtype=jnp.float32,
     return_hidden: bool = False,
+    text_segment_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Training/prefill forward: visual encode → splice → decoder logits
     (or final hidden states when return_hidden, for the chunked-CE loss).
 
     mesh: only needed for attn_impl='ring' without an ambient mesh
-    (jax.sharding.set_mesh) in scope."""
+    (jax.sharding.set_mesh) in scope.
+    text_segment_ids: decoder-row sample ids for sequence-packed text
+    training (train/data.collate_packed_text) — distinct from the
+    VISUAL buffer's `segment_ids`."""
     vis = encode_visual(
         params, cfg, patches, segment_ids, pos_coords, region_ids,
         q_region_ids, remat=remat, compute_dtype=compute_dtype,
@@ -124,6 +128,7 @@ def forward(
         remat=remat, attn_impl=cfg.attn_impl, mesh=mesh,
         compute_dtype=compute_dtype, logits_dtype=logits_dtype,
         return_hidden=return_hidden,
+        segment_ids=text_segment_ids,
     )
     return out
 

@@ -253,6 +253,7 @@ def forward(
     compute_dtype: jnp.dtype | None = None,
     logits_dtype: jnp.dtype = jnp.float32,
     return_hidden: bool = False,
+    segment_ids: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, Params | None]:
     """Full decoder forward.
 
@@ -271,6 +272,12 @@ def forward(
         attention runs over the whole cache with `kv_mask` [B, S] validity.
       kv_mask: with no cache, [B, T] padding mask; with cache, [B, S] slot
         validity — caller maintains it (see models/generate.py).
+      segment_ids: [B, T] int32 SAMPLE ids for sequence-packed training
+        (0 = pad): attention is causal in SLOT order and masked on
+        segment equality, so samples packed into one row never attend
+        each other, while `positions` (restarting per sample) still
+        drives RoPE. Training-only: incompatible with kv_cache and the
+        ring impls.
 
     Returns (logits [B, T, V] in logits_dtype, updated kv_cache or None).
     """
@@ -298,6 +305,14 @@ def forward(
 
     if kv_cache is not None and write_slots is None:
         write_slots = positions[:, 0]
+
+    if segment_ids is not None and (
+        kv_cache is not None or attn_impl not in ("xla", "pallas")
+    ):
+        raise ValueError(
+            "segment_ids (packed training) requires attn_impl xla|pallas "
+            "and no kv_cache"
+        )
 
     if attn_impl == "pallas":
         from oryx_tpu.ops.pallas import flash_attention as _fa
@@ -328,6 +343,24 @@ def forward(
     else:
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
 
+    # Packed rows: causal order is the SLOT order (within a sample the
+    # two coincide; across samples the segment mask rules) — which also
+    # keeps the Pallas slot_positions DMA clamp valid despite the
+    # restarting RoPE positions.
+    attn_positions = positions
+    if segment_ids is not None:
+        attn_positions = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32), (B, T)
+        )
+        base_attn_fn = attn_fn
+
+        def attn_fn(q, k, v, **kw):  # noqa: F811 - deliberate wrap
+            return base_attn_fn(
+                q, k, v,
+                q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+                **kw,
+            )
+
     def body(carry, xs):
         h = carry
         if kv_cache is not None:
@@ -336,7 +369,7 @@ def forward(
             lp, ck, cv = xs, None, None
         h, ck, cv = _block(
             cfg, h, lp, cos, sin,
-            positions=positions,
+            positions=attn_positions,
             cache_k=ck, cache_v=cv,
             write_slots=write_slots,
             kv_mask=kv_mask,

@@ -65,6 +65,7 @@ def microbatch_loss(
         token_ids=mb["token_ids"], visual_idx=mb["visual_idx"],
         is_visual=mb["is_visual"], attn_mask=mb["attn_mask"],
         positions=mb["positions"],
+        text_segment_ids=mb.get("text_segment_ids"),
         remat=cfg.train.remat_policy if cfg.train.remat else "none",
         compute_dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
             cfg.dtype
