@@ -271,6 +271,7 @@ def collate_packed_text(
     patch_size: int = 14,
     base_grid: int = 27,
     buckets: tuple[int, ...] = packing.DEFAULT_BUCKETS,
+    max_len: int | None = None,
 ) -> dict[str, np.ndarray]:
     """Sequence-PACKED text-only batch: multiple samples share one
     `bucket`-wide row (first-fit-decreasing), separated by
@@ -296,6 +297,10 @@ def collate_packed_text(
     """
     if any(ex.images for ex in examples):
         raise ValueError("collate_packed_text is text-only; use collate")
+    # Same meaning as collate's max_len (the shared **collate_kw set):
+    # a ceiling on the row length.
+    if max_len is not None and bucket > max_len:
+        raise ValueError(f"bucket={bucket} exceeds max_len={max_len}")
     order = sorted(
         range(len(examples)),
         key=lambda i: len(examples[i].input_ids),
@@ -376,6 +381,10 @@ def _pad_to_shape(arr: np.ndarray, shape: tuple[int, ...], fill) -> np.ndarray:
 def collate_microbatches(
     examples: Sequence[Example],
     grad_accum_steps: int,
+    *,
+    packed_text: bool = False,
+    pack_bucket: int | None = None,
+    pack_num_rows: int | None = None,
     **collate_kw,
 ) -> dict[str, np.ndarray]:
     """Collate `grad_accum_steps` microbatches into stacked arrays with a
@@ -385,15 +394,32 @@ def collate_microbatches(
     reference its own packed visual buffer — then all microbatches are
     re-padded to common bucket shapes so they stack. Padding uses id 0 /
     IGNORE_INDEX, which every consumer already treats as padding.
+
+    packed_text routes text-only microbatches through
+    `collate_packed_text` (sequence packing); pass pack_bucket and —
+    for a retrace-free jitted step — pack_num_rows. Packing integrates
+    HERE (the grad-accum collator), not via grouped_batch_iterator's
+    accum==1 shortcut, which calls `collate` directly.
     """
     n = len(examples)
     if n % grad_accum_steps != 0:
         raise ValueError(f"batch of {n} not divisible by {grad_accum_steps}")
     per = n // grad_accum_steps
-    micro = [
-        collate(examples[i * per : (i + 1) * per], **collate_kw)
-        for i in range(grad_accum_steps)
-    ]
+    if packed_text:
+        if pack_bucket is None:
+            raise ValueError("packed_text needs pack_bucket")
+        micro = [
+            collate_packed_text(
+                examples[i * per : (i + 1) * per], bucket=pack_bucket,
+                num_rows=pack_num_rows, **collate_kw,
+            )
+            for i in range(grad_accum_steps)
+        ]
+    else:
+        micro = [
+            collate(examples[i * per : (i + 1) * per], **collate_kw)
+            for i in range(grad_accum_steps)
+        ]
     out: dict[str, np.ndarray] = {}
     for key in micro[0]:
         fill = IGNORE_INDEX if key == "labels" else 0

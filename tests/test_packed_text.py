@@ -172,3 +172,33 @@ def test_num_rows_pins_shape():
         data_lib.collate_packed_text(
             _examples(cfg, lengths=(30, 30, 30)), bucket=32, num_rows=2
         )
+
+
+def test_packed_microbatches_train_step():
+    """Grad-accum path: packed text microbatches stack to the
+    [accum, ...] layout and run the REAL train step."""
+    from oryx_tpu.train.optimizer import make_optimizer
+
+    cfg = cfg_lib.oryx_tiny()
+    exs = _examples(cfg, lengths=(11, 7, 5, 9, 6, 4))
+    host = data_lib.collate_microbatches(
+        exs, 2, packed_text=True, pack_bucket=32, pack_num_rows=2,
+        base_grid=cfg.vision.base_grid,
+    )
+    assert host["token_ids"].shape == (2, 2, 32)
+    assert host["text_segment_ids"].shape == (2, 2, 32)
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, grad_accum_steps=2)
+    )
+    params = oryx.init_params(cfg2, jax.random.key(0))
+    tx = make_optimizer(cfg2.train, params)
+    state = step_lib.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params),
+    )
+    batch = {k: jnp.asarray(v) for k, v in host.items()}
+    state, metrics = step_lib.train_step(state, batch, cfg2, tx)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(metrics["num_tokens"]) == sum(
+        len(e.labels) - len(e.labels) // 2 for e in exs
+    )
