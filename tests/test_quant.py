@@ -184,3 +184,34 @@ def test_stacked_getitem_uses_matching_scales():
         np.testing.assert_allclose(
             np.asarray(q3[i]), full[i], rtol=1e-6
         )
+
+
+def test_quantized_prefix_cache_session(tiny_quantized):
+    """Feature interplay: the ChatSession KV prefix cache over int8
+    weights (Q8Weight embedding gathers feed the suffix prefill) must
+    match the uncached quantized pipe turn for turn."""
+    from oryx_tpu.serve.pipeline import ChatSession
+
+    _, pipe_q8 = tiny_quantized
+    plain = ChatSession(pipe_q8, cache=False)
+    cached = ChatSession(pipe_q8, cache=True)
+    for q in ("hello there", "and then?"):
+        a = plain.ask(q, max_new_tokens=5)
+        b = cached.ask(q, max_new_tokens=5)
+        assert a == b, (q, a, b)
+    assert cached._cache_state.cache is not None
+
+
+def test_quantized_loglikelihood_scoring(tiny_quantized):
+    """Feature interplay: score_options over int8 weights — finite
+    scores whose ranking tracks the float model's closely enough to
+    pick the same argmax on a well-separated case."""
+    pipe_fp, pipe_q8 = tiny_quantized
+    opts = ["A", "B", "C", "D"]
+    s_fp = pipe_fp.score_options("pick one", opts)
+    s_q8 = pipe_q8.score_options("pick one", opts)
+    assert np.isfinite(s_q8).all()
+    # int8 perturbs each option's log-prob by at most a small absolute
+    # delta, and the pick itself must not flip on this case.
+    assert np.abs(s_q8 - s_fp).max() < 0.5, (s_fp, s_q8)
+    assert int(np.argmax(s_q8)) == int(np.argmax(s_fp)), (s_fp, s_q8)
