@@ -471,17 +471,26 @@ def _supervise() -> None:
             rc, out, err = _run_bench_child()
             line = _find_json_line(out)
             if rc == 0 and line:
-                # Pass the child's stdout through (latency notes etc.),
-                # then re-print the JSON line so it is LAST on stdout.
-                body = "\n".join(
+                # Pass the child's stdout through (latency notes etc.) and
+                # its stderr phase markers (wall-clock per phase — the only
+                # record of where slow-tunnel time went), then re-print the
+                # JSON line so it is LAST on stdout.
+                phases = [
+                    ln for ln in err.splitlines() if ln.startswith("# [")
+                ]
+                body = "\n".join(phases + [
                     ln for ln in out.strip().splitlines() if ln.strip() != line
-                )
+                ])
                 if body:
                     print(body)
                 print(line)
                 return
             both = out + err
-            last = "\n".join(both.strip().splitlines()[-15:])
+            # Keep the phase markers in the post-mortem even when the
+            # interesting tail is 15 lines of XLA warnings — they are the
+            # whole point on a killed/hung child.
+            phases = [ln for ln in both.splitlines() if ln.startswith("# [")]
+            last = "\n".join(phases + both.strip().splitlines()[-15:])
             infra = rc is None or any(m in both for m in _TUNNEL_ERR_MARKERS)
             if not infra:
                 _emit_error("bench_failed", last, attempt)
@@ -493,6 +502,15 @@ def _supervise() -> None:
     _emit_error("tpu_unavailable", last, PROBE_ATTEMPTS)
 
 
+def _phase(msg: str) -> None:
+    """Timestamped phase marker on stderr. The supervisor captures child
+    stderr (including the partial read when it kills on timeout), so these
+    tell a post-mortem *where* a slow-tunnel run was stuck — a 33-minute
+    silent hang with 14 s of CPU is indistinguishable from a livelock
+    without them."""
+    print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -501,6 +519,7 @@ def main() -> None:
     from oryx_tpu.train import step as step_lib
     from oryx_tpu.train.optimizer import make_optimizer
 
+    _phase("backend init")
     backend = jax.default_backend()
     n_chips = jax.device_count()
     chip, hbm, peak = chip_info(jax)
@@ -509,6 +528,7 @@ def main() -> None:
     host = _make_batch(cfg, batch_size, seq_bucket, img_side)
     batch = {k: jnp.asarray(v)[None] for k, v in host.items()}  # accum=1
 
+    _phase(f"init params ({geo_name})")
     params = oryx.init_params(cfg, jax.random.key(0))
     tx = make_optimizer(cfg.train, params)
     state = step_lib.TrainState(
@@ -518,10 +538,12 @@ def main() -> None:
     # NOTE: sync via device_get, not block_until_ready — the latter is a
     # no-op over the remote-chip (axon) transport and fakes the timing.
     tokens_per_step = int(np.sum(host["attn_mask"]))
+    _phase("train_step compile + warmup")
     for _ in range(WARMUP_STEPS):
         state, metrics = step_lib.train_step(state, batch, cfg, tx)
     float(jax.device_get(metrics["loss"]))
 
+    _phase("train_step timed loop")
     t0 = time.perf_counter()
     for _ in range(TIMED_STEPS):
         state, metrics = step_lib.train_step(state, batch, cfg, tx)
@@ -542,6 +564,7 @@ def main() -> None:
     if not os.environ.get("BENCH_NO_LATENCY"):
         try:
             # Fresh params: the originals were donated into train_step.
+            _phase("latency: 64-frame video-QA")
             params = oryx.init_params(cfg, jax.random.key(0))
             lat64 = bench_video_latency(params, cfg, 64)
         except Exception as e:  # keep the primary metric even if this fails
@@ -554,6 +577,7 @@ def main() -> None:
         ) == "1"
         if want256 and lat64 is not None:
             try:
+                _phase("latency: 256-frame video-QA (north star)")
                 lat256 = bench_video_latency(params, cfg, 256)
             except Exception as e:  # OOM here is itself a finding
                 print(f"# 256-frame latency bench failed: {e!r}")
@@ -570,6 +594,7 @@ def main() -> None:
         try:
             from oryx_tpu.utils.quant import quantize_params
 
+            _phase("latency: 64-frame video-QA, int8 weights")
             params = quantize_params(params)
             lat64_q8 = bench_video_latency(params, cfg, 64)
         except Exception as e:  # attempted-and-failed must be auditable
