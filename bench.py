@@ -485,12 +485,14 @@ def _supervise() -> None:
                     print(body)
                 print(line)
                 return
-            both = out + err
+            both = out + "\n" + err
             # Keep the phase markers in the post-mortem even when the
             # interesting tail is 15 lines of XLA warnings — they are the
-            # whole point on a killed/hung child.
+            # whole point on a killed/hung child. Budget both pieces so
+            # _emit_error's detail[-2000:] can never slice the phases off.
             phases = [ln for ln in both.splitlines() if ln.startswith("# [")]
-            last = "\n".join(phases + both.strip().splitlines()[-15:])
+            tail = "\n".join(both.strip().splitlines()[-15:])[-1400:]
+            last = "\n".join(phases)[:500] + ("\n" if phases else "") + tail
             infra = rc is None or any(m in both for m in _TUNNEL_ERR_MARKERS)
             if not infra:
                 _emit_error("bench_failed", last, attempt)
