@@ -492,7 +492,7 @@ def _supervise() -> None:
             # _emit_error's detail[-2000:] can never slice the phases off.
             phases = [ln for ln in both.splitlines() if ln.startswith("# [")]
             tail = "\n".join(both.strip().splitlines()[-15:])[-1400:]
-            last = "\n".join(phases)[:500] + ("\n" if phases else "") + tail
+            last = "\n".join(phases)[-500:] + ("\n" if phases else "") + tail
             infra = rc is None or any(m in both for m in _TUNNEL_ERR_MARKERS)
             if not infra:
                 _emit_error("bench_failed", last, attempt)

@@ -41,7 +41,17 @@ SWEEPS = {
 
 
 def run_one(extra_env: dict[str, str], timeout: int) -> dict | None:
-    env = {**os.environ, "BENCH_NO_LATENCY": "1", **extra_env}
+    # One probe attempt and a child budget inside our own timeout: the
+    # supervisor's full 3x5-min retry ladder would eat the per-config
+    # window before the bench ever ran. A flap costs one config, and the
+    # next config probes again anyway.
+    env = {
+        **os.environ,
+        "BENCH_NO_LATENCY": "1",
+        "BENCH_PROBE_ATTEMPTS": "1",
+        "BENCH_TIMEOUT_S": str(max(60, timeout - 150)),
+        **extra_env,
+    }
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],

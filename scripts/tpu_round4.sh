@@ -19,11 +19,19 @@ mkdir -p "$OUT" || exit 1
 # present and empty; an unreadable environ or an unset/nonempty var
 # counts as a possible claimer (the box default exports it nonempty).
 claimers=()
-for pid in $(ps -eo pid,comm --no-headers | awk '$2 ~ /^python/{print $1}'); do
+for dir in /proc/[0-9]*; do
+  pid=${dir#/proc/}
   [ "$pid" = "$$" ] && continue
-  if ! tr '\0' '\n' </proc/"$pid"/environ 2>/dev/null \
-      | grep -qx 'PALLAS_AXON_POOL_IPS='; then
-    claimers+=("$pid")
+  # Match on the interpreter binary, not comm: a `pytest`/`ipython`
+  # entry point is still a python process that can dial the chip.
+  case "$(readlink "$dir/exe" 2>/dev/null)" in
+    *python*) ;;
+    *) continue ;;
+  esac
+  if ! { tr '\0' '\n' <"$dir/environ" \
+      | grep -qx 'PALLAS_AXON_POOL_IPS='; } 2>/dev/null; then
+    # Exited between scan and read → cannot hold a claim; else flag.
+    [ -e "$dir" ] && claimers+=("$pid")
   fi
 done
 if [ "${#claimers[@]}" -gt 0 ]; then
@@ -33,23 +41,28 @@ fi
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
 
 fail=0
-step() {  # step <name> <cmd...>
-  local name=$1; shift
+step() {  # step <name> <timeout_s> <cmd...> — timeout: a hung tunnel must
+  # cost one step, not the agenda (bench.py self-supervises, the rest
+  # would block on a dead RPC forever).
+  local name=$1 tmo=$2; shift 2
   echo "== $name =="
-  if ! "$@" 2>"$OUT/$name.err" | tee "$OUT/$name.out"; then
+  if ! timeout --kill-after=30 "$tmo" "$@" \
+      2>"$OUT/$name.err" | tee "$OUT/$name.out"; then
     echo "== $name FAILED (continuing; see $OUT/$name.err) ==" >&2
     fail=1
   fi
 }
 
-step bench_default python bench.py
-step sweep_loss_chunk env BENCH_NO_LATENCY=1 \
-  python scripts/bench_sweep.py loss_chunk
-step sweep_fwd_blocks env BENCH_NO_LATENCY=1 \
-  python scripts/bench_sweep.py fwd_blocks
-step sweep_remat env BENCH_NO_LATENCY=1 python scripts/bench_sweep.py remat
-step smoke_eval python scripts/make_smoke_eval.py --out /tmp/smoke_tpu --run \
-  --result "$OUT/smoke_result_tpu.json"
+# 12600 > the supervisor's worst-case ladder (3 probes + 2 backoffs + up
+# to 3 children at BENCH_TIMEOUT_S) so the outer kill can never preempt
+# the structured {"error": ...} line.
+step bench_default 12600 python bench.py
+step tpu_validate 3600 python scripts/tpu_validate.py
+step sweep_loss_chunk 3600 python scripts/bench_sweep.py loss_chunk
+step sweep_fwd_blocks 3600 python scripts/bench_sweep.py fwd_blocks
+step sweep_remat 3600 python scripts/bench_sweep.py remat
+step smoke_eval 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \
+  --run --result "$OUT/smoke_result_tpu.json"
 
 echo "== done; results in $OUT (fail=$fail) =="
 exit "$fail"
