@@ -83,6 +83,11 @@ def main() -> None:
     if results:
         best = max(results, key=lambda r: r.get("value", 0.0))
         print(json.dumps({"best": best["config"], "value": best["value"]}))
+    if len(results) < len(SWEEPS[which]):
+        # Nonzero exit when any config failed so a retrying caller
+        # (tunnel_watch -> tpu_round4 step .ok markers) re-runs the sweep
+        # rather than banking a partial grid as done.
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

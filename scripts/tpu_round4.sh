@@ -22,14 +22,19 @@ claimers=()
 for dir in /proc/[0-9]*; do
   pid=${dir#/proc/}
   [ "$pid" = "$$" ] && continue
-  # Match on the interpreter binary, not comm: a `pytest`/`ipython`
-  # entry point is still a python process that can dial the chip.
-  case "$(readlink "$dir/exe" 2>/dev/null)" in
-    *python*) ;;
+  # Interpreter detection by either signal: /proc/<pid>/exe catches
+  # `pytest`/`ipython` entry points (comm says otherwise), comm covers
+  # processes whose exe link is unreadable (other-user EACCES).
+  comm=$(cat "$dir/comm" 2>/dev/null)
+  exe=$(readlink "$dir/exe" 2>/dev/null) || exe=""
+  case "$comm:$exe" in
+    *python*|*pytest*|*ipython*) ;;
     *) continue ;;
   esac
-  if ! { tr '\0' '\n' <"$dir/environ" \
-      | grep -qx 'PALLAS_AXON_POOL_IPS='; } 2>/dev/null; then
+  # Read the whole environ first (a pipe into grep -q can SIGPIPE tr
+  # under pipefail); unreadable → empty → no positive evidence → flag.
+  envtxt=$(tr '\0' '\n' <"$dir/environ" 2>/dev/null) || envtxt=""
+  if ! grep -qx 'PALLAS_AXON_POOL_IPS=' <<<"$envtxt"; then
     # Exited between scan and read → cannot hold a claim; else flag.
     [ -e "$dir" ] && claimers+=("$pid")
   fi
@@ -43,11 +48,19 @@ export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
 fail=0
 step() {  # step <name> <timeout_s> <cmd...> — timeout: a hung tunnel must
   # cost one step, not the agenda (bench.py self-supervises, the rest
-  # would block on a dead RPC forever).
+  # would block on a dead RPC forever). A step that already succeeded in
+  # a previous run against the same OUT dir is skipped, so a watcher
+  # retry after a mid-agenda tunnel death only repeats the missing steps.
   local name=$1 tmo=$2; shift 2
+  if [ -e "$OUT/$name.ok" ]; then
+    echo "== $name already ok; skipping =="
+    return 0
+  fi
   echo "== $name =="
-  if ! timeout --kill-after=30 "$tmo" "$@" \
+  if timeout --kill-after=30 "$tmo" "$@" \
       2>"$OUT/$name.err" | tee "$OUT/$name.out"; then
+    : >"$OUT/$name.ok"
+  else
     echo "== $name FAILED (continuing; see $OUT/$name.err) ==" >&2
     fail=1
   fi

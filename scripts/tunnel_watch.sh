@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Watch the axon tunnel and run the on-chip agenda the moment it is up.
+#
+#   scripts/tunnel_watch.sh [OUT_DIR] [DEADLINE_HOURS]
+#
+# Probes the default backend in a short-lived subprocess every ~9 min;
+# on a green probe, runs scripts/tpu_round4.sh "$OUT_DIR". Keeps
+# retrying (the tunnel can die mid-agenda; tpu_round4.sh is itself
+# hang-proof and continue-on-failure) until the agenda exits 0 or the
+# deadline passes. Designed to be left running in the background for
+# hours — the tunnel's outages are long and its recoveries unannounced.
+set -uo pipefail
+cd "$(dirname "$0")/.." || exit 1
+OUT=${1:-/tmp/r4_onchip}
+DEADLINE_H=${2:-10}
+PROBE='import jax, jax.numpy as jnp; v = float(jax.device_get(jnp.sum(jnp.ones((256, 256), jnp.float32)))); assert v == 65536.0, v; print("PROBE_OK", jax.default_backend(), flush=True)'
+end=$(( $(date +%s) + DEADLINE_H * 3600 ))
+try=0
+while [ "$(date +%s)" -lt "$end" ]; do
+  try=$((try + 1))
+  if timeout --kill-after=15 120 python -c "$PROBE" >/dev/null 2>&1; then
+    echo "[$(date -u +%H:%M:%S)] probe $try ok — running agenda" >&2
+    if bash scripts/tpu_round4.sh "$OUT"; then
+      echo "[$(date -u +%H:%M:%S)] agenda complete" >&2
+      exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] agenda incomplete (rc!=0); will retry" >&2
+  else
+    echo "[$(date -u +%H:%M:%S)] probe $try failed (tunnel down)" >&2
+  fi
+  sleep 540
+done
+echo "deadline reached without a complete agenda" >&2
+exit 1
