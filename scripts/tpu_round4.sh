@@ -31,6 +31,11 @@ for dir in /proc/[0-9]*; do
     *python*|*pytest*|*ipython*) ;;
     *) continue ;;
   esac
+  # The axon relay (/root/.relay.py) IS the tunnel — it runs with a
+  # nonempty pool IP by design and must be up for any probe to succeed;
+  # it is infrastructure, not a competing workload.
+  cmdline=$(tr '\0' ' ' <"$dir/cmdline" 2>/dev/null) || cmdline=""
+  case " $cmdline" in *" /root/.relay.py "*) continue ;; esac
   # Read the whole environ first (a pipe into grep -q can SIGPIPE tr
   # under pipefail); unreadable → empty → no positive evidence → flag.
   envtxt=$(tr '\0' '\n' <"$dir/environ" 2>/dev/null) || envtxt=""
