@@ -184,28 +184,6 @@ class OryxConfig:
     # "xla" (portable, CPU-testable) or "pallas" (TPU kernels).
     attn_impl: str = "xla"
 
-    def __post_init__(self):
-        if (
-            self.train.remat
-            and self.train.remat_policy == "attn"
-            and self.attn_impl != "pallas"
-        ):
-            # The "attn" policy saves residuals by checkpoint NAME
-            # (flash_out/flash_lse) which only the Pallas kernel's vjp
-            # emits; on xla/ring attention it silently degrades to plain
-            # block remat. Warn rather than raise: CPU tests deliberately
-            # run TPU-tuned configs with attn_impl="xla". ("attn_qkv"
-            # still saves the q/k/v tags on any impl, so no warning.)
-            import warnings
-
-            warnings.warn(
-                f"remat_policy='attn' saves nothing with "
-                f"attn_impl={self.attn_impl!r} (the flash_out/flash_lse "
-                f"checkpoint names exist only in the Pallas kernel); "
-                f"behaves as remat_policy='block'",
-                stacklevel=2,
-            )
-
     # ---- (de)serialization -------------------------------------------------
 
     def to_dict(self) -> dict[str, Any]:

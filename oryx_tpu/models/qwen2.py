@@ -314,6 +314,12 @@ def forward(
             "and no kv_cache"
         )
 
+    # NOTE for new attn impls: every branch's implementation must tag its
+    # output `checkpoint_name(out, "flash_out")` (plus "flash_lse" where a
+    # logsumexp residual exists) or the "attn"/"attn_qkv" remat policies
+    # (utils/remat.py) silently degrade to full block recompute for it.
+    # Tagged per-impl rather than here so the custom-VJP kernels save the
+    # exact residuals their backward needs without double-tagging.
     if attn_impl == "pallas":
         from oryx_tpu.ops.pallas import flash_attention as _fa
 

@@ -35,6 +35,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
@@ -141,10 +142,17 @@ def attention(
     while Tq % chunk:
         chunk //= 2
     if chunk >= Tq:
-        return _attention_dense(
+        out = _attention_dense(
             q, k, v, q_positions=q_positions,
             q_segment_ids=q_segment_ids, **kwargs,
         )
+        # Same tag the Pallas kernel gives its output, so the "attn"/
+        # "attn_qkv" remat policies (utils/remat.py) save the attention
+        # output on this path too. There is no explicit logsumexp here, so
+        # dq/dk/dv still recompute the softmax internals under remat; the
+        # saved output cuts the recompute tree for everything downstream
+        # (o_proj and the MLP backward).
+        return checkpoint_name(out, "flash_out")
 
     nc = Tq // chunk
 
@@ -171,4 +179,5 @@ def attention(
     outs = jax.lax.map(
         body, (split_q(q), split_q(q_positions), split_q(q_segment_ids))
     )  # [nc, B, chunk, Hq, D]
-    return jnp.moveaxis(outs, 0, 1).reshape(B, Tq, Hq, D)
+    out = jnp.moveaxis(outs, 0, 1).reshape(B, Tq, Hq, D)
+    return checkpoint_name(out, "flash_out")

@@ -21,6 +21,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
@@ -119,7 +120,10 @@ def ring_attention_shard(
     )
     out = acc / jnp.where(l == 0.0, 1.0, l)
     out = jnp.moveaxis(out, 3, 1).reshape(B, Tl, Hq, D)  # [B,Tl,Hk,G,D]
-    return out.astype(q.dtype)
+    # Tag for the "attn"/"attn_qkv" remat policies (utils/remat.py): the
+    # saved output spares the backward a full second ring pass for the
+    # downstream (o_proj/MLP) gradients.
+    return checkpoint_name(out.astype(q.dtype), "flash_out")
 
 
 def _ring_shard_flash(
@@ -194,7 +198,12 @@ def _ring_flash_forward(
     out, lse, *_ = jax.lax.fori_loop(
         0, n, body, (out, lse, k, v, kv_pos, kv_valid)
     )
-    return out.astype(q.dtype), lse
+    # Same tags as the Pallas kernel: with remat_policy="attn"/"attn_qkv"
+    # these are saved, so the checkpointed backward reuses the ring
+    # backward's residuals instead of re-running the forward ring pass.
+    out = checkpoint_name(out.astype(q.dtype), "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, lse
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))

@@ -16,11 +16,18 @@ Policies:
   * "dots" — checkpoint with `checkpoint_dots`: matmul outputs are saved,
     elementwise ops recomputed. ~the activation memory of "none" minus
     fusion temporaries, but the backward skips all MXU recompute.
-  * "attn" — save only the flash-attention kernel outputs + logsumexp
-    (named "flash_out"/"flash_lse" in ops/pallas/flash_attention._fwd):
-    a thin slice of "dots" costing ~2 bytes/token/layer/head-dim that
-    spares the backward from re-running the forward attention kernel —
-    the most expensive single op in a block recompute.
+  * "attn" — save only the flash-attention outputs + logsumexp
+    (named "flash_out"/"flash_lse"): a thin slice of "dots" costing
+    ~2 bytes/token/layer/head-dim that spares the backward from
+    re-running the forward attention — the most expensive single op in a
+    block recompute. Every attn_impl carries the tags: the Pallas kernel
+    (ops/pallas/flash_attention._fwd) and the flash-inner ring
+    (ops/ring_attention._ring_flash_forward) save out+lse so their
+    custom-VJP backward needs no forward re-run; the XLA path
+    (ops/attention.attention) and the plain ring shard name only
+    "flash_out" (no explicit lse exists there), which still cuts the
+    recompute tree for the o_proj/MLP backward while dq/dk/dv recompute
+    softmax internals.
   * "attn_qkv" — "attn" plus the post-rope q/k/v projections (named
     "attn_q"/"attn_k"/"attn_v" in models/qwen2._block): the backward
     additionally skips the three projection matmuls and the rope —

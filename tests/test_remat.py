@@ -27,13 +27,21 @@ def _loss_and_grads(cfg, params, host_batch):
     return float(loss), grads
 
 
-@pytest.mark.parametrize("policy", ["none", "dots", "attn", "attn_qkv"])
-def test_remat_policies_match_block(policy):
-    base = cfg_lib.oryx_tiny()
-    if policy.startswith("attn"):
-        # The flash saved names exist only in the Pallas kernel's vjp
-        # (interpret mode on CPU); compare block-vs-attn on that path.
-        base = dataclasses.replace(base, attn_impl="pallas")
+@pytest.mark.parametrize(
+    "policy,impl",
+    [
+        ("none", "xla"),
+        ("dots", "xla"),
+        ("attn", "pallas"),
+        ("attn_qkv", "pallas"),
+        # The xla path names only "flash_out" (no explicit lse); the
+        # policies must still be value-preserving there.
+        ("attn", "xla"),
+        ("attn_qkv", "xla"),
+    ],
+)
+def test_remat_policies_match_block(policy, impl):
+    base = dataclasses.replace(cfg_lib.oryx_tiny(), attn_impl=impl)
     params = oryx.init_params(base, jax.random.key(0))
     host = _batch(base)
 
@@ -64,3 +72,33 @@ def test_remat_policies_match_block(policy):
 def test_unknown_remat_policy_raises():
     with pytest.raises(ValueError, match="unknown remat policy"):
         wrap_remat(lambda c, x: (c, None), "everything")
+
+
+def test_attn_policy_saves_flash_out_on_xla_path():
+    """ADVICE r3: remat_policy='attn' used to be a silent no-op with
+    attn_impl='xla'. The XLA attention output now carries the
+    'flash_out' tag, so the policy must actually save it."""
+    import contextlib
+    import io
+
+    from jax.ad_checkpoint import print_saved_residuals
+
+    from oryx_tpu.ops.attention import attention
+
+    def body(q, kv):
+        out = attention(q, kv, kv, causal=True)
+        return (out.astype(jax.numpy.float32) ** 2).sum()
+
+    q = jax.numpy.ones((1, 8, 4, 8), jax.numpy.float32)
+    kv = jax.numpy.ones((1, 8, 2, 8), jax.numpy.float32)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        print_saved_residuals(wrap_remat(body, "attn"), q, kv)
+    # jax 0.9 reports residuals by producing op/source, not tag name: the
+    # saved set must be exactly the two arguments plus the value tagged at
+    # the `checkpoint_name(out, "flash_out")` line in ops/attention.py —
+    # nothing else (softmax internals stay recomputed).
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 3, lines
+    saved = [l for l in lines if "from the argument" not in l]
+    assert len(saved) == 1 and "ops/attention.py" in saved[0], lines
