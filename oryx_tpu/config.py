@@ -121,10 +121,13 @@ class TrainConfig:
     remat_policy: str = "block"
 
     def __post_init__(self):
-        if self.remat_policy not in ("block", "dots", "attn", "attn_qkv"):
+        from oryx_tpu.utils.remat import POLICIES
+
+        allowed = tuple(p for p in POLICIES if p != "none")
+        if self.remat_policy not in allowed:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: use "
-                "block|dots|attn|attn_qkv (disable checkpointing with "
+                f"{'|'.join(allowed)} (disable checkpointing with "
                 "remat=False, not a policy)"
             )
     # Sequence-chunk size for the memory-efficient CE loss (0 = dense

@@ -228,7 +228,10 @@ def _block(
             slot_positions=True,
         )
     attn_out = attn_out.reshape(B, T, -1)
-    h = h + _linear(attn_out, lp["o_proj"])
+    # "attn_o" tag: with remat_policy="attn_o" the residual-stream value
+    # h_mid = h + o_out is rebuilt from this saved projection, so the
+    # backward recomputes neither the attention nor o_proj.
+    h = h + checkpoint_name(_linear(attn_out, lp["o_proj"]), "attn_o")
 
     x = rms_norm(h, lp["post_attn_norm"]["weight"], cfg.rms_norm_eps)
     gate = jax.nn.silu(_linear(x, lp["gate_proj"]))
@@ -316,8 +319,9 @@ def forward(
 
     # NOTE for new attn impls: every branch's implementation must tag its
     # output `checkpoint_name(out, "flash_out")` (plus "flash_lse" where a
-    # logsumexp residual exists) or the "attn"/"attn_qkv" remat policies
-    # (utils/remat.py) silently degrade to full block recompute for it.
+    # logsumexp residual exists) or the "attn"/"attn_qkv"/"attn_o" remat
+    # policies (utils/remat.py) silently degrade for it — the attention
+    # forward gets recomputed in the backward despite the policy.
     # Tagged per-impl rather than here so the custom-VJP kernels save the
     # exact residuals their backward needs without double-tagging.
     if attn_impl == "pallas":

@@ -32,17 +32,27 @@ Policies:
     "attn_q"/"attn_k"/"attn_v" in models/qwen2._block): the backward
     additionally skips the three projection matmuls and the rope —
     ~3 bytes/token/layer/(q+2kv head-dim) more HBM than "attn".
+  * "attn_o" — "attn_qkv" plus the o_proj output (named "attn_o" in
+    models/qwen2._block): the mid-block residual h + o_out is rebuilt
+    from the saved projection, so the only matmuls the backward
+    recomputes are gate/up (down's input) — the rest of the recompute
+    tree is two RMS norms and a silu (VPU work). Costs ~2 more
+    bytes/token/layer/hidden over "attn_qkv"; the best FLOPs/memory
+    point wherever it fits.
 """
 
 from __future__ import annotations
 
 import jax
 
-POLICIES = ("none", "block", "dots", "attn", "attn_qkv")
+POLICIES = ("none", "block", "dots", "attn", "attn_qkv", "attn_o")
 
 _SAVED_NAMES = {
     "attn": ("flash_out", "flash_lse"),
     "attn_qkv": ("flash_out", "flash_lse", "attn_q", "attn_k", "attn_v"),
+    "attn_o": (
+        "flash_out", "flash_lse", "attn_q", "attn_k", "attn_v", "attn_o",
+    ),
 }
 
 

@@ -24,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SWEEPS = {
     "remat": [
         {"BENCH_REMAT_POLICY": p}
-        for p in ("none", "block", "attn", "attn_qkv")
+        for p in ("none", "block", "attn", "attn_qkv", "attn_o")
     ],
     "loss_chunk": [{"BENCH_LOSS_CHUNK": str(c)} for c in (64, 128, 256, 512)],
     "bwd_blocks": [

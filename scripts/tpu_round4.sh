@@ -6,7 +6,10 @@
 # tunnel surfaces as the bench supervisor's structured error, not a
 # hang. A failed step does NOT abort the agenda — the tunnel flaps for
 # hours at a time, and whichever steps do land are the deliverable.
-# Results land under $1 (default /tmp/r4_onchip).
+# Exception: a failed pre-step tunnel probe DOES abort early (every
+# remaining step would just burn its timeout on the dead RPC); the
+# watcher (tunnel_watch.sh) retries the agenda and .ok markers skip the
+# steps that already landed. Results land under $1 (default /tmp/r4_onchip).
 set -uo pipefail
 cd "$(dirname "$0")/.." || exit 1
 OUT=${1:-/tmp/r4_onchip}
@@ -51,6 +54,14 @@ fi
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
 
 fail=0
+PROBE='import jax, jax.numpy as jnp; v = float(jax.device_get(jnp.sum(jnp.ones((256, 256), jnp.float32)))); assert v == 65536.0, v'
+probe() {  # cheap tunnel check between steps: a dead tunnel must cost one
+  # 2-min probe, not each remaining step's full timeout (the non-bench
+  # steps have no supervisor; they hang on a dead RPC until killed).
+  # stderr is kept so a persistent NON-tunnel failure (broken env,
+  # import error) is diagnosable instead of reading as an eternal flap.
+  timeout --kill-after=15 120 python -c "$PROBE" >/dev/null 2>"$OUT/probe.err"
+}
 step() {  # step <name> <timeout_s> <cmd...> — timeout: a hung tunnel must
   # cost one step, not the agenda (bench.py self-supervises, the rest
   # would block on a dead RPC forever). A step that already succeeded in
@@ -60,6 +71,12 @@ step() {  # step <name> <timeout_s> <cmd...> — timeout: a hung tunnel must
   if [ -e "$OUT/$name.ok" ]; then
     echo "== $name already ok; skipping =="
     return 0
+  fi
+  if ! probe; then
+    echo "== $name: tunnel probe failed; aborting agenda (watcher retries) ==" >&2
+    fail=1
+    echo "== done early; results in $OUT (fail=$fail) =="
+    exit "$fail"
   fi
   echo "== $name =="
   if timeout --kill-after=30 "$tmo" "$@" \

@@ -34,10 +34,12 @@ def _loss_and_grads(cfg, params, host_batch):
         ("dots", "xla"),
         ("attn", "pallas"),
         ("attn_qkv", "pallas"),
+        ("attn_o", "pallas"),
         # The xla path names only "flash_out" (no explicit lse); the
         # policies must still be value-preserving there.
         ("attn", "xla"),
         ("attn_qkv", "xla"),
+        ("attn_o", "xla"),
     ],
 )
 def test_remat_policies_match_block(policy, impl):
