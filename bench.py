@@ -216,7 +216,8 @@ def _bench_cfg(backend: str, hbm_bytes: int):
         attn_impl="pallas" if backend == "tpu" else "xla",
     )
     # Remat policy (utils/remat.py), BENCH_REMAT_POLICY = none|block|
-    # dots|attn. TPU default "attn": saving the flash outputs + lse
+    # dots|attn|attn_qkv|attn_o. TPU default "attn": saving the flash
+    # outputs + lse
     # (~0.7 GB at this geometry) skips the kernel recompute in the
     # backward — measured +4% step time over "block" on v5e, while
     # "dots" exceeds HBM by ~5 GB (TPU_VALIDATION.md).
@@ -232,6 +233,8 @@ def _bench_cfg(backend: str, hbm_bytes: int):
         )
     if chunk:
         train_updates.update(loss_chunk=int(chunk))
+    if os.environ.get("BENCH_MOMENT_DTYPE"):  # float32|bfloat16
+        train_updates.update(moment_dtype=os.environ["BENCH_MOMENT_DTYPE"])
     if train_updates:
         import dataclasses
 

@@ -100,6 +100,12 @@ class TrainConfig:
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
     max_grad_norm: float = 1.0
+    # Dtype for Adam's first moment ("float32" | "bfloat16"). bf16 halves
+    # the m buffer (~1.4 GB at the 0.7B bench geometry) at negligible
+    # quality cost — the variance buffer stays fp32 because its tiny
+    # squared gradients need mantissa precision near eps, which bf16's
+    # 7-bit mantissa can't represent.
+    moment_dtype: str = "float32"
     global_batch_size: int = 128
     grad_accum_steps: int = 1
     num_train_steps: int = 1000
@@ -129,6 +135,10 @@ class TrainConfig:
                 f"remat_policy={self.remat_policy!r}: use "
                 f"{'|'.join(allowed)} (disable checkpointing with "
                 "remat=False, not a policy)"
+            )
+        if self.moment_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"moment_dtype={self.moment_dtype!r}: use float32|bfloat16"
             )
     # Sequence-chunk size for the memory-efficient CE loss (0 = dense
     # [B, T, V] logits). At 152k vocab the dense path needs ~10 GB fp32

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 
 from oryx_tpu.config import VisionConfig
 from oryx_tpu.ops.attention import attention
+from jax.ad_checkpoint import checkpoint_name
+
 from oryx_tpu.ops.norms import layer_norm
 from oryx_tpu.parallel.sharding import constrain
 from oryx_tpu.utils.remat import wrap_remat
@@ -163,8 +165,15 @@ def forward(
         q = _linear(x, lp["q_proj"]).reshape(B, P, cfg.num_heads, cfg.head_dim)
         k = _linear(x, lp["k_proj"]).reshape(B, P, cfg.num_heads, cfg.head_dim)
         v = _linear(x, lp["v_proj"]).reshape(B, P, cfg.num_heads, cfg.head_dim)
+        # Same remat tags as the decoder block (models/qwen2._block) so the
+        # "attn_qkv"/"attn_o" policies skip the encoder's projection and
+        # attention recompute too; the attention output itself is tagged
+        # "flash_out" inside attn_fn's implementation.
+        q = checkpoint_name(q, "attn_q")
+        k = checkpoint_name(k, "attn_k")
+        v = checkpoint_name(v, "attn_v")
         o = attn_fn(q, k, v).reshape(B, P, -1)
-        h = h + _linear(o, lp["o_proj"])
+        h = h + checkpoint_name(_linear(o, lp["o_proj"]), "attn_o")
         x = layer_norm(
             h, lp["norm2"]["weight"], lp["norm2"]["bias"], cfg.layer_norm_eps
         )

@@ -102,7 +102,8 @@ def make_optimizer(
         return optax.chain(
             optax.clip_by_global_norm(cfg.max_grad_norm),
             optax.scale_by_adam(
-                b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps
+                b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps,
+                mu_dtype=cfg.moment_dtype,
             ),
             optax.add_decayed_weights(
                 cfg.weight_decay,

@@ -2,7 +2,8 @@
 configuration (kernel tile sizes and remat policy are baked at trace
 time, so in-process sweeps would read stale settings).
 
-    python scripts/bench_sweep.py remat          # none|block|attn (dots OOMs)
+    python scripts/bench_sweep.py remat   # none|block|attn|attn_qkv|attn_o
+                                          # ("dots" OOMs at the bench shape)
     python scripts/bench_sweep.py loss_chunk     # CE chunk 64..512
     python scripts/bench_sweep.py bwd_blocks     # flash backward tiles
 

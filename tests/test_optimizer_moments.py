@@ -1,0 +1,71 @@
+"""moment_dtype knob (config.TrainConfig): bf16 first moment halves the
+m buffer; the variance buffer must stay fp32 regardless."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.train import step as step_lib
+from oryx_tpu.train.optimizer import make_optimizer
+
+from tests.test_trainer_modes import _batch
+
+
+def _moment_leaves(opt_state):
+    """All (mu_leaf, nu_leaf) arrays inside a ScaleByAdamState tree."""
+    mus, nus = [], []
+    for s in jax.tree.leaves(
+        opt_state, is_leaf=lambda x: hasattr(x, "mu") and hasattr(x, "nu")
+    ):
+        if hasattr(s, "mu"):
+            mus.extend(jax.tree.leaves(s.mu))
+            nus.extend(jax.tree.leaves(s.nu))
+    return mus, nus
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_moment_dtype_applied_and_step_trains(dtype):
+    base = cfg_lib.oryx_tiny()
+    cfg = dataclasses.replace(
+        base, train=dataclasses.replace(base.train, moment_dtype=dtype)
+    )
+    params = oryx.init_params(cfg, jax.random.key(0))
+    tx = make_optimizer(cfg.train, params)
+    opt_state = tx.init(params)
+
+    mus, nus = _moment_leaves(opt_state)
+    assert mus and nus
+    assert all(m.dtype == jnp.dtype(dtype) for m in mus), (
+        {m.dtype for m in mus}
+    )
+    assert all(n.dtype == jnp.float32 for n in nus), {n.dtype for n in nus}
+
+    params0 = jax.tree.map(np.asarray, params)  # train_step donates params
+    state = step_lib.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+    )
+    batch = {k: jnp.asarray(v)[None] for k, v in _batch(cfg).items()}
+    losses = []
+    for _ in range(3):
+        state, metrics = step_lib.train_step(state, batch, cfg, tx)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all(), losses
+    # Params must actually move under the bf16 moments.
+    moved = jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - np.asarray(b)))),
+        params0, state.params,
+    )
+    assert max(jax.tree.leaves(moved)) > 0.0
+
+
+def test_bad_moment_dtype_rejected():
+    with pytest.raises(ValueError, match="moment_dtype"):
+        dataclasses.replace(
+            cfg_lib.oryx_tiny().train, moment_dtype="float16"
+        )
