@@ -12,9 +12,11 @@ build a model dir + byte-level HF tokenizer on disk, then invoke
     python scripts/make_smoke_eval.py --out /tmp/smoke --run \
         --result assets/smoke_eval/result_cpu.json
 
-Accuracy with random weights is chance-level by construction (4 options);
-the committed result JSON documents the harness producing a real accuracy
-from the real decode path, not the model's skill.
+Accuracy with random weights: chance-level (0.25, 4 options) under
+--scoring loglikelihood; 0.0 under the default generate mode (the random
+model emits no parseable answer letter). Either way the committed result
+JSON documents the harness producing a real accuracy from the real
+pipeline, not the model's skill.
 """
 
 from __future__ import annotations
@@ -122,6 +124,12 @@ def main(argv=None):
     )
     ap.add_argument("--result", default=None, help="result json path")
     ap.add_argument("--num-frames", type=int, default=4)
+    ap.add_argument(
+        "--scoring", default="generate", choices=["generate", "loglikelihood"],
+        help="harness scoring mode; loglikelihood gives chance-level "
+        "accuracy on the random-weight smoke model (generate-mode answer "
+        "parsing scores 0.0 there)",
+    )
     args = ap.parse_args(argv)
 
     task = build_task(args.out)
@@ -138,6 +146,7 @@ def main(argv=None):
         "--num-frames", str(args.num_frames),
         "--max-new-tokens", "4",
         "--by", "kind",
+        "--scoring", args.scoring,
         *( ["--output", args.result] if args.result else [] ),
     ])
 

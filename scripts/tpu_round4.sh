@@ -96,8 +96,10 @@ step tpu_validate 3600 python scripts/tpu_validate.py
 step sweep_loss_chunk 3600 python scripts/bench_sweep.py loss_chunk
 step sweep_fwd_blocks 3600 python scripts/bench_sweep.py fwd_blocks
 step sweep_remat 3600 python scripts/bench_sweep.py remat
-step smoke_eval 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \
-  --run --result "$OUT/smoke_result_tpu.json"
+# Step named for its scoring mode so a stale marker from a generate-mode
+# run can't skip the loglikelihood run.
+step smoke_eval_ll 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \
+  --run --scoring loglikelihood --result "$OUT/smoke_result_tpu.json"
 
 echo "== done; results in $OUT (fail=$fail) =="
 exit "$fail"
