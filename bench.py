@@ -323,6 +323,34 @@ class _CharTokenizer:
         return "".join(chr(i) for i in ids if 0 < i < 50000)
 
 
+def make_video_request(pipe, cfg, num_frames: int):
+    """One deterministic video-QA request, prepped + packed the way the
+    serving pipeline does it. Shared by the end-to-end latency bench and
+    scripts/bench_components.py so the component breakdown measures the
+    SAME request shape the e2e number comes from.
+
+    Returns (frames, question, mm_batch, staged_arrays)."""
+    from oryx_tpu.models import oryx, splice
+    from oryx_tpu.ops import packing
+
+    rng = np.random.default_rng(0)
+    frames = [
+        rng.integers(0, 255, size=(224, 224, 3), dtype=np.uint8)
+        for _ in range(num_frames)
+    ]
+    question = "what happens?"
+    ids, images, factors, caps = pipe._prepare_request({
+        "question": question, "images": frames, "is_video": True,
+    })
+    packed = packing.pack_raw_images(
+        images, patch_size=cfg.vision.patch_size,
+        base_grid=cfg.vision.base_grid, side_factors=factors,
+        max_patches=caps,
+    )
+    batch = splice.build_mm_batch([ids], splice.query_slots(packed))
+    return frames, question, batch, oryx.stage_mm_arrays(packed, batch)
+
+
 def bench_video_latency(params, cfg, num_frames: int = 64) -> dict:
     """Video-QA latency through the serving pipeline, split into two
     components (VERDICT r3 #4 — the tunnel-noise fix):
@@ -341,32 +369,17 @@ def bench_video_latency(params, cfg, num_frames: int = 64) -> dict:
     case (16x compression, shared patch budget across frames)."""
     import jax
 
-    from oryx_tpu.models import oryx, splice
+    from oryx_tpu.models import oryx
     from oryx_tpu.ops import packing
     from oryx_tpu.serve.pipeline import OryxInference
 
     pipe = OryxInference(_CharTokenizer(), params, cfg)
-    rng = np.random.default_rng(0)
-    frames = [
-        rng.integers(0, 255, size=(224, 224, 3), dtype=np.uint8)
-        for _ in range(num_frames)
-    ]
-    question = "what happens?"
+    frames, question, batch, arrays = make_video_request(pipe, cfg, num_frames)
 
     # --- device-only component ------------------------------------------
-    ids, images, factors, caps = pipe._prepare_request({
-        "question": question, "images": frames, "is_video": True,
-    })
-    packed = packing.pack_raw_images(
-        images, patch_size=cfg.vision.patch_size,
-        base_grid=cfg.vision.base_grid, side_factors=factors,
-        max_patches=caps,
-    )
-    batch = splice.build_mm_batch([ids], splice.query_slots(packed))
     cache_len = packing.round_up_bucket(
         batch.token_ids.shape[1] + LATENCY_NEW_TOKENS
     )
-    arrays = oryx.stage_mm_arrays(packed, batch)
     key = jax.random.key(0)
     run = lambda: oryx._jit_mm_generate(
         params, cfg, arrays, LATENCY_NEW_TOKENS, cache_len, key,
@@ -397,7 +410,7 @@ def bench_video_latency(params, cfg, num_frames: int = 64) -> dict:
             3,
         ),
         "e2e_p50_s": round(float(np.percentile(e2e, 50)), 4),
-        "patch_bucket": int(packed.patches.shape[0]),
+        "patch_bucket": int(arrays["patches"].shape[0]),
         "seq_bucket": int(batch.token_ids.shape[1]),
     }
 

@@ -100,6 +100,8 @@ step sweep_remat 3600 python scripts/bench_sweep.py remat
 # run can't skip the loglikelihood run.
 step smoke_eval_ll 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \
   --run --scoring loglikelihood --result "$OUT/smoke_result_tpu.json"
+step components64 3600 env COMPONENT_FRAMES=64 python scripts/bench_components.py
+step components256 3600 env COMPONENT_FRAMES=256 python scripts/bench_components.py
 
 echo "== done; results in $OUT (fail=$fail) =="
 exit "$fail"
