@@ -81,6 +81,14 @@ _TUNNEL_ERR_MARKERS = (
     "Socket closed",
 )
 
+# Allocator-context OOM markers (XLA: "RESOURCE_EXHAUSTED: Out of memory
+# while trying to allocate ..."). Deliberately NOT bare RESOURCE_EXHAUSTED,
+# which gRPC also uses for transient transport conditions. Classified here
+# in the supervisor, which sees the FULL child output — downstream callers
+# (scripts/bench_sweep.py) only see a truncated detail tail where the OOM
+# header line is usually sliced off.
+_OOM_MARKERS = ("Out of memory", "out of memory")
+
 WARMUP_STEPS = 2
 TIMED_STEPS = 5
 LATENCY_REPEATS = 5
@@ -511,7 +519,11 @@ def _supervise() -> None:
             last = "\n".join(phases)[-500:] + ("\n" if phases else "") + tail
             infra = rc is None or any(m in both for m in _TUNNEL_ERR_MARKERS)
             if not infra:
-                _emit_error("bench_failed", last, attempt)
+                oom = any(m in both for m in _OOM_MARKERS)
+                # "oom" is deterministic for the configuration: retrying
+                # the identical run cannot succeed (sweep callers bank it
+                # instead of looping).
+                _emit_error("oom" if oom else "bench_failed", last, attempt)
         else:
             last = tail
         if attempt < PROBE_ATTEMPTS:

@@ -15,17 +15,35 @@ live in TPU_VALIDATION.md — re-run after kernel or remat changes.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Infra-vs-bug taxonomy shared with the bench supervisor. The supervisor
+# classifies OOMs itself (it sees the full child output; see bench.py
+# _OOM_MARKERS) and emits {"error": "oom"} — deterministic for the
+# configuration, so the result is banked rather than retried: a
+# watcher-driven re-run must not loop forever on a config that OOMs by
+# construction (e.g. remat policies that exceed HBM at the bench shape).
+# The text markers below are only the fallback for non-supervised runs
+# (CPU in-process mode), requiring allocator context — bare
+# RESOURCE_EXHAUSTED is also a transient gRPC transport status.
+from bench import _OOM_MARKERS, _TUNNEL_ERR_MARKERS  # noqa: E402
 
 SWEEPS = {
     "remat": [
         {"BENCH_REMAT_POLICY": p}
         for p in ("none", "block", "attn", "attn_qkv", "attn_o")
+    ] + [
+        # attn_o costs ~1.7 GB over attn at the bench geometry; bf16
+        # first moments free ~1.4 GB, so the combination lands even if
+        # plain attn_o tips over HBM.
+        {"BENCH_REMAT_POLICY": "attn_o", "BENCH_MOMENT_DTYPE": "bfloat16"},
     ],
     "loss_chunk": [{"BENCH_LOSS_CHUNK": str(c)} for c in (64, 128, 256, 512)],
     "bwd_blocks": [
@@ -41,7 +59,42 @@ SWEEPS = {
 }
 
 
-def run_one(extra_env: dict[str, str], timeout: int) -> dict | None:
+def _state_path(which: str, extra_env: dict[str, str]) -> str | None:
+    """Keyed by a hash of the config CONTENT, not its list index — a
+    later edit/reorder of a SWEEPS list must never serve a stale banked
+    record for a different config."""
+    d = os.environ.get("SWEEP_STATE_DIR")
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    h = hashlib.sha1(
+        json.dumps(extra_env, sort_keys=True).encode()
+    ).hexdigest()[:12]
+    return os.path.join(d, f"{which}_{h}.json")
+
+
+def _bank(state: str, rec: dict) -> None:
+    """Atomic write: the agenda's `timeout --kill-after` can SIGKILL this
+    process mid-dump; a truncated state file must not wedge retries."""
+    tmp = state + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, state)
+
+
+def run_one(extra_env: dict[str, str], timeout: int,
+            state: str | None = None) -> dict | None:
+    """Returns the banked record, or None when the config should be
+    retried (tunnel flap / timeout). Deterministic failures (OOM) are
+    banked as error records — retrying them cannot succeed."""
+    if state and os.path.exists(state):
+        try:
+            rec = json.load(open(state))
+        except ValueError:  # truncated by a mid-write kill: re-run
+            os.remove(state)
+        else:
+            print(json.dumps({**rec, "cached": True}))
+            return rec
     # One probe attempt and a child budget inside our own timeout: the
     # supervisor's full 3x5-min retry ladder would eat the per-config
     # window before the bench ever ran. A flap costs one config, and the
@@ -66,12 +119,40 @@ def run_one(extra_env: dict[str, str], timeout: int) -> dict | None:
          if l.startswith("{")), None,
     )
     if out.returncode != 0 or line is None:
-        print(json.dumps({
-            "config": extra_env, "error": (out.stderr or out.stdout)[-400:],
-        }))
+        both = (out.stderr or "") + (out.stdout or "")
+        # Prefer the supervisor's own classification (it saw the full,
+        # untruncated child output); fall back to allocator-context text
+        # markers for non-supervised (in-process CPU) runs.
+        err_json = {}
+        if line is not None:
+            try:
+                err_json = json.loads(line)
+            except ValueError:
+                pass
+        deterministic = err_json.get("error") == "oom" or (
+            any(m in both for m in _OOM_MARKERS)
+            and not any(m in both for m in _TUNNEL_ERR_MARKERS)
+        )
+        rec = {
+            "config": extra_env,
+            "error": err_json.get("error") or (out.stderr or out.stdout)[-400:],
+            **({"detail": err_json["detail"][-400:]}
+               if err_json.get("detail") else {}),
+        }
+        print(json.dumps(rec))
+        if deterministic:
+            # Banked as a (negative) result with or without a state dir:
+            # a deterministic failure must count toward sweep completion,
+            # or a retrying caller loops forever on a config that OOMs by
+            # construction.
+            if state:
+                _bank(state, rec)
+            return rec
         return None
     rec = {"config": extra_env, **json.loads(line)}
     print(json.dumps(rec))
+    if state:
+        _bank(state, rec)
     return rec
 
 
@@ -80,14 +161,19 @@ def main() -> None:
     if which not in SWEEPS:
         raise SystemExit(f"unknown sweep {which!r}; have {sorted(SWEEPS)}")
     timeout = int(os.environ.get("SWEEP_TIMEOUT_S", "600"))
-    results = [r for e in SWEEPS[which] if (r := run_one(e, timeout))]
-    if results:
-        best = max(results, key=lambda r: r.get("value", 0.0))
+    results = [
+        r for e in SWEEPS[which]
+        if (r := run_one(e, timeout, _state_path(which, e)))
+    ]
+    scored = [r for r in results if "value" in r]
+    if scored:
+        best = max(scored, key=lambda r: r.get("value", 0.0))
         print(json.dumps({"best": best["config"], "value": best["value"]}))
     if len(results) < len(SWEEPS[which]):
-        # Nonzero exit when any config failed so a retrying caller
-        # (tunnel_watch -> tpu_round4 step .ok markers) re-runs the sweep
-        # rather than banking a partial grid as done.
+        # Nonzero exit ONLY for retryable gaps (tunnel flap/timeout) so a
+        # retrying caller (tunnel_watch -> tpu_round4 .ok markers) re-runs
+        # just those; with SWEEP_STATE_DIR set, banked configs (including
+        # deterministic OOMs) are never re-paid.
         raise SystemExit(1)
 
 

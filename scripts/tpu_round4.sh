@@ -93,9 +93,16 @@ step() {  # step <name> <timeout_s> <cmd...> — timeout: a hung tunnel must
 # the structured {"error": ...} line.
 step bench_default 12600 python bench.py
 step tpu_validate 3600 python scripts/tpu_validate.py
-step sweep_loss_chunk 3600 python scripts/bench_sweep.py loss_chunk
-step sweep_fwd_blocks 3600 python scripts/bench_sweep.py fwd_blocks
-step sweep_remat 3600 python scripts/bench_sweep.py remat
+# SWEEP_STATE_DIR banks per-config results (incl. deterministic OOMs)
+# so watcher retries after a flap re-pay only the missing configs.
+step sweep_loss_chunk 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
+  python scripts/bench_sweep.py loss_chunk
+step sweep_fwd_blocks 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
+  python scripts/bench_sweep.py fwd_blocks
+# 6 remat configs x 600 s per-config cap: 3600 s would let the outer
+# kill preempt the last config; 4500 leaves margin.
+step sweep_remat 4500 env SWEEP_STATE_DIR="$OUT/sweep_state" \
+  python scripts/bench_sweep.py remat
 # Step named for its scoring mode so a stale marker from a generate-mode
 # run can't skip the loglikelihood run.
 step smoke_eval_ll 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \

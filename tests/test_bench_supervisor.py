@@ -49,6 +49,41 @@ def test_unreachable_backend_emits_structured_error():
         assert "Traceback" not in line, line
 
 
+def test_oom_child_classified_deterministic(monkeypatch, capsys):
+    """An OOM in the child (allocator context in the FULL output the
+    supervisor sees) must be emitted as {"error": "oom"} so sweep callers
+    bank it instead of retrying forever; bare gRPC RESOURCE_EXHAUSTED
+    without allocator context must stay "bench_failed"/retryable."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.setattr(bench, "_probe_once", lambda: (True, ""))
+    monkeypatch.setattr(
+        bench, "_run_bench_child",
+        lambda: (1, "", "RESOURCE_EXHAUSTED: Out of memory while trying "
+                 "to allocate 20.5GiB\n<alloc breakdown>"),
+    )
+    try:
+        bench._supervise()
+        raise AssertionError("should have exited")
+    except SystemExit as e:
+        assert e.code == 1
+    d = _last_json(capsys.readouterr().out)
+    assert d["error"] == "oom"
+
+    monkeypatch.setattr(
+        bench, "_run_bench_child",
+        lambda: (1, "", "RESOURCE_EXHAUSTED: message larger than max"),
+    )
+    try:
+        bench._supervise()
+        raise AssertionError("should have exited")
+    except SystemExit as e:
+        assert e.code == 1
+    d = _last_json(capsys.readouterr().out)
+    assert d["error"] == "bench_failed"
+
+
 def test_probe_success_runs_bench_child():
     """Auto-chosen CPU backend: probe passes, the bench child runs, and
     the metric line is LAST on stdout."""

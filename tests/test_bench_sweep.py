@@ -1,0 +1,108 @@
+"""Sweep state banking + failure taxonomy (scripts/bench_sweep.py): a
+watcher-retried sweep must re-pay only retryable gaps — banked successes
+and deterministic OOMs are final, truncated state files recover, and
+content-hashed keys never serve a stale record for an edited config."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (REPO, os.path.join(REPO, "scripts")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import bench_sweep as bs  # noqa: E402
+
+
+class _Proc:
+    def __init__(self, rc, stdout="", stderr=""):
+        self.returncode, self.stdout, self.stderr = rc, stdout, stderr
+
+
+@pytest.fixture
+def state_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWEEP_STATE_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _fake_run(monkeypatch, proc):
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: proc)
+
+
+GOOD_LINE = json.dumps({"metric": "m", "value": 42.0, "unit": "tok/s"})
+
+
+def test_success_banks_and_replays(state_dir, monkeypatch):
+    cfg = {"BENCH_REMAT_POLICY": "attn"}
+    path = bs._state_path("remat", cfg)
+    _fake_run(monkeypatch, _Proc(0, stdout=GOOD_LINE))
+    r1 = bs.run_one(cfg, 300, path)
+    assert r1["value"] == 42.0 and os.path.exists(path)
+
+    # Replay must not touch subprocess at all.
+    def boom(*a, **k):
+        raise AssertionError("subprocess must not run on a cache hit")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert bs.run_one(cfg, 300, path) == r1
+
+
+def test_state_keyed_by_content_not_index():
+    a = bs._state_path("remat", {"BENCH_REMAT_POLICY": "attn"})
+    b = bs._state_path("remat", {"BENCH_REMAT_POLICY": "attn_o"})
+    if a is not None:  # env may not set SWEEP_STATE_DIR outside fixture
+        assert a != b
+
+
+def test_truncated_state_file_recovers(state_dir, monkeypatch):
+    cfg = {"BENCH_REMAT_POLICY": "attn"}
+    path = bs._state_path("remat", cfg)
+    with open(path, "w") as f:
+        f.write('{"config": {"BENCH')  # mid-write SIGKILL artifact
+    _fake_run(monkeypatch, _Proc(0, stdout=GOOD_LINE))
+    r = bs.run_one(cfg, 300, path)
+    assert r["value"] == 42.0 and json.load(open(path))["value"] == 42.0
+
+
+def test_supervisor_oom_is_banked_deterministic(state_dir, monkeypatch):
+    cfg = {"BENCH_REMAT_POLICY": "dots"}
+    path = bs._state_path("remat", cfg)
+    line = json.dumps({"error": "oom", "detail": "Out of memory while ..."})
+    _fake_run(monkeypatch, _Proc(1, stdout=line))
+    r = bs.run_one(cfg, 300, path)
+    assert r is not None and r["error"] == "oom"
+    assert json.load(open(path))["error"] == "oom"
+
+
+def test_oom_counts_as_result_without_state_dir(monkeypatch):
+    monkeypatch.delenv("SWEEP_STATE_DIR", raising=False)
+    line = json.dumps({"error": "oom", "detail": "Out of memory while ..."})
+    _fake_run(monkeypatch, _Proc(1, stdout=line))
+    r = bs.run_one({"BENCH_REMAT_POLICY": "dots"}, 300, None)
+    assert r is not None and r["error"] == "oom"
+
+
+def test_bare_resource_exhausted_is_retryable(state_dir, monkeypatch):
+    cfg = {"x": "re"}
+    path = bs._state_path("remat", cfg)
+    _fake_run(
+        monkeypatch,
+        _Proc(1, stderr="RESOURCE_EXHAUSTED: message larger than max"),
+    )
+    assert bs.run_one(cfg, 300, path) is None
+    assert not os.path.exists(path)
+
+
+def test_tunnel_marker_beats_oom_text(state_dir, monkeypatch):
+    cfg = {"x": "flap"}
+    path = bs._state_path("remat", cfg)
+    _fake_run(
+        monkeypatch,
+        _Proc(1, stderr="Out of memory ... UNAVAILABLE: socket closed"),
+    )
+    assert bs.run_one(cfg, 300, path) is None
+    assert not os.path.exists(path)
