@@ -33,7 +33,11 @@ sys.path.insert(0, REPO)
 # The text markers below are only the fallback for non-supervised runs
 # (CPU in-process mode), requiring allocator context — bare
 # RESOURCE_EXHAUSTED is also a transient gRPC transport status.
-from bench import _OOM_MARKERS, _TUNNEL_ERR_MARKERS  # noqa: E402
+from bench import (  # noqa: E402
+    _OOM_MARKERS,
+    _TUNNEL_ERR_MARKERS,
+    _find_json_line,
+)
 
 SWEEPS = {
     "remat": [
@@ -114,10 +118,7 @@ def run_one(extra_env: dict[str, str], timeout: int,
     except subprocess.TimeoutExpired:
         print(json.dumps({"config": extra_env, "error": "timeout"}))
         return None
-    line = next(
-        (l for l in reversed(out.stdout.splitlines())
-         if l.startswith("{")), None,
-    )
+    line = _find_json_line(out.stdout or "")
     if out.returncode != 0 or line is None:
         both = (out.stderr or "") + (out.stdout or "")
         # Prefer the supervisor's own classification (it saw the full,

@@ -103,6 +103,18 @@ step sweep_fwd_blocks 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
 # kill preempt the last config; 4500 leaves margin.
 step sweep_remat 4500 env SWEEP_STATE_DIR="$OUT/sweep_state" \
   python scripts/bench_sweep.py remat
+# Union of the per-sweep winners, full bench (throughput + latency):
+# the evidence for flipping repo defaults, landed unattended. Gated on
+# ALL sweeps having completed — a partial grid must not bank a stale
+# "best" combination behind a .ok marker the watcher then skips.
+if [ -e "$OUT/sweep_loss_chunk.ok" ] && [ -e "$OUT/sweep_fwd_blocks.ok" ] \
+    && [ -e "$OUT/sweep_remat.ok" ]; then
+  step bench_best 12600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
+    python scripts/bench_best.py
+else
+  echo "== bench_best: sweeps incomplete; deferring to a watcher retry ==" >&2
+  fail=1
+fi
 # Step named for its scoring mode so a stale marker from a generate-mode
 # run can't skip the loglikelihood run.
 step smoke_eval_ll 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \

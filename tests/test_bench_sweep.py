@@ -51,11 +51,12 @@ def test_success_banks_and_replays(state_dir, monkeypatch):
     assert bs.run_one(cfg, 300, path) == r1
 
 
-def test_state_keyed_by_content_not_index():
+def test_state_keyed_by_content_not_index(state_dir):
     a = bs._state_path("remat", {"BENCH_REMAT_POLICY": "attn"})
     b = bs._state_path("remat", {"BENCH_REMAT_POLICY": "attn_o"})
-    if a is not None:  # env may not set SWEEP_STATE_DIR outside fixture
-        assert a != b
+    assert a is not None and b is not None and a != b
+    # Same content, same key — the replay identity the banking relies on.
+    assert a == bs._state_path("remat", {"BENCH_REMAT_POLICY": "attn"})
 
 
 def test_truncated_state_file_recovers(state_dir, monkeypatch):
@@ -95,6 +96,34 @@ def test_bare_resource_exhausted_is_retryable(state_dir, monkeypatch):
     )
     assert bs.run_one(cfg, 300, path) is None
     assert not os.path.exists(path)
+
+
+def test_best_env_filters_orphans_and_ooms(state_dir):
+    import bench_best as bb
+
+    # Bank two scored records + one OOM for CURRENT sweep configs.
+    for cfg, rec in [
+        ({"BENCH_REMAT_POLICY": "attn"}, {"value": 90.0}),
+        ({"BENCH_REMAT_POLICY": "attn_o"}, {"value": 120.0}),
+        ({"BENCH_REMAT_POLICY": "dots"}, {"error": "oom"}),
+    ]:
+        if cfg in bs.SWEEPS["remat"]:
+            bs._bank(
+                bs._state_path("remat", cfg), {"config": cfg, **rec}
+            )
+    bs._bank(
+        bs._state_path("loss_chunk", {"BENCH_LOSS_CHUNK": "256"}),
+        {"config": {"BENCH_LOSS_CHUNK": "256"}, "value": 100.0},
+    )
+    # Orphan: a banked record whose config is NOT in the current SWEEPS
+    # (stale hash from an edited list) — must not participate.
+    json.dump(
+        {"config": {"BENCH_REMAT_POLICY": "legacy"}, "value": 999.0},
+        open(os.path.join(str(state_dir), "remat_deadbeef0000.json"), "w"),
+    )
+    env = bb.best_env(str(state_dir))
+    assert env.get("BENCH_REMAT_POLICY") == "attn_o"
+    assert env.get("BENCH_LOSS_CHUNK") == "256"
 
 
 def test_tunnel_marker_beats_oom_text(state_dir, monkeypatch):
