@@ -210,6 +210,13 @@ def _bench_cfg(backend: str, hbm_bytes: int):
         )
         batch_size, seq_bucket, img_patches_side = 8, (2048,), 16
         comp_heads = 12
+        # Sweepable geometry knobs (scripts/bench_sweep.py "batch"): more
+        # tokens/step amortizes per-step overhead where the memory freed
+        # by bf16 moments / thin remat policies allows.
+        if os.environ.get("BENCH_BATCH"):
+            batch_size = int(os.environ["BENCH_BATCH"])
+        if os.environ.get("BENCH_SEQ"):
+            seq_bucket = (int(os.environ["BENCH_SEQ"]),)
     else:
         geo_name, llm = "tiny", cfg_lib.tiny_llm()
         vision = cfg_lib.tiny_vision()
@@ -443,6 +450,11 @@ def _run_bench_child() -> tuple[int | None, str, str]:
     means killed on timeout."""
     env = dict(os.environ)
     env[_BENCH_CHILD_ENV] = "1"
+    # Persistent compile cache (same default as dryrun_multichip): the
+    # driver's end-of-round bench pays the 0.6B-geometry compile on one
+    # CPU core + tunnel latency; a warm cache from the agenda's earlier
+    # runs turns that into seconds.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -3,11 +3,15 @@ union configuration.
 
 The sweeps (scripts/bench_sweep.py) vary one knob at a time; this step
 reads their banked per-config results under SWEEP_STATE_DIR, picks the
-argmax-by-tok/s config of each sweep, merges their env overrides (later
-sweeps win conflicts, which cannot occur with the current disjoint
-knobs), and runs bench.py with the merged env — the evidence for
-flipping repo defaults. Skips silently-missing sweeps: a partial state
-dir yields the best-known combination, not a crash.
+argmax-by-tok/s config of each sweep, merges their env overrides, and
+runs bench.py with the merged env — the evidence for flipping repo
+defaults. The sweeps' knobs OVERLAP on BENCH_MOMENT_DTYPE (the remat
+combo row and the >8 batch rows both carry bfloat16): the merge is
+sorted-by-sweep-name with later sweeps winning, and today every
+overlapping key only ever takes the value "bfloat16" — revisit the
+resolution if a sweep ever sets a different value for a shared key.
+Skips silently-missing sweeps: a partial state dir yields the
+best-known combination, not a crash.
 
     SWEEP_STATE_DIR=/tmp/r4_onchip/sweep_state python scripts/bench_best.py
 """
@@ -34,7 +38,7 @@ def best_env(state_dir: str) -> dict[str, str]:
     by_sweep: dict[str, tuple[float, dict]] = {}
     for which, configs in SWEEPS.items():
         for cfg in configs:
-            path = _state_path(which, cfg)
+            path = _state_path(which, cfg, state_dir)
             if not path or not os.path.exists(path):
                 continue
             try:

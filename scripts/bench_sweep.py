@@ -60,14 +60,26 @@ SWEEPS = {
         for q, k in (("512", "512"), ("512", "1024"), ("1024", "512"),
                      ("1024", "1024"))
     ],
+    # Token-volume sweep: more tokens/step amortizes per-step overhead.
+    # The >8 rows only stand a chance with the bf16-moment headroom, so
+    # they carry it; OOMs bank as final negative results.
+    "batch": [
+        {"BENCH_BATCH": "8"},
+        {"BENCH_BATCH": "12", "BENCH_MOMENT_DTYPE": "bfloat16"},
+        {"BENCH_BATCH": "16", "BENCH_MOMENT_DTYPE": "bfloat16"},
+        {"BENCH_BATCH": "8", "BENCH_SEQ": "4096",
+         "BENCH_MOMENT_DTYPE": "bfloat16"},
+    ],
 }
 
 
-def _state_path(which: str, extra_env: dict[str, str]) -> str | None:
+def _state_path(
+    which: str, extra_env: dict[str, str], state_dir: str | None = None
+) -> str | None:
     """Keyed by a hash of the config CONTENT, not its list index — a
     later edit/reorder of a SWEEPS list must never serve a stale banked
     record for a different config."""
-    d = os.environ.get("SWEEP_STATE_DIR")
+    d = state_dir or os.environ.get("SWEEP_STATE_DIR")
     if not d:
         return None
     os.makedirs(d, exist_ok=True)

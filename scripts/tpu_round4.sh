@@ -103,12 +103,14 @@ step sweep_fwd_blocks 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
 # kill preempt the last config; 4500 leaves margin.
 step sweep_remat 4500 env SWEEP_STATE_DIR="$OUT/sweep_state" \
   python scripts/bench_sweep.py remat
+step sweep_batch 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
+  python scripts/bench_sweep.py batch
 # Union of the per-sweep winners, full bench (throughput + latency):
 # the evidence for flipping repo defaults, landed unattended. Gated on
 # ALL sweeps having completed — a partial grid must not bank a stale
 # "best" combination behind a .ok marker the watcher then skips.
 if [ -e "$OUT/sweep_loss_chunk.ok" ] && [ -e "$OUT/sweep_fwd_blocks.ok" ] \
-    && [ -e "$OUT/sweep_remat.ok" ]; then
+    && [ -e "$OUT/sweep_remat.ok" ] && [ -e "$OUT/sweep_batch.ok" ]; then
   step bench_best 12600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
     python scripts/bench_best.py
 else

@@ -101,16 +101,18 @@ def test_bare_resource_exhausted_is_retryable(state_dir, monkeypatch):
 def test_best_env_filters_orphans_and_ooms(state_dir):
     import bench_best as bb
 
-    # Bank two scored records + one OOM for CURRENT sweep configs.
-    for cfg, rec in [
+    # Bank two scored records + one OOM, all for CURRENT sweep configs —
+    # the OOM must go to a live config or the value-is-None filter leg
+    # is never exercised.
+    banked = [
         ({"BENCH_REMAT_POLICY": "attn"}, {"value": 90.0}),
         ({"BENCH_REMAT_POLICY": "attn_o"}, {"value": 120.0}),
-        ({"BENCH_REMAT_POLICY": "dots"}, {"error": "oom"}),
-    ]:
-        if cfg in bs.SWEEPS["remat"]:
-            bs._bank(
-                bs._state_path("remat", cfg), {"config": cfg, **rec}
-            )
+        ({"BENCH_REMAT_POLICY": "attn_o", "BENCH_MOMENT_DTYPE": "bfloat16"},
+         {"error": "oom"}),
+    ]
+    for cfg, rec in banked:
+        assert cfg in bs.SWEEPS["remat"], cfg
+        bs._bank(bs._state_path("remat", cfg), {"config": cfg, **rec})
     bs._bank(
         bs._state_path("loss_chunk", {"BENCH_LOSS_CHUNK": "256"}),
         {"config": {"BENCH_LOSS_CHUNK": "256"}, "value": 100.0},
