@@ -1,0 +1,74 @@
+"""Worker process for tests/test_multiprocess.py — NOT a pytest module.
+
+Each of two processes owns 4 CPU devices (8 global), rendezvouses via
+oryx_tpu.parallel.mesh.initialize_distributed (Gloo), builds the SAME
+Trainer (dp=2 x fsdp=4 over the global device set), and runs two real
+train steps on the same host batch (single-controller semantics: every
+process presents the identical host value; GSPMD shards it). Prints one
+MP_RESULT JSON line the parent asserts on.
+
+Run directly (in 2 processes):
+    python tests/mp_trainer_worker.py <pid> <port> <tmpdir>
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+# Must match the env the parent sets; asserted here so a refactor of the
+# parent can't silently run this single-process.
+assert os.environ.get("JAX_PLATFORMS") == "cpu"
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from oryx_tpu import config as cfg_lib  # noqa: E402
+from oryx_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+mesh_lib.initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+assert jax.process_count() == 2
+assert jax.device_count() == 8 and len(jax.local_devices()) == 4
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+from test_trainer_modes import _batch  # noqa: E402
+
+from oryx_tpu.train.trainer import Trainer  # noqa: E402
+
+cfg = dataclasses.replace(
+    cfg_lib.oryx_tiny(),
+    mesh=cfg_lib.MeshConfig(dp=2, fsdp=4, tp=1, sp=1),
+)
+cfg = dataclasses.replace(
+    cfg,
+    train=dataclasses.replace(
+        cfg.train, num_train_steps=2, log_every=100, checkpoint_every=100,
+        checkpoint_dir=os.path.join(sys.argv[3], "ckpt"),
+    ),
+)
+
+trainer = Trainer(cfg, sharding_mode="fsdp")
+batch = _batch(cfg)
+state = trainer.fit(iter([batch, batch]), num_steps=2, resume=False,
+                    prefetch=0)
+step = int(jax.device_get(state.step))
+
+# Loss of the final params, recomputed identically on every process — the
+# cross-process agreement assertion (GSPMD must give one global answer).
+from oryx_tpu.train import step as step_lib  # noqa: E402
+
+mb = {k: jax.numpy.asarray(v) for k, v in batch.items()}
+loss, _ = jax.jit(step_lib.microbatch_loss, static_argnames=("cfg",))(
+    state.params, cfg, mb
+)
+print(json.dumps({
+    "mp_result": True, "pid": pid, "step": step,
+    "process_count": jax.process_count(),
+    "loss": round(float(jax.device_get(loss)), 6),
+}), flush=True)
