@@ -48,7 +48,7 @@ cfg = dataclasses.replace(
 cfg = dataclasses.replace(
     cfg,
     train=dataclasses.replace(
-        cfg.train, num_train_steps=2, log_every=100, checkpoint_every=100,
+        cfg.train, num_train_steps=2, log_every=100, checkpoint_every=2,
         checkpoint_dir=os.path.join(sys.argv[3], "ckpt"),
     ),
 )
@@ -59,6 +59,24 @@ state = trainer.fit(iter([batch, batch]), num_steps=2, resume=False,
                     prefetch=0)
 step = int(jax.device_get(state.step))
 
+# Multi-process checkpoint/resume (failure posture A3 at "pod" scale):
+# step 2 was saved by BOTH processes through orbax's coordinated save; a
+# fresh Trainer must restore it and agree on the resumed step.
+trainer2 = Trainer(cfg, sharding_mode="fsdp")
+resumed = trainer2.resume_if_available()
+assert resumed == 2, resumed
+for a, b in zip(
+    jax.tree_util.tree_leaves(state.params),
+    jax.tree_util.tree_leaves(trainer2.state.params),
+):
+    assert a.sharding == b.sharding
+    for sa, sb in zip(a.addressable_shards, b.addressable_shards):
+        assert sa.index == sb.index
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(sa.data)),
+            np.asarray(jax.device_get(sb.data)),
+        )
+
 # Loss of the final params, recomputed identically on every process — the
 # cross-process agreement assertion (GSPMD must give one global answer).
 from oryx_tpu.train import step as step_lib  # noqa: E402
@@ -68,7 +86,7 @@ loss, _ = jax.jit(step_lib.microbatch_loss, static_argnames=("cfg",))(
     state.params, cfg, mb
 )
 print(json.dumps({
-    "mp_result": True, "pid": pid, "step": step,
+    "mp_result": True, "pid": pid, "step": step, "resumed": resumed,
     "process_count": jax.process_count(),
     "loss": round(float(jax.device_get(loss)), 6),
 }), flush=True)

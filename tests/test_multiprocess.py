@@ -64,6 +64,9 @@ def test_two_process_trainer_fsdp(tmp_path):
     for r in results:
         assert r["process_count"] == 2
         assert r["step"] == 2
+        # Coordinated orbax save at step 2 restored by a fresh Trainer
+        # in every process (multi-host pod-restart posture).
+        assert r["resumed"] == 2
     # GSPMD must produce ONE global answer: both processes report the
     # same post-training loss to the printed precision.
     assert results[0]["loss"] == results[1]["loss"], results
