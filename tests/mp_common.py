@@ -1,0 +1,29 @@
+"""Shared bootstrap for the mp_*_worker.py multi-process test workers
+(NOT a pytest module): argv parse, distributed rendezvous, and the
+topology asserts that pin the 2-process x 4-device contract."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bootstrap():
+    """Returns (pid, jax) after the Gloo rendezvous. argv: <pid> <port>
+    [extra...]. Asserted env must match what test_multiprocess.py sets —
+    a refactor of the parent must not silently run workers
+    single-process."""
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    assert os.environ.get("JAX_PLATFORMS") == "cpu"
+
+    import jax
+
+    from oryx_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+    assert jax.process_count() == 2
+    assert jax.device_count() == 8 and len(jax.local_devices()) == 4
+    return pid, jax

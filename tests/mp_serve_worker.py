@@ -1,0 +1,58 @@
+"""Worker process for tests/test_multiprocess.py (serving leg) — NOT a
+pytest module.
+
+Two processes x 4 CPU devices: tensor-parallel serving over the global
+tp=8 mesh (the reference's multi-GPU `device_map` analog at multi-host
+scale). Both processes run the same chat_batch and must produce
+byte-identical replies; the reply text is printed for the parent to
+compare across processes.
+
+Run directly (in 2 processes):
+    python tests/mp_serve_worker.py <pid> <port>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+))
+from mp_common import bootstrap  # noqa: E402
+
+pid, jax = bootstrap()
+
+import numpy as np  # noqa: E402
+
+from oryx_tpu import config as cfg_lib  # noqa: E402
+from oryx_tpu.config import MeshConfig  # noqa: E402
+
+from test_serve import FakeTokenizer  # noqa: E402
+
+from oryx_tpu.models import oryx  # noqa: E402
+from oryx_tpu.parallel.mesh import build_mesh  # noqa: E402
+from oryx_tpu.serve.pipeline import OryxInference  # noqa: E402
+
+cfg = cfg_lib.oryx_tiny()
+params = oryx.init_params(cfg, jax.random.key(0))
+
+mesh = build_mesh(MeshConfig(tp=8))
+pipe = OryxInference(FakeTokenizer(), params, cfg, mesh=mesh,
+                     sharding_mode="tp")
+leaves = jax.tree_util.tree_leaves(pipe.params)
+assert any(not l.sharding.is_fully_replicated for l in leaves)
+
+rng = np.random.default_rng(5)
+img = rng.integers(0, 255, size=(40, 56, 3), dtype=np.uint8)
+replies = pipe.chat_batch(
+    [
+        {"question": "what is this?", "images": [img]},
+        {"question": "hello there"},
+    ],
+    max_new_tokens=4,
+)
+print(json.dumps({
+    "mp_result": True, "pid": pid,
+    "process_count": jax.process_count(),
+    "replies": replies,
+}), flush=True)

@@ -16,27 +16,17 @@ import json
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+))
+from mp_common import bootstrap  # noqa: E402
 
-pid = int(sys.argv[1])
-port = sys.argv[2]
+pid, jax = bootstrap()
 
-# Must match the env the parent sets; asserted here so a refactor of the
-# parent can't silently run this single-process.
-assert os.environ.get("JAX_PLATFORMS") == "cpu"
-
-import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 from oryx_tpu import config as cfg_lib  # noqa: E402
-from oryx_tpu.parallel import mesh as mesh_lib  # noqa: E402
 
-mesh_lib.initialize_distributed(f"127.0.0.1:{port}", 2, pid)
-assert jax.process_count() == 2
-assert jax.device_count() == 8 and len(jax.local_devices()) == 4
-
-sys.path.insert(0, os.path.join(REPO, "tests"))
 from test_trainer_modes import _batch  # noqa: E402
 
 from oryx_tpu.train.trainer import Trainer  # noqa: E402

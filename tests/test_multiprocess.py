@@ -17,6 +17,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mp_trainer_worker.py")
+SERVE_WORKER = os.path.join(REPO, "tests", "mp_serve_worker.py")
 
 
 def _free_port() -> int:
@@ -25,18 +26,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_trainer_fsdp(tmp_path):
-    port = _free_port()
+def _run_workers(worker: str, extra_args: list[str]) -> list[dict]:
     env = {
         **os.environ,
         "PALLAS_AXON_POOL_IPS": "",
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
     }
+    port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), str(port), str(tmp_path)],
+            [sys.executable, worker, str(i), str(port), *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True,
         )
@@ -59,10 +59,16 @@ def test_two_process_trainer_fsdp(tmp_path):
             if q.poll() is None:
                 q.kill()
                 q.communicate()
-
     assert {r["pid"] for r in results} == {0, 1}
+    assert all(r["process_count"] == 2 for r in results)
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_trainer_fsdp(tmp_path):
+    results = _run_workers(WORKER, [str(tmp_path)])
+
     for r in results:
-        assert r["process_count"] == 2
         assert r["step"] == 2
         # Coordinated orbax save at step 2 restored by a fresh Trainer
         # in every process (multi-host pod-restart posture).
@@ -70,3 +76,14 @@ def test_two_process_trainer_fsdp(tmp_path):
     # GSPMD must produce ONE global answer: both processes report the
     # same post-training loss to the printed precision.
     assert results[0]["loss"] == results[1]["loss"], results
+
+
+@pytest.mark.slow
+def test_two_process_tp_serving():
+    """Tensor-parallel serving over the global tp=8 mesh across two
+    processes — the reference's multi-GPU device_map analog at
+    multi-host scale. Both processes run the same two-request batch and
+    must report byte-identical reply lists."""
+    results = _run_workers(SERVE_WORKER, [])
+    assert results[0]["replies"] == results[1]["replies"], results
+    assert len(results[0]["replies"]) == 2
