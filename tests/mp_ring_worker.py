@@ -1,0 +1,32 @@
+"""Worker process for tests/test_multiprocess.py (ring-attention leg) —
+NOT a pytest module.
+
+sp=8 over 8 devices split across two processes: the decoder's ring
+attention rotates K/V blocks with lax.ppermute around a ring that
+CROSSES the process boundary twice per revolution — the single-box
+analog of ring attention over ICI/DCN on a multi-host pod. Reuses the
+driver-facing dryrun harness (__graft_entry__._dryrun_one_mesh) so the
+exact program the driver compile-checks is what runs multi-process.
+
+Run directly (in 2 processes):
+    python tests/mp_ring_worker.py <pid> <port>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+))
+from mp_common import bootstrap  # noqa: E402
+
+pid, jax = bootstrap()
+
+import __graft_entry__ as graft  # noqa: E402
+
+graft._dryrun_one_mesh(8, 1, 1, 1, 8)  # prints "dryrun_multichip ok: ..."
+print(json.dumps({
+    "mp_result": True, "pid": pid,
+    "process_count": jax.process_count(),
+}), flush=True)

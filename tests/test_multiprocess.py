@@ -18,6 +18,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mp_trainer_worker.py")
 SERVE_WORKER = os.path.join(REPO, "tests", "mp_serve_worker.py")
+RING_WORKER = os.path.join(REPO, "tests", "mp_ring_worker.py")
 
 
 def _free_port() -> int:
@@ -50,7 +51,15 @@ def _run_workers(worker: str, extra_args: list[str]) -> list[dict]:
             line = next(
                 l for l in out.splitlines() if l.startswith('{"mp_result"')
             )
-            results.append(json.loads(line))
+            rec = json.loads(line)
+            # Keep only the harness's own report lines for assertions —
+            # a failed assert must not dump two full worker stdouts of
+            # XLA noise over the mismatched values.
+            rec["_report_lines"] = [
+                l for l in out.splitlines()
+                if l.startswith("dryrun_multichip ok:")
+            ]
+            results.append(rec)
     finally:
         # A failed/crashed worker must not strand its peer in the Gloo
         # rendezvous (it would outlive the test run blocked on a dead
@@ -87,3 +96,16 @@ def test_two_process_tp_serving():
     results = _run_workers(SERVE_WORKER, [])
     assert results[0]["replies"] == results[1]["replies"], results
     assert len(results[0]["replies"]) == 2
+
+
+@pytest.mark.slow
+def test_two_process_ring_attention_sp8():
+    """sp=8 over two processes: the decoder's ring attention ppermutes
+    K/V blocks around a ring that crosses the process boundary — the
+    single-box analog of ring attention over ICI/DCN on a pod. Runs the
+    exact driver-facing dryrun program (__graft_entry__._dryrun_one_mesh)
+    and requires the identical finite loss on both processes."""
+    results = _run_workers(RING_WORKER, [])
+    ok_lines = [r["_report_lines"][0] for r in results]
+    assert "sp=8 attn=ring" in ok_lines[0], ok_lines
+    assert ok_lines[0] == ok_lines[1], ok_lines
