@@ -1,0 +1,176 @@
+"""Minimal pure-python reader for XLA profiler traces (xplane.pb).
+
+`jax.profiler.trace` writes TensorBoard-format `*.xplane.pb` files, but
+the usual consumers (tensorboard_plugin_profile + a matching tensorflow
+pywrap build) are version-locked and broken on this box. The XSpace
+schema is stable and tiny, and protobuf wire format skips unknown
+fields, so this module decodes just the subset an op-level summary
+needs: planes -> lines -> events, with per-plane event-metadata names.
+
+Field numbers follow tsl/profiler/protobuf/xplane.proto:
+  XSpace.planes=1; XPlane.name=2 .lines=3 .event_metadata=4(map);
+  XLine.name=2 .events=4; XEvent.metadata_id=1 .duration_ps=3;
+  XEventMetadata(map value).id=1 .name=2 .display_name=4.
+
+No dependency on tensorflow or protobuf. Used by
+scripts/capture_trace.py for the on-chip "profile, iterate" loop.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass, field
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    value: int for varint/fixed, bytes for length-delimited."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:  # varint
+            val, i = _varint(buf, i)
+        elif wtype == 2:  # length-delimited
+            ln, i = _varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wtype == 5:  # 32-bit
+            val = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        elif wtype == 1:  # 64-bit
+            val = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        else:  # groups (3/4) do not occur in proto3 xplane
+            raise ValueError(f"unsupported wire type {wtype}")
+        yield fnum, wtype, val
+
+
+@dataclass
+class Event:
+    name: str
+    duration_ps: int
+
+
+@dataclass
+class Line:
+    name: str
+    events: list[Event] = field(default_factory=list)
+
+
+@dataclass
+class Plane:
+    name: str
+    lines: list[Line] = field(default_factory=list)
+
+
+def _parse_event(buf: bytes) -> tuple[int, int]:
+    meta_id = dur = 0
+    for fnum, _, val in _fields(buf):
+        if fnum == 1:
+            meta_id = val
+        elif fnum == 3:
+            dur = val
+    return meta_id, dur
+
+
+def _parse_metadata_entry(buf: bytes) -> tuple[int, str]:
+    """One map<int64, XEventMetadata> entry → (id, best name)."""
+    key, name, display = 0, "", ""
+    for fnum, _, val in _fields(buf):
+        if fnum == 1:
+            key = val
+        elif fnum == 2:
+            for f2, _, v2 in _fields(val):
+                if f2 == 2:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 4:
+                    display = v2.decode("utf-8", "replace")
+    return key, display or name
+
+
+def _parse_line(buf: bytes, names: dict[int, str]) -> Line:
+    line = Line(name="")
+    for fnum, _, val in _fields(buf):
+        if fnum == 2:
+            line.name = val.decode("utf-8", "replace")
+        elif fnum == 4:
+            meta_id, dur = _parse_event(val)
+            line.events.append(Event(names.get(meta_id, str(meta_id)), dur))
+    return line
+
+
+def _parse_plane(buf: bytes) -> Plane:
+    name = ""
+    metadata: dict[int, str] = {}
+    line_bufs: list[bytes] = []
+    for fnum, _, val in _fields(buf):
+        if fnum == 2:
+            name = val.decode("utf-8", "replace")
+        elif fnum == 3:
+            line_bufs.append(val)
+        elif fnum == 4:
+            k, v = _parse_metadata_entry(val)
+            metadata[k] = v
+    return Plane(
+        name, [_parse_line(b, metadata) for b in line_bufs]
+    )
+
+
+def parse_xspace(path: str) -> list[Plane]:
+    with open(path, "rb") as f:
+        buf = f.read()
+    return [
+        _parse_plane(val) for fnum, _, val in _fields(buf) if fnum == 1
+    ]
+
+
+def find_xplane_files(trace_dir: str) -> list[str]:
+    return sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        )
+    )
+
+
+def op_totals(
+    planes: list[Plane],
+    plane_filter: str = "",
+    line_filter: str = "",
+) -> dict[str, int]:
+    """Total duration_ps per event name over matching planes/lines.
+
+    TPU device planes are named like '/device:TPU:0' with 'XLA Ops' /
+    'XLA Modules' lines; pass plane_filter='TPU', line_filter='Ops' for
+    a per-op device-time profile."""
+    totals: dict[str, int] = {}
+    for plane in planes:
+        if plane_filter and plane_filter not in plane.name:
+            continue
+        for line in plane.lines:
+            if line_filter and line_filter not in line.name:
+                continue
+            for ev in line.events:
+                totals[ev.name] = totals.get(ev.name, 0) + ev.duration_ps
+    return totals
+
+
+def top_ops(
+    planes: list[Plane], n: int = 25, **kw
+) -> list[tuple[str, float]]:
+    """Top-n (name, total_ms) by duration."""
+    totals = op_totals(planes, **kw)
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:n]
+    return [(name, ps / 1e9) for name, ps in ranked]

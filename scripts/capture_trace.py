@@ -1,0 +1,100 @@
+"""Capture an XLA profiler trace of the bench train step and print an
+op-level summary — the "profile, iterate" loop for MFU work.
+
+Runs the same geometry/config selection as bench.py (same env knobs:
+BENCH_REMAT_POLICY, BENCH_LOSS_CHUNK, BENCH_MOMENT_DTYPE, BENCH_BATCH,
+BENCH_SEQ), warms up, then traces TRACE_STEPS steps with
+jax.profiler.trace and decodes the written xplane.pb with the
+dependency-free reader in oryx_tpu/utils/xplane.py (the TF/tensorboard
+profile tooling on this box is version-broken). Prints one JSON line:
+top ops by total device time (TPU plane when present, host plane as
+fallback on CPU smoke runs).
+
+    TRACE_DIR=/tmp/oryx_trace python scripts/capture_trace.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRACE_STEPS = int(os.environ.get("TRACE_STEPS", "3"))
+TOP_N = int(os.environ.get("TRACE_TOP_N", "30"))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _bench_cfg, _make_batch, chip_info
+    from oryx_tpu.models import oryx
+    from oryx_tpu.train import step as step_lib
+    from oryx_tpu.train.optimizer import make_optimizer
+    from oryx_tpu.utils import xplane
+
+    trace_dir = os.environ.get("TRACE_DIR", "/tmp/oryx_trace")
+    backend = jax.default_backend()
+    _, hbm, _ = chip_info(jax)
+    geo_name, cfg, batch_size, seq_bucket, img_side = _bench_cfg(backend, hbm)
+    host = _make_batch(cfg, batch_size, seq_bucket, img_side)
+    batch = {k: jnp.asarray(v)[None] for k, v in host.items()}
+
+    params = oryx.init_params(cfg, jax.random.key(0))
+    tx = make_optimizer(cfg.train, params)
+    state = step_lib.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params)
+    )
+
+    # Warmup outside the trace: compile noise would dominate the profile.
+    for _ in range(2):
+        state, metrics = step_lib.train_step(state, batch, cfg, tx)
+    jax.device_get(metrics["loss"])
+
+    with jax.profiler.trace(trace_dir):
+        for _ in range(TRACE_STEPS):
+            state, metrics = step_lib.train_step(state, batch, cfg, tx)
+        jax.device_get(metrics["loss"])
+
+    files = xplane.find_xplane_files(trace_dir)
+    if not files:
+        print(json.dumps({"error": "no_xplane_written", "dir": trace_dir}))
+        raise SystemExit(1)
+    planes = xplane.parse_xspace(files[-1])
+    device = xplane.top_ops(planes, n=TOP_N, plane_filter="TPU",
+                            line_filter="Ops")
+    if device:
+        source, top = "tpu_xla_ops", device
+    else:
+        # Host fallback (CPU smoke): exclude any "Modules" aggregate
+        # lines — a module event contains its ops' time, so summing both
+        # would double-count and let one jit_train_step entry swamp the
+        # per-op ranking.
+        host_planes = [
+            xplane.Plane(
+                p.name,
+                [l for l in p.lines if "Modules" not in l.name],
+            )
+            for p in planes
+        ]
+        source, top = "host_fallback", xplane.top_ops(host_planes, n=TOP_N)
+    print(json.dumps({
+        "metric": "trace_top_ops",
+        "geometry": geo_name,
+        "steps": TRACE_STEPS,
+        "backend": backend,
+        "source": source,
+        "planes": [p.name for p in planes],
+        "xplane": files[-1],
+        "top_ops_ms": [
+            {"op": name, "ms": round(ms, 3)} for name, ms in top
+        ],
+    }))
+
+
+if __name__ == "__main__":
+    main()

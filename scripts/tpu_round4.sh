@@ -123,6 +123,9 @@ step smoke_eval_ll 1800 python scripts/make_smoke_eval.py --out /tmp/smoke_tpu \
   --run --scoring loglikelihood --result "$OUT/smoke_result_tpu.json"
 step components64 3600 env COMPONENT_FRAMES=64 python scripts/bench_components.py
 step components256 3600 env COMPONENT_FRAMES=256 python scripts/bench_components.py
+# Op-level device profile of the default bench step (the round-5 MFU
+# optimization map); the xplane artifact stays under $OUT.
+step trace 3600 env TRACE_DIR="$OUT/trace" python scripts/capture_trace.py
 
 echo "== done; results in $OUT (fail=$fail) =="
 exit "$fail"
