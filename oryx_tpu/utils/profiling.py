@@ -11,6 +11,7 @@ north-star metric (tokens/sec/chip) continuously.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
 from typing import Iterator
 
@@ -38,6 +39,61 @@ def annotate(name: str):
     """Context manager naming a region in the profiler timeline. Wrap host
     dispatch of model phases (vit / compressor / decoder / data)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+@dataclasses.dataclass
+class OpProfile:
+    """Result of op_profile: ranked (name, total_ms) plus provenance —
+    `source` distinguishes real device op time ("tpu_xla_ops") from the
+    host-event fallback ("host_fallback"), which measures python/dispatch
+    and must never be mistaken for device time when optimizing."""
+
+    top: list[tuple[str, float]]
+    source: str
+    xplane_path: str
+    plane_names: list[str]
+
+
+def op_profile(
+    fn, *args, trace_dir: str, steps: int = 3, top_n: int = 25, sync=None
+) -> OpProfile:
+    """Run `fn(*args)` `steps` times under a trace and return an
+    OpProfile: top ops by total device time — self-contained: the
+    written xplane.pb is decoded by utils/xplane.py, no TensorBoard
+    tooling needed. On TPU this reads the 'XLA Ops' device lines; on CPU
+    it falls back to host events (module aggregates excluded), flagged
+    via `.source`.
+
+    fn should already be compiled (call it once beforehand) — compile
+    time inside the trace would swamp the profile. `sync` receives the
+    last result and must block on it (default: jax.block_until_ready;
+    pass a device_get-based sync over remote transports where
+    block_until_ready is a no-op)."""
+    from oryx_tpu.utils import xplane
+
+    sync = sync or jax.block_until_ready
+    with trace(trace_dir):
+        out = None
+        for _ in range(steps):
+            out = fn(*args)
+        sync(out)
+    files = xplane.find_xplane_files(trace_dir)
+    if not files:
+        raise RuntimeError(f"no xplane.pb written under {trace_dir}")
+    planes = xplane.parse_xspace(files[-1])
+    names = [p.name for p in planes]
+    device = xplane.top_ops(
+        planes, n=top_n, plane_filter="TPU", line_filter="Ops"
+    )
+    if device:
+        return OpProfile(device, "tpu_xla_ops", files[-1], names)
+    host = [
+        xplane.Plane(p.name, [l for l in p.lines if "Modules" not in l.name])
+        for p in planes
+    ]
+    return OpProfile(
+        xplane.top_ops(host, n=top_n), "host_fallback", files[-1], names
+    )
 
 
 class StepTimer:

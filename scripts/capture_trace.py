@@ -35,7 +35,7 @@ def main() -> None:
     from oryx_tpu.models import oryx
     from oryx_tpu.train import step as step_lib
     from oryx_tpu.train.optimizer import make_optimizer
-    from oryx_tpu.utils import xplane
+    from oryx_tpu.utils import profiling
 
     trace_dir = os.environ.get("TRACE_DIR", "/tmp/oryx_trace")
     backend = jax.default_backend()
@@ -51,47 +51,40 @@ def main() -> None:
     )
 
     # Warmup outside the trace: compile noise would dominate the profile.
+    # The carry threads through so every traced step is a REAL step (a
+    # repeated identical step could be elided by donation aliasing).
+    holder = {"state": state}
+
+    def one_step():
+        holder["state"], metrics = step_lib.train_step(
+            holder["state"], batch, cfg, tx
+        )
+        return metrics["loss"]
+
     for _ in range(2):
-        state, metrics = step_lib.train_step(state, batch, cfg, tx)
-    jax.device_get(metrics["loss"])
+        loss = one_step()
+    jax.device_get(loss)
 
-    with jax.profiler.trace(trace_dir):
-        for _ in range(TRACE_STEPS):
-            state, metrics = step_lib.train_step(state, batch, cfg, tx)
-        jax.device_get(metrics["loss"])
-
-    files = xplane.find_xplane_files(trace_dir)
-    if not files:
-        print(json.dumps({"error": "no_xplane_written", "dir": trace_dir}))
+    try:
+        prof = profiling.op_profile(
+            one_step, trace_dir=trace_dir, steps=TRACE_STEPS, top_n=TOP_N,
+            sync=jax.device_get,  # block_until_ready is a no-op over axon
+        )
+    except RuntimeError as e:  # no xplane written (e.g. trace aborted)
+        print(json.dumps({"error": "no_xplane_written", "detail": str(e)}))
         raise SystemExit(1)
-    planes = xplane.parse_xspace(files[-1])
-    device = xplane.top_ops(planes, n=TOP_N, plane_filter="TPU",
-                            line_filter="Ops")
-    if device:
-        source, top = "tpu_xla_ops", device
-    else:
-        # Host fallback (CPU smoke): exclude any "Modules" aggregate
-        # lines — a module event contains its ops' time, so summing both
-        # would double-count and let one jit_train_step entry swamp the
-        # per-op ranking.
-        host_planes = [
-            xplane.Plane(
-                p.name,
-                [l for l in p.lines if "Modules" not in l.name],
-            )
-            for p in planes
-        ]
-        source, top = "host_fallback", xplane.top_ops(host_planes, n=TOP_N)
     print(json.dumps({
         "metric": "trace_top_ops",
         "geometry": geo_name,
         "steps": TRACE_STEPS,
         "backend": backend,
-        "source": source,
-        "planes": [p.name for p in planes],
-        "xplane": files[-1],
+        # source=host_fallback on a TPU run means the device plane was
+        # NOT found — host dispatch noise, not device op time.
+        "source": prof.source,
+        "planes": prof.plane_names,
+        "xplane": prof.xplane_path,
         "top_ops_ms": [
-            {"op": name, "ms": round(ms, 3)} for name, ms in top
+            {"op": name, "ms": round(ms, 3)} for name, ms in prof.top
         ],
     }))
 

@@ -98,3 +98,23 @@ def test_parse_real_jax_trace(tmp_path):
     totals = xplane.op_totals(planes)
     assert totals and max(totals.values()) > 0
     assert any(name and not name.isdigit() for name in totals)
+
+
+@pytest.mark.slow
+def test_op_profile_end_to_end(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.utils import profiling
+
+    f = jax.jit(lambda x: jnp.sum(x @ x))
+    x = jnp.ones((64, 64))
+    f(x)  # compile outside the trace
+    prof = profiling.op_profile(
+        f, x, trace_dir=str(tmp_path), steps=2, top_n=10,
+        sync=jax.device_get,
+    )
+    assert prof.source in ("tpu_xla_ops", "host_fallback")
+    assert prof.top and all(ms >= 0 for _, ms in prof.top)
+    assert all(isinstance(name, str) and name for name, _ in prof.top)
+    assert prof.xplane_path.endswith(".xplane.pb") and prof.plane_names
