@@ -83,13 +83,18 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    initialization_timeout: int = 300,
 ) -> None:
     """Multi-host rendezvous — the NCCL/env-var `init_process_group`
-    equivalent (SURVEY.md §2c). On TPU pods arguments are auto-detected."""
+    equivalent (SURVEY.md §2c). On TPU pods arguments are auto-detected.
+    initialization_timeout covers slow peers (a contended host importing
+    jax can keep the coordinator waiting for minutes)."""
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        initialization_timeout=initialization_timeout,
     )
 
 

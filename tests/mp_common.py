@@ -23,7 +23,12 @@ def bootstrap():
 
     from oryx_tpu.parallel import mesh as mesh_lib
 
-    mesh_lib.initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+    # Generous rendezvous window: under a full-suite run all three
+    # processes (pytest + 2 workers) contend for this box's single CPU
+    # core, and a worker's jax import alone can take minutes.
+    mesh_lib.initialize_distributed(
+        f"127.0.0.1:{port}", 2, pid, initialization_timeout=600
+    )
     assert jax.process_count() == 2
     assert jax.device_count() == 8 and len(jax.local_devices()) == 4
     return pid, jax

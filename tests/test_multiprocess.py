@@ -46,8 +46,13 @@ def _run_workers(worker: str, extra_args: list[str]) -> list[dict]:
     results = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=540)
-            assert p.returncode == 0, (out[-800:], err[-1500:])
+            # Must exceed the workers' 600s rendezvous window
+            # (mp_common.bootstrap) or a slow rendezvous times out HERE
+            # first, killing the workers before they can report anything.
+            out, err = p.communicate(timeout=900)
+            # Generous stderr tail: a worker's jax traceback is long, and
+            # this message is the ONLY diagnostic a CI failure preserves.
+            assert p.returncode == 0, (out[-800:], err[-4000:])
             line = next(
                 l for l in out.splitlines() if l.startswith('{"mp_result"')
             )
