@@ -25,6 +25,7 @@
 #include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstring>
 #include <thread>
 #include <vector>
 
@@ -53,13 +54,21 @@ Taps make_taps(int dst, int src) {
   return t;
 }
 
-template <typename T>
-inline float load_norm(const T* img, long idx, float scale, float mean,
-                       float inv_std) {
-  return (static_cast<float>(img[idx]) * scale - mean) * inv_std;
-}
-
 // One image: resize to (out_h, out_w), normalize, write patch rows.
+//
+// Separable, horizontal-first with a two-row cache — the naive
+// per-pixel 4-tap gather with patch-scattered writes defeats
+// auto-vectorization and measured 0.6x numpy's vectorized path per core
+// on this repo's build box:
+//   1. each NEEDED source row is horizontally resampled + normalized
+//      once into a cached out_w*C row (the only gather pass; cached by
+//      source row index, so upscale reuses rows and downscale touches
+//      each source row at most once — cost scales with out_w, never W);
+//   2. vertical 2-tap blend of the two cached rows, contiguous and
+//      auto-vectorizable;
+//   3. one contiguous memcpy per horizontal patch into patch layout.
+// Normalization commutes with bilinear blending (both linear), so values
+// match the previous kernel to fp rounding (tests pin 1e-4).
 template <typename T>
 void preprocess_one(const T* img, int H, int W, int C, int out_h, int out_w,
                     int patch, float mean, float inv_std, float px_scale,
@@ -69,26 +78,49 @@ void preprocess_one(const T* img, int H, int W, int C, int out_h, int out_w,
   const int gw = out_w / patch;
   const int patch_dim = patch * patch * C;
   const long rowW = static_cast<long>(W) * C;
-  for (int y = 0; y < out_h; ++y) {
-    const long y0 = ty.lo[y] * rowW, y1 = ty.hi[y] * rowW;
-    const float fy = ty.frac[y];
-    const int gy = y / patch, py = y % patch;
+  const long rowO = static_cast<long>(out_w) * C;
+  const float a = px_scale * inv_std;  // (v*px_scale - mean)*inv_std
+  const float b = -mean * inv_std;     //   == v*a + b
+  std::vector<float> cache[2] = {std::vector<float>(rowO),
+                                 std::vector<float>(rowO)};
+  int cached_src[2] = {-1, -1};
+  // `protect` pins the slot holding the OTHER row this y needs: without
+  // it, computing the hi row could evict the lo row's slot while the
+  // caller still holds a pointer into it.
+  auto hrow = [&](int src_y, int protect) -> const float* {
+    for (int s = 0; s < 2; ++s) {
+      if (cached_src[s] == src_y) return cache[s].data();
+    }
+    const int s = (cached_src[0] == protect) ? 1 : 0;
+    const T* r = img + static_cast<long>(src_y) * rowW;
+    float* d = cache[s].data();
     for (int x = 0; x < out_w; ++x) {
-      const long x0 = static_cast<long>(tx.lo[x]) * C;
-      const long x1 = static_cast<long>(tx.hi[x]) * C;
+      const T* p0 = r + static_cast<long>(tx.lo[x]) * C;
+      const T* p1 = r + static_cast<long>(tx.hi[x]) * C;
       const float fx = tx.frac[x];
-      const int gx = x / patch, pxi = x % patch;
-      float* dst = out + static_cast<long>(gy * gw + gx) * patch_dim +
-                   (static_cast<long>(py) * patch + pxi) * C;
       for (int c = 0; c < C; ++c) {
-        const float tl = load_norm(img, y0 + x0 + c, px_scale, mean, inv_std);
-        const float tr = load_norm(img, y0 + x1 + c, px_scale, mean, inv_std);
-        const float bl = load_norm(img, y1 + x0 + c, px_scale, mean, inv_std);
-        const float br = load_norm(img, y1 + x1 + c, px_scale, mean, inv_std);
-        const float top = tl + (tr - tl) * fx;
-        const float bot = bl + (br - bl) * fx;
-        dst[c] = top + (bot - top) * fy;
+        const float v0 = static_cast<float>(p0[c]);
+        const float v1 = static_cast<float>(p1[c]);
+        d[static_cast<long>(x) * C + c] = (v0 + (v1 - v0) * fx) * a + b;
       }
+    }
+    cached_src[s] = src_y;
+    return d;
+  };
+  std::vector<float> orow(rowO);
+  for (int y = 0; y < out_h; ++y) {
+    const float* r0 = hrow(ty.lo[y], -1);
+    const float* r1 = hrow(ty.hi[y], ty.lo[y]);
+    const float fy = ty.frac[y];
+    for (long i = 0; i < rowO; ++i) {
+      orow[i] = r0[i] + (r1[i] - r0[i]) * fy;
+    }
+    const int gy = y / patch, py = y % patch;
+    for (int gx = 0; gx < gw; ++gx) {
+      float* dst = out + static_cast<long>(gy * gw + gx) * patch_dim +
+                   static_cast<long>(py) * patch * C;
+      std::memcpy(dst, orow.data() + static_cast<long>(gx) * patch * C,
+                  sizeof(float) * patch * C);
     }
   }
 }
