@@ -1,0 +1,110 @@
+"""AOT memory estimate of the bench-geometry train step per remat
+policy — no TPU needed.
+
+Lowers + compiles the full SFT step for the REAL bench geometry
+(bench._bench_cfg's TPU branch) on one CPU device from
+ShapeDtypeStructs (no 0.7B params materialized) and reads the
+compiler's memory analysis. Argument bytes are exact arithmetic
+(params + AdamW state + batch); temp bytes are the CPU compiler's
+estimate — fusion details differ from TPU, but the DELTAS between remat
+policies are dominated by the saved-residual buffers, which exist
+identically on both backends. Use it to sanity-check whether a policy
+plausibly fits the 16 GB v5e before spending chip time.
+
+    python scripts/estimate_remat_memory.py [policy ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GB = 1024**3
+
+
+def one(policy: str, moment_dtype: str = "float32") -> dict:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _bench_cfg, _make_batch
+    from oryx_tpu.models import oryx
+    from oryx_tpu.train import step as step_lib
+    from oryx_tpu.train.optimizer import make_optimizer
+
+    geo, cfg, batch_size, seq_bucket, img_side = _bench_cfg(
+        "tpu", 16 * GB
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        attn_impl="xla",  # CPU-compilable; attention residuals same shape
+        train=dataclasses.replace(
+            cfg.train, remat=policy != "none", moment_dtype=moment_dtype,
+            remat_policy=policy if policy != "none" else "block",
+        ),
+    )
+    host = _make_batch(cfg, batch_size, seq_bucket, img_side)
+
+    params_shape = jax.eval_shape(
+        lambda: oryx.init_params(cfg, jax.random.key(0))
+    )
+    tx = make_optimizer(cfg.train, params_shape)
+    opt_shape = jax.eval_shape(tx.init, params_shape)
+    state_in = step_lib.TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        params=params_shape,
+        opt_state=opt_shape,
+    )
+    batch = {
+        k: jax.ShapeDtypeStruct((1, *v.shape), jnp.asarray(v).dtype)
+        for k, v in host.items()
+    }
+    jit_step = jax.jit(
+        step_lib.train_step_fn, static_argnames=("cfg", "tx"),
+        donate_argnames=("state",),
+    )
+    compiled = jit_step.lower(state_in, batch, cfg=cfg, tx=tx).compile()
+    ma = compiled.memory_analysis()
+    overrides = {
+        k: os.environ[k]
+        for k in ("BENCH_BATCH", "BENCH_SEQ", "BENCH_LOSS_CHUNK")
+        if os.environ.get(k)
+    }
+    return {
+        "geometry": geo,
+        "policy": policy,
+        "moment_dtype": moment_dtype,
+        # Inherited bench env overrides, recorded so a sweep-polluted
+        # shell can't pass these numbers off as the default geometry.
+        **({"env_overrides": overrides} if overrides else {}),
+        "args_gb": round(ma.argument_size_in_bytes / GB, 2),
+        "temp_gb": round(ma.temp_size_in_bytes / GB, 2),
+        "total_gb": round(
+            (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+             + ma.output_size_in_bytes - ma.alias_size_in_bytes) / GB, 2
+        ),
+    }
+
+
+def main() -> None:
+    cases = [("block", "float32"), ("attn", "float32"),
+             ("attn_qkv", "float32"), ("attn_o", "float32"),
+             ("attn_o", "bfloat16")]
+    if len(sys.argv) > 1:
+        # "policy" or "policy:moment_dtype" (e.g. attn_o:bfloat16).
+        cases = [
+            (p.split(":")[0], p.split(":")[1] if ":" in p else "float32")
+            for p in sys.argv[1:]
+        ]
+    for policy, mdt in cases:
+        print(json.dumps(one(policy, mdt)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
