@@ -100,6 +100,16 @@ class TrainConfig:
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
     max_grad_norm: float = 1.0
+    # Skip the optimizer update on steps whose loss or global grad norm
+    # is non-finite (DeepSpeed skip-on-overflow analog for bf16 spikes):
+    # params/moments keep their previous values, metrics gain a
+    # "skipped" flag, and training continues. Off by default — skipping
+    # can mask real divergence; turn on for long unattended pod runs.
+    skip_nonfinite_steps: bool = False
+    # With the guard on, abort after this many CONSECUTIVE skipped steps
+    # — persistently poisoned data must kill the run, not silently no-op
+    # a pod forever (Trainer.fit raises RuntimeError).
+    max_consecutive_skipped: int = 20
     # Dtype for Adam's first moment ("float32" | "bfloat16"). bf16 halves
     # the m buffer (~1.4 GB at the 0.7B bench geometry) at negligible
     # quality cost — the variance buffer stays fp32 because its tiny

@@ -133,11 +133,29 @@ def train_step_fn(
 
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
+    gnorm = optax.global_norm(grads)
     metrics = {
         "loss": loss_sum / accum,
-        "grad_norm": optax.global_norm(grads),
+        "grad_norm": gnorm,
         "num_tokens": ntok,
     }
+    if cfg.train.skip_nonfinite_steps:
+        # Anomalous-step guard (DeepSpeed's skip-on-overflow analog for
+        # bf16: a poisoned batch or data-driven spike must not write NaNs
+        # into params/moments). The update is computed regardless and
+        # SELECTED against — a lax.cond would re-shard both branches'
+        # state under GSPMD for no real saving, while the select fuses.
+        ok = jnp.isfinite(loss_sum) & jnp.isfinite(gnorm)
+        params = jax.tree.map(
+            lambda new, old: jnp.where(ok, new, old), params, state.params
+        )
+        opt_state = jax.tree.map(
+            lambda new, old: (
+                jnp.where(ok, new, old) if hasattr(new, "dtype") else new
+            ),
+            opt_state, state.opt_state,
+        )
+        metrics["skipped"] = (~ok).astype(jnp.int32)
     return (
         TrainState(step=state.step + 1, params=params, opt_state=opt_state),
         metrics,

@@ -175,6 +175,7 @@ class Trainer:
             from oryx_tpu.train.data import PrefetchIterator
 
             batches = prefetcher = PrefetchIterator(batches, depth=prefetch)
+        consecutive_skipped = 0
         try:
             with jax.sharding.set_mesh(self.mesh):
                 for step_i in range(start, num_steps):
@@ -191,7 +192,24 @@ class Trainer:
                     self.state, metrics = self._step(
                         self.state, batch, cfg=cfg, tx=self.tx
                     )
-                    self.logger.log_step(step_i + 1, jax.device_get(metrics))
+                    host_metrics = jax.device_get(metrics)
+                    self.logger.log_step(step_i + 1, host_metrics)
+                    if int(host_metrics.get("skipped", 0)):
+                        consecutive_skipped += 1
+                        if (
+                            consecutive_skipped
+                            >= cfg.train.max_consecutive_skipped
+                        ):
+                            # Persistently non-finite: a silent no-op pod
+                            # is worse than a dead one (params frozen,
+                            # checkpoints advancing, compute burning).
+                            raise RuntimeError(
+                                f"{consecutive_skipped} consecutive "
+                                "non-finite steps skipped — aborting "
+                                "(see train.max_consecutive_skipped)"
+                            )
+                    else:
+                        consecutive_skipped = 0
                     if (step_i + 1) % cfg.train.checkpoint_every == 0:
                         self.ckpt.save(step_i + 1, self.state)
         finally:

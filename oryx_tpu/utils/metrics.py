@@ -57,9 +57,13 @@ class MetricLogger:
         self._last_time = time.perf_counter()
         self._last_step = 0
         self._tokens_since = 0
+        self._skipped_since = 0
 
     def log_step(self, step: int, metrics: dict[str, Any]) -> None:
         self._tokens_since += int(metrics.get("num_tokens", 0))
+        # Accumulated, not sampled: a skip on a step that isn't a
+        # log_every multiple must still show in the next record.
+        self._skipped_since += int(metrics.get("skipped", 0))
         if step % self.log_every != 0:
             return
         now = time.perf_counter()
@@ -70,13 +74,16 @@ class MetricLogger:
             "step": step,
             **{
                 k: float(v) for k, v in metrics.items()
-                if k != "num_tokens"
+                if k not in ("num_tokens", "skipped")
             },
             "steps_per_sec": nsteps / dt,
             "tokens_per_sec_per_chip": self._tokens_since / dt / n_chips,
         }
+        if "skipped" in metrics:
+            rec["skipped"] = self._skipped_since
         self._last_time, self._last_step = now, step
         self._tokens_since = 0
+        self._skipped_since = 0
         rank0_print(
             f"step {step}: " + " ".join(
                 f"{k}={v:.4g}" for k, v in rec.items() if k != "step"

@@ -1,0 +1,106 @@
+"""skip_nonfinite_steps (train/step.py): a poisoned batch must not write
+NaNs into params or optimizer state when the guard is on — and must
+(the default) when it is off, proving the guard is really the thing
+protecting the state."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.train import step as step_lib
+from oryx_tpu.train.optimizer import make_optimizer
+
+from tests.test_trainer_modes import _batch
+
+
+def _poisoned(cfg):
+    host = _batch(cfg)
+    host = dict(host)
+    host["patches"] = np.full_like(host["patches"], np.inf)
+    return host
+
+
+def _run_step(cfg, host, steps=1):
+    params = oryx.init_params(cfg, jax.random.key(0))
+    params0 = jax.tree.map(np.asarray, params)  # step donates params
+    tx = make_optimizer(cfg.train, params)
+    state = step_lib.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params)
+    )
+    batch = {k: jnp.asarray(v)[None] for k, v in host.items()}
+    for _ in range(steps):
+        state, metrics = step_lib.train_step(state, batch, cfg, tx)
+    return params0, state, jax.device_get(metrics)
+
+
+@pytest.mark.parametrize("skip", [True, False])
+def test_poisoned_batch(skip):
+    base = cfg_lib.oryx_tiny()
+    cfg = dataclasses.replace(
+        base, train=dataclasses.replace(base.train, skip_nonfinite_steps=skip)
+    )
+    params0, state, metrics = _run_step(cfg, _poisoned(cfg))
+    assert not np.isfinite(metrics["loss"])
+    leaves = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+    if skip:
+        assert metrics["skipped"] == 1
+        # Params untouched; every state leaf still finite.
+        for a, b in zip(jax.tree.leaves(params0), leaves):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        assert all(
+            np.isfinite(np.asarray(l)).all()
+            for l in jax.tree.leaves(state.opt_state)
+            if hasattr(l, "dtype")
+        )
+        assert int(state.step) == 1  # data progress still advances
+    else:
+        # Without the guard the poison really does reach the params —
+        # the counterfactual that makes the skip=True leg meaningful.
+        assert not all(np.isfinite(l).all() for l in leaves)
+
+
+def test_trainer_aborts_after_consecutive_skips():
+    """Persistently poisoned data must kill the run, not no-op forever."""
+    from oryx_tpu.train.trainer import Trainer
+
+    base = cfg_lib.oryx_tiny()
+    cfg = dataclasses.replace(
+        base,
+        mesh=cfg_lib.MeshConfig(dp=2, fsdp=4),
+        train=dataclasses.replace(
+            base.train, skip_nonfinite_steps=True,
+            max_consecutive_skipped=3, num_train_steps=10, log_every=100,
+            checkpoint_every=100, checkpoint_dir="/tmp/skip_abort_ckpt",
+        ),
+    )
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh (conftest)")
+    bad = _poisoned(cfg)
+    t = Trainer(cfg, sharding_mode="fsdp")
+    with pytest.raises(RuntimeError, match="consecutive non-finite"):
+        t.fit(iter([bad] * 10), num_steps=10, resume=False, prefetch=0)
+
+
+def test_good_batch_not_skipped():
+    base = cfg_lib.oryx_tiny()
+    cfg = dataclasses.replace(
+        base,
+        train=dataclasses.replace(base.train, skip_nonfinite_steps=True),
+    )
+    # 3 steps: step 1's warmup lr is 0.0, so movement shows from step 2.
+    params0, state, metrics = _run_step(cfg, _batch(cfg), steps=3)
+    assert np.isfinite(metrics["loss"]) and metrics["skipped"] == 0
+    # The update applied: params moved.
+    moved = any(
+        np.max(np.abs(np.asarray(a) - np.asarray(b))) > 0
+        for a, b in zip(
+            jax.tree.leaves(params0), jax.tree.leaves(state.params)
+        )
+    )
+    assert moved
