@@ -167,6 +167,7 @@ class _Pending:
         self.done = threading.Event()
         self.reply: str | None = None
         self.finish_reason: str = "stop"
+        self.usage: tuple[int, int] | None = None
         self.error: str | None = None
 
     @property
@@ -246,18 +247,19 @@ class Batcher:
             s = first.sampling
             try:
                 with self.device_lock:
-                    replies, reasons = self.pipe.chat_batch(
+                    replies, reasons, counts = self.pipe.chat_batch(
                         [p.request for p in group],
                         max_new_tokens=_decode_bucket(first.max_new),
                         per_row_max=[p.max_new for p in group],
                         return_finish_reasons=True,
+                        return_token_counts=True,
                         temperature=s.get("temperature"),
                         top_p=s.get("top_p"),
                         stop=s.get("stop"),
                         seed=s.get("seed") or 0,
                     )
-                for p, r, why in zip(group, replies, reasons):
-                    p.reply, p.finish_reason = r, why
+                for p, r, why, use in zip(group, replies, reasons, counts):
+                    p.reply, p.finish_reason, p.usage = r, why, use
             except Exception as e:  # surface per-request, keep serving
                 for p in group:
                     p.error = f"{type(e).__name__}: {e}"
@@ -303,9 +305,10 @@ def _parse_sampling(req: dict[str, Any]) -> dict[str, Any]:
 
 
 def _completion_body(
-    model: str, reply: str, finish_reason: str = "stop"
+    model: str, reply: str, finish_reason: str = "stop",
+    usage: tuple[int, int] | None = None,
 ) -> dict[str, Any]:
-    return {
+    body = {
         "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
         "object": "chat.completion",
         "created": int(time.time()),
@@ -316,6 +319,14 @@ def _completion_body(
             "finish_reason": finish_reason,
         }],
     }
+    if usage is not None:
+        prompt, completion = usage
+        body["usage"] = {
+            "prompt_tokens": prompt,
+            "completion_tokens": completion,
+            "total_tokens": prompt + completion,
+        }
+    return body
 
 
 def _chunk_body(
@@ -498,7 +509,8 @@ def build_server(
                 self._json(500, {"error": {"message": pending.error}})
             else:
                 self._json(200, _completion_body(
-                    model_name, pending.reply, pending.finish_reason
+                    model_name, pending.reply, pending.finish_reason,
+                    usage=pending.usage,
                 ))
 
         def _sse(self, body: dict[str, Any]) -> None:

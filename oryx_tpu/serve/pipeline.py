@@ -311,11 +311,12 @@ class OryxInference:
         max_new_tokens: int | None = None,
         seed: int = 0,
         return_finish_reasons: bool = False,
+        return_token_counts: bool = False,
         temperature: float | None = None,
         top_p: float | None = None,
         stop: Sequence[str] | None = None,
         per_row_max: Sequence[int] | None = None,
-    ) -> list[str] | tuple[list[str], list[str]]:
+    ) -> list[str] | tuple:
         """Batched single-turn QA: one ViT + compressor + decode scan for
         the whole batch (the batching win the reference gets from varlen
         flash-attn plus HF batched generate; SURVEY.md §3.5).
@@ -333,6 +334,11 @@ class OryxInference:
         cap, and its finish reason reflects the cap, not the shared
         decode window. Greedy/sampled tokens are unchanged by the longer
         window (the step-key split is prefix-stable).
+        return_token_counts: also return per-row (prompt_tokens,
+        completion_tokens) — prompt counts the REAL spliced row length
+        (text + visual tokens, no padding), the OpenAI usage convention.
+        Return shape grows in flag order:
+        replies[, reasons][, counts].
         """
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
@@ -363,6 +369,7 @@ class OryxInference:
             toks, num, fin = self._text_batch(
                 ids_rows, max_new, key, cfg=cfg, stop_seqs=stop_seqs
             )
+            prompt_lens = [len(r) for r in ids_rows]
         else:
             packed = packing.pack_raw_images(
                 all_images,
@@ -380,6 +387,10 @@ class OryxInference:
                     max_new_tokens=max_new, key=key,
                     stop_sequences=stop_seqs,
                 )
+            prompt_lens = [
+                int(np.sum(np.asarray(batch.attn_mask)[b]))
+                for b in range(len(requests))
+            ]
         caps = per_row_max or [max_new] * len(toks)
         replies = [
             self._decode(
@@ -387,14 +398,19 @@ class OryxInference:
             )
             for b in range(len(toks))
         ]
-        if not return_finish_reasons:
-            return replies
-        # A row "stopped" only if its EOS/stop landed within ITS cap.
-        reasons = [
-            "stop" if bool(f) and int(n) <= c else "length"
-            for f, n, c in zip(fin, num, caps)
-        ]
-        return replies, reasons
+        out: tuple = (replies,)
+        if return_finish_reasons:
+            # A row "stopped" only if its EOS/stop landed within ITS cap.
+            out += ([
+                "stop" if bool(f) and int(n) <= c else "length"
+                for f, n, c in zip(fin, num, caps)
+            ],)
+        if return_token_counts:
+            out += ([
+                (prompt_lens[b], min(int(num[b]), caps[b]))
+                for b in range(len(toks))
+            ],)
+        return out[0] if len(out) == 1 else out
 
     def _text_batch(self, ids_rows, max_new: int, key, *, cfg=None,
                     stop_seqs=None):

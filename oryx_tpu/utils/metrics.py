@@ -10,6 +10,7 @@ same record dict if present.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -70,10 +71,18 @@ class MetricLogger:
         dt = max(now - self._last_time, 1e-9)
         nsteps = max(step - self._last_step, 1)
         n_chips = jax.device_count()
+        def js(v):
+            # Non-finite floats serialize as JSON null: with the skip
+            # guard on, a NaN loss is a normal recurring condition, and
+            # json.dumps would otherwise emit the non-RFC `NaN` token
+            # that breaks strict JSONL consumers (jq, JSON.parse).
+            f = float(v)
+            return f if math.isfinite(f) else None
+
         rec = {
             "step": step,
             **{
-                k: float(v) for k, v in metrics.items()
+                k: js(v) for k, v in metrics.items()
                 if k not in ("num_tokens", "skipped")
             },
             "steps_per_sec": nsteps / dt,
@@ -86,7 +95,8 @@ class MetricLogger:
         self._skipped_since = 0
         rank0_print(
             f"step {step}: " + " ".join(
-                f"{k}={v:.4g}" for k, v in rec.items() if k != "step"
+                f"{k}={'nan' if v is None else format(v, '.4g')}"
+                for k, v in rec.items() if k != "step"
             )
         )
         if self._f:
@@ -94,7 +104,7 @@ class MetricLogger:
             self._f.flush()
         if self._tb:
             for k, v in rec.items():
-                if k != "step":
+                if k != "step" and v is not None:
                     self._tb.add_scalar(f"train/{k}", v, step)
 
     def close(self) -> None:

@@ -160,16 +160,20 @@ def test_batcher_groups_and_fifo():
 
     class StubPipe:
         def chat_batch(self, requests, max_new_tokens,
-                       return_finish_reasons=False, per_row_max=None,
+                       return_finish_reasons=False,
+                       return_token_counts=False, per_row_max=None,
                        **sampling):
             calls.append((
                 [r["question"] for r in requests], max_new_tokens,
                 list(per_row_max or []),
             ))
             replies = [r["question"].upper() for r in requests]
+            out = (replies,)
             if return_finish_reasons:
-                return replies, ["stop"] * len(replies)
-            return replies
+                out += (["stop"] * len(replies),)
+            if return_token_counts:
+                out += ([(3, 1)] * len(replies),)
+            return out[0] if len(out) == 1 else out
 
     # Generous window: it only delays the first flush, and a tight one
     # would flake under CI load (the grouping below assumes all four
@@ -228,6 +232,14 @@ def test_server_completion_matches_pipeline(server):
     reply = out["choices"][0]["message"]["content"]
     assert out["object"] == "chat.completion"
     assert reply == pipe.chat("hello there", max_new_tokens=5)
+
+    # OpenAI usage accounting: real token counts, not padding.
+    usage = out["usage"]
+    assert usage["prompt_tokens"] > 0
+    assert 0 < usage["completion_tokens"] <= 5
+    assert usage["total_tokens"] == (
+        usage["prompt_tokens"] + usage["completion_tokens"]
+    )
 
     # /v1/models and /healthz answer.
     with urllib.request.urlopen(url + "/v1/models", timeout=30) as r:
@@ -361,13 +373,17 @@ def test_batcher_splits_on_sampling_params():
 
     class StubPipe:
         def chat_batch(self, requests, max_new_tokens,
-                       return_finish_reasons=False, **sampling):
+                       return_finish_reasons=False,
+                       return_token_counts=False, **sampling):
             calls.append((
                 [r["question"] for r in requests],
                 sampling.get("temperature"),
             ))
             replies = [r["question"].upper() for r in requests]
-            return replies, ["stop"] * len(replies)
+            out = (replies, ["stop"] * len(replies))
+            if return_token_counts:
+                out += ([(3, 1)] * len(replies),)
+            return out
 
     b = api_server.Batcher(StubPipe(), window=2.0, max_batch=8)
     pending = [

@@ -257,6 +257,36 @@ def test_chat_batch_all_text(tiny_model):
     assert all(isinstance(r, str) for r in replies)
 
 
+def test_chat_batch_token_counts(tiny_model):
+    """return_token_counts: prompt counts the REAL spliced length (text +
+    visual tokens, no padding); completion counts generated tokens."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    img = np.random.default_rng(7).integers(
+        0, 255, size=(40, 56, 3), dtype=np.uint8
+    )
+    requests = [
+        {"question": "what is this?", "images": [img]},
+        {"question": "hello there"},
+    ]
+    replies, reasons, counts = pipe.chat_batch(
+        requests, max_new_tokens=4,
+        return_finish_reasons=True, return_token_counts=True,
+    )
+    assert len(counts) == 2
+    (p_img, c_img), (p_txt, c_txt) = counts
+    assert 0 < c_img <= 4 and 0 < c_txt <= 4
+    # The image row's prompt includes its visual tokens: strictly longer
+    # than the text-only row despite a similar question length.
+    assert p_img > p_txt > 0
+
+    # Text-only batch path reports exact prompt lengths too.
+    r2, c2 = pipe.chat_batch(
+        [{"question": "hi"}], max_new_tokens=3, return_token_counts=True
+    )
+    assert len(c2) == 1 and c2[0][0] > 0 and 0 < c2[0][1] <= 3
+
+
 def test_chat_stream_matches_chat(tiny_model):
     """Streamed deltas concatenate to the non-streaming reply (greedy),
     for text-only and image requests, across chunk sizes that do and do
