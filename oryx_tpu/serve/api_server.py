@@ -330,17 +330,29 @@ def _completion_body(
 
 
 def _chunk_body(
-    model: str, cid: str, delta: str | None, finish_reason: str = "stop"
+    model: str, cid: str, delta: str | None, finish_reason: str = "stop",
+    *,
+    usage_field: bool = False,
+    usage: dict[str, int] | None = None,
 ) -> dict[str, Any]:
+    """One chat.completion.chunk. usage_field=True adds the "usage" key
+    per OpenAI's stream_options.include_usage contract: null on every
+    delta chunk, totals on the FINAL chunk (which carries empty choices —
+    pass usage with delta=None and it replaces the finish chunk's
+    choice)."""
     choice: dict[str, Any] = {"index": 0, "delta": {}, "finish_reason": None}
     if delta is None:
         choice["finish_reason"] = finish_reason
     else:
         choice["delta"] = {"content": delta}
-    return {
+    choices = [] if usage else [choice]
+    body: dict[str, Any] = {
         "id": cid, "object": "chat.completion.chunk",
-        "created": int(time.time()), "model": model, "choices": [choice],
+        "created": int(time.time()), "model": model, "choices": choices,
     }
+    if usage_field:
+        body["usage"] = usage
+    return body
 
 
 def build_server(
@@ -420,6 +432,19 @@ def build_server(
                             f"got {max_new}"
                         )
                 sampling = _parse_sampling(req)
+                if (so := req.get("stream_options")) is not None:
+                    # Unsupported values raise (-> 400), never silently
+                    # no-op — same policy as _parse_sampling.
+                    if not req.get("stream"):
+                        raise ValueError(
+                            "stream_options requires stream: true"
+                        )
+                    if not isinstance(so, dict) or set(so) - {
+                        "include_usage"
+                    }:
+                        raise ValueError(
+                            "stream_options supports only include_usage"
+                        )
             except Exception as e:
                 self._json(400, {"error": {
                     "message": f"{type(e).__name__}: {e}",
@@ -449,11 +474,17 @@ def build_server(
                             continue
                     return False
 
+                want_usage = bool(
+                    (req.get("stream_options") or {}).get("include_usage")
+                )
+                usage: dict[str, int] = {}
+
                 def produce():
                     gen = pipe.chat_stream(
                         question, images=images or None,
                         is_video=is_video, history=history,
-                        max_new_tokens=max_new, **sampling,
+                        max_new_tokens=max_new, usage_out=usage,
+                        **sampling,
                     )
                     try:
                         with stream_lock:
@@ -481,14 +512,33 @@ def build_server(
                     while True:
                         kind, payload = deltas.get()
                         if kind == "delta":
-                            self._sse(_chunk_body(model_name, cid, payload))
+                            self._sse(_chunk_body(
+                                model_name, cid, payload,
+                                usage_field=want_usage,
+                            ))
                         elif kind == "error":
                             self._sse({"error": {"message": payload}})
                             break
                         else:
-                            self._sse(
-                                _chunk_body(model_name, cid, None, payload)
-                            )
+                            self._sse(_chunk_body(
+                                model_name, cid, None, payload,
+                                usage_field=want_usage,
+                            ))
+                            if want_usage and usage:
+                                # One final empty-choices chunk with the
+                                # totals (the producer filled `usage`
+                                # before signaling "end").
+                                p = usage["prompt_tokens"]
+                                c = usage["completion_tokens"]
+                                self._sse(_chunk_body(
+                                    model_name, cid, None,
+                                    usage_field=True,
+                                    usage={
+                                        "prompt_tokens": p,
+                                        "completion_tokens": c,
+                                        "total_tokens": p + c,
+                                    },
+                                ))
                             break
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()

@@ -446,6 +446,7 @@ class OryxInference:
         top_p: float | None = None,
         stop: Sequence[str] | None = None,
         cache_state: "PrefixCacheState | None" = None,
+        usage_out: dict | None = None,
     ):
         """Streaming `chat` (HF TextIteratorStreamer parity): yields text
         DELTAS as tokens decode; ''.join(deltas) equals chat()'s reply
@@ -461,6 +462,14 @@ class OryxInference:
         the RETURN value becomes (reason, new PrefixCacheState) — the
         new state's ids cover the PROMPT only (streamed reply tokens are
         re-prefilled next turn; the visual prefill is still one-time).
+
+        usage_out: a dict the generator fills with prompt_tokens (real
+        spliced prompt length incl. visual tokens and any cached prefix)
+        and completion_tokens before returning — the streaming half of
+        chat_batch's return_token_counts. The finishing token (EOS or
+        device-detected stop) is counted, matching the batch path; a
+        stop string caught only by the host-side text trim may overcount
+        by up to the in-flight decode chunk.
         """
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
@@ -501,7 +510,7 @@ class OryxInference:
         ]
         emitted: list[int] = []
         text_done = ""
-        finished = False
+        finished = eos_hit = False
 
         def trim_stops(text: str) -> tuple[str, bool]:
             """Cut at the earliest full stop-string occurrence."""
@@ -536,6 +545,15 @@ class OryxInference:
         def result(reason):
             """Return value: bare reason, or (reason, new state) when the
             caller passed a cache_state."""
+            if usage_out is not None:
+                usage_out["prompt_tokens"] = int(lengths[0])
+                # +1 counts the finishing EOS, matching chat_batch's num
+                # ("up to and including the finishing token"); `emitted`
+                # excludes it (the loop breaks before appending). Stop-
+                # string finishes already have their tokens in `emitted`.
+                usage_out["completion_tokens"] = len(emitted) + (
+                    1 if eos_hit else 0
+                )
             if cache_state is None:
                 return reason
             return reason, PrefixCacheState(
@@ -559,7 +577,7 @@ class OryxInference:
                     block, final_cache = block
                 for t in block[0]:
                     if int(t) == eos:
-                        finished = True
+                        finished = eos_hit = True
                         break
                     emitted.append(int(t))
                 text = self.tokenizer.decode(

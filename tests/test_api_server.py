@@ -248,6 +248,52 @@ def test_server_completion_matches_pipeline(server):
         assert json.load(r)["status"] == "ok"
 
 
+def test_server_streaming_usage_chunk(server):
+    """stream_options.include_usage: a final empty-choices chunk carries
+    the usage totals; without the option, no chunk has usage."""
+    url, pipe = server
+    body = {
+        "model": "oryx-tpu", "stream": True,
+        "stream_options": {"include_usage": True},
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5,
+    }
+    with _post(url, body) as resp:
+        raw = resp.read().decode()
+    chunks = [
+        json.loads(l[len("data: "):])
+        for l in raw.splitlines()
+        if l.startswith("data: ") and l != "data: [DONE]"
+    ]
+    # OpenAI contract: EVERY chunk carries the usage key — null on delta
+    # chunks, totals (with empty choices) on the final one.
+    assert all("usage" in c for c in chunks), chunks
+    with_usage = [c for c in chunks if c["usage"] is not None]
+    assert len(with_usage) == 1
+    u = with_usage[-1]["usage"]
+    assert with_usage[-1]["choices"] == []
+    assert u["prompt_tokens"] > 0 and 0 < u["completion_tokens"] <= 5
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+    body.pop("stream_options")
+    with _post(url, body) as resp:
+        raw = resp.read().decode()
+    assert '"usage"' not in raw
+
+    # Unsupported stream_options shapes 400 instead of silently no-oping.
+    for bad in (
+        {"stream": False, "stream_options": {"include_usage": True}},
+        {"stream": True, "stream_options": {"includeUsage": True}},
+    ):
+        b = {"model": "oryx-tpu", "max_tokens": 4, **bad,
+             "messages": [{"role": "user", "content": "hi"}]}
+        try:
+            _post(url, b).close()
+            raise AssertionError(f"{bad} should have 400'd")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
 def test_server_streaming_sse(server):
     url, pipe = server
     body = {
