@@ -32,7 +32,13 @@ def _run_workers(worker: str, extra_args: list[str]) -> list[dict]:
         **os.environ,
         "PALLAS_AXON_POOL_IPS": "",
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # The collective timeout covers Gloo's key-value rendezvous
+        # (default ~30s): under full-suite contention on this one-core
+        # box the workers' first collectives can arrive minutes apart
+        # (observed: 'GetKeyValue() timed out ... 29.99s' crashing one
+        # worker while its peer still compiled).
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4 "
+                     "--xla_cpu_collective_timeout_seconds=600",
     }
     port = _free_port()
     procs = [
