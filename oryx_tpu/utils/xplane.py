@@ -45,12 +45,18 @@ def _fields(buf: bytes):
             val, i = _varint(buf, i)
         elif wtype == 2:  # length-delimited
             ln, i = _varint(buf, i)
+            if i + ln > n:  # short slice = mid-write truncation
+                raise ValueError("length-delimited field runs off buffer")
             val = buf[i:i + ln]
             i += ln
         elif wtype == 5:  # 32-bit
+            if i + 4 > n:
+                raise ValueError("fixed32 field runs off buffer")
             val = int.from_bytes(buf[i:i + 4], "little")
             i += 4
         elif wtype == 1:  # 64-bit
+            if i + 8 > n:
+                raise ValueError("fixed64 field runs off buffer")
             val = int.from_bytes(buf[i:i + 8], "little")
             i += 8
         else:  # groups (3/4) do not occur in proto3 xplane
@@ -130,11 +136,16 @@ def _parse_plane(buf: bytes) -> Plane:
 
 
 def parse_xspace(path: str) -> list[Plane]:
+    """Raises ValueError (not IndexError) on a truncated/corrupt file —
+    e.g. a profiler killed mid-write by a step timeout."""
     with open(path, "rb") as f:
         buf = f.read()
-    return [
-        _parse_plane(val) for fnum, _, val in _fields(buf) if fnum == 1
-    ]
+    try:
+        return [
+            _parse_plane(val) for fnum, _, val in _fields(buf) if fnum == 1
+        ]
+    except (IndexError, ValueError) as e:
+        raise ValueError(f"truncated/corrupt xplane file: {path}") from e
 
 
 def find_xplane_files(trace_dir: str) -> list[str]:

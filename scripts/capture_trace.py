@@ -73,6 +73,9 @@ def main() -> None:
     except RuntimeError as e:  # no xplane written (e.g. trace aborted)
         print(json.dumps({"error": "no_xplane_written", "detail": str(e)}))
         raise SystemExit(1)
+    except ValueError as e:  # truncated xplane (profiler killed mid-write)
+        print(json.dumps({"error": "corrupt_xplane", "detail": str(e)}))
+        raise SystemExit(1)
     print(json.dumps({
         "metric": "trace_top_ops",
         "geometry": geo_name,

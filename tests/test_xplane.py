@@ -81,6 +81,16 @@ def test_parse_synthetic_xspace(tmp_path):
     assert top == [("matmul-fused", 3_000_000 / 1e9)]
 
 
+def test_truncated_file_raises_valueerror(tmp_path):
+    plane = _plane("/device:TPU:0", lines=[_line("XLA Ops", [_event(7, 5)])],
+                   metas=[_meta_entry(7, "op")])
+    buf = _field(1, 2, plane)
+    path = tmp_path / "trunc.xplane.pb"
+    path.write_bytes(buf[: len(buf) - 3])  # mid-write kill artifact
+    with pytest.raises(ValueError, match="truncated"):
+        xplane.parse_xspace(str(path))
+
+
 @pytest.mark.slow
 def test_parse_real_jax_trace(tmp_path):
     import jax
