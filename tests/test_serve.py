@@ -257,6 +257,32 @@ def test_chat_batch_all_text(tiny_model):
     assert all(isinstance(r, str) for r in replies)
 
 
+def test_chat_batch_mixed_video_and_image(tiny_model):
+    """A single batch mixing a VIDEO row (16x compression, shared patch
+    budget), an image row (1x), and a text row must reproduce the
+    per-request answers — three compressor ratios in one packed buffer."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    rng = np.random.default_rng(11)
+    frames = [
+        rng.integers(0, 255, size=(32, 32, 3), dtype=np.uint8)
+        for _ in range(4)
+    ]
+    img = rng.integers(0, 255, size=(40, 56, 3), dtype=np.uint8)
+    requests = [
+        {"question": "what happens?", "images": frames, "is_video": True},
+        {"question": "what is this?", "images": [img]},
+        {"question": "hello there"},
+    ]
+    batched = pipe.chat_batch(requests, max_new_tokens=4)
+    singles = [
+        pipe.chat_video(frames, "what happens?", max_new_tokens=4),
+        pipe.chat("what is this?", images=[img], max_new_tokens=4),
+        pipe.chat("hello there", max_new_tokens=4),
+    ]
+    assert batched == singles
+
+
 def test_chat_batch_token_counts(tiny_model):
     """return_token_counts: prompt counts the REAL spliced length (text +
     visual tokens, no padding); completion counts generated tokens."""
