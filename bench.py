@@ -210,18 +210,20 @@ def _bench_cfg(backend: str, hbm_bytes: int):
         )
         batch_size, seq_bucket, img_patches_side = 8, (2048,), 16
         comp_heads = 12
-        # Sweepable geometry knobs (scripts/bench_sweep.py "batch"): more
-        # tokens/step amortizes per-step overhead where the memory freed
-        # by bf16 moments / thin remat policies allows.
-        if os.environ.get("BENCH_BATCH"):
-            batch_size = int(os.environ["BENCH_BATCH"])
-        if os.environ.get("BENCH_SEQ"):
-            seq_bucket = (int(os.environ["BENCH_SEQ"]),)
     else:
         geo_name, llm = "tiny", cfg_lib.tiny_llm()
         vision = cfg_lib.tiny_vision()
         batch_size, seq_bucket, img_patches_side = 2, (128,), 4
         comp_heads = 4
+    # Sweepable geometry knobs (scripts/bench_sweep.py "batch"): more
+    # tokens/step amortizes per-step overhead where the memory freed by
+    # bf16 moments / thin remat policies allows. Honored on every
+    # backend — a CPU sweep must measure the requested geometry, not
+    # silently bank distinct records for the same default tiny shape.
+    if os.environ.get("BENCH_BATCH"):
+        batch_size = int(os.environ["BENCH_BATCH"])
+    if os.environ.get("BENCH_SEQ"):
+        seq_bucket = (int(os.environ["BENCH_SEQ"]),)
     cfg = cfg_lib.OryxConfig(
         llm=llm,
         vision=vision,
@@ -530,11 +532,13 @@ def _supervise() -> None:
             tail = "\n".join(both.strip().splitlines()[-15:])[-1400:]
             last = "\n".join(phases)[-500:] + ("\n" if phases else "") + tail
             infra = rc is None or any(m in both for m in _TUNNEL_ERR_MARKERS)
-            if not infra:
-                oom = any(m in both for m in _OOM_MARKERS)
-                # "oom" is deterministic for the configuration: retrying
-                # the identical run cannot succeed (sweep callers bank it
-                # instead of looping).
+            # "oom" is deterministic for the configuration: retrying the
+            # identical run cannot succeed (sweep callers bank it instead
+            # of looping). It takes precedence over the infra markers — an
+            # OOM that also tears the tunnel connection down is still an
+            # OOM, and re-paying compile+OOM per retry buys nothing.
+            oom = any(m in both for m in _OOM_MARKERS)
+            if oom or not infra:
                 _emit_error("oom" if oom else "bench_failed", last, attempt)
         else:
             last = tail

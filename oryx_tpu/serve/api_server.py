@@ -524,22 +524,25 @@ def build_server(
                                 model_name, cid, None, payload,
                                 usage_field=want_usage,
                             ))
-                            if want_usage and usage:
-                                # One final empty-choices chunk with the
-                                # totals (the producer filled `usage`
-                                # before signaling "end").
-                                p = usage["prompt_tokens"]
-                                c = usage["completion_tokens"]
-                                self._sse(_chunk_body(
-                                    model_name, cid, None,
-                                    usage_field=True,
-                                    usage={
-                                        "prompt_tokens": p,
-                                        "completion_tokens": c,
-                                        "total_tokens": p + c,
-                                    },
-                                ))
                             break
+                    if want_usage:
+                        # One final empty-choices chunk with the totals.
+                        # The OpenAI contract promises this chunk when
+                        # stream_options.include_usage is set, so it is
+                        # emitted on the error path too, with whatever
+                        # counts the producer managed to fill (zeros if
+                        # it died before accounting).
+                        p = usage.get("prompt_tokens", 0)
+                        c = usage.get("completion_tokens", 0)
+                        self._sse(_chunk_body(
+                            model_name, cid, None,
+                            usage_field=True,
+                            usage={
+                                "prompt_tokens": p,
+                                "completion_tokens": c,
+                                "total_tokens": p + c,
+                            },
+                        ))
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):

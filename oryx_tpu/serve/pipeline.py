@@ -466,10 +466,9 @@ class OryxInference:
         usage_out: a dict the generator fills with prompt_tokens (real
         spliced prompt length incl. visual tokens and any cached prefix)
         and completion_tokens before returning — the streaming half of
-        chat_batch's return_token_counts. The finishing token (EOS or
-        device-detected stop) is counted, matching the batch path; a
-        stop string caught only by the host-side text trim may overcount
-        by up to the in-flight decode chunk.
+        chat_batch's return_token_counts. The finishing token is counted
+        (EOS, or the token that completes a stop string), matching the
+        batch path; tokens decoded past a host-side stop cut are not.
         """
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
@@ -511,6 +510,7 @@ class OryxInference:
         emitted: list[int] = []
         text_done = ""
         finished = eos_hit = False
+        stop_tok_count: int | None = None
 
         def trim_stops(text: str) -> tuple[str, bool]:
             """Cut at the earliest full stop-string occurrence."""
@@ -547,13 +547,20 @@ class OryxInference:
             caller passed a cache_state."""
             if usage_out is not None:
                 usage_out["prompt_tokens"] = int(lengths[0])
-                # +1 counts the finishing EOS, matching chat_batch's num
-                # ("up to and including the finishing token"); `emitted`
-                # excludes it (the loop breaks before appending). Stop-
-                # string finishes already have their tokens in `emitted`.
-                usage_out["completion_tokens"] = len(emitted) + (
-                    1 if eos_hit else 0
-                )
+                # A stop-string finish counts through the token that
+                # completed the stop (stop_tok_count), not the whole
+                # in-flight decode chunk; the stop cut sits inside
+                # `emitted`, so it always precedes an EOS seen in the
+                # same chunk. Otherwise +1 counts the finishing EOS,
+                # matching chat_batch's num ("up to and including the
+                # finishing token"); `emitted` excludes it (the loop
+                # breaks before appending).
+                if stop_tok_count is not None:
+                    usage_out["completion_tokens"] = stop_tok_count
+                elif eos_hit:
+                    usage_out["completion_tokens"] = len(emitted) + 1
+                else:
+                    usage_out["completion_tokens"] = len(emitted)
             if cache_state is None:
                 return reason
             return reason, PrefixCacheState(
@@ -575,6 +582,7 @@ class OryxInference:
             ):
                 if cache_state is not None:
                     block, final_cache = block
+                chunk_start = len(emitted)
                 for t in block[0]:
                     if int(t) == eos:
                         finished = eos_hit = True
@@ -584,6 +592,17 @@ class OryxInference:
                     emitted, skip_special_tokens=True
                 )
                 text, hit = trim_stops(text)
+                if usage_out is not None and hit and stop_tok_count is None:
+                    # The stop string completed somewhere in THIS chunk
+                    # (earlier chunks were trimmed and didn't hit), so a
+                    # short incremental decode finds the minimal token
+                    # prefix containing it — the host-side analogue of
+                    # the device's finishing-token count.
+                    for k in range(chunk_start + 1, len(emitted) + 1):
+                        if trim_stops(self.tokenizer.decode(
+                                emitted[:k], skip_special_tokens=True))[1]:
+                            stop_tok_count = k
+                            break
                 finished = finished or hit
                 safe = text.strip() if finished else stable_prefix(text)
                 if len(safe) > len(text_done):

@@ -724,3 +724,29 @@ def test_sharded_pipe_cached_session_matches_unsharded(tiny_model):
         assert cached.ask(q, max_new_tokens=4) == ref.ask(
             q, max_new_tokens=4
         )
+
+
+def test_chat_stream_usage_stop_matches_batch(tiny_model):
+    """A stop-string finish counts completion tokens through the token
+    that completes the stop — matching chat_batch's capped count, not
+    the whole in-flight decode chunk."""
+    cfg, params = tiny_model
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    base = pipe.chat("hello there", max_new_tokens=8)
+    if len(base) < 2:
+        pytest.skip("tiny model emitted too little text to split on")
+    stop = base[1]
+    _, _, counts = pipe.chat_batch(
+        [{"question": "hello there"}], max_new_tokens=8, stop=[stop],
+        return_finish_reasons=True, return_token_counts=True,
+    )
+    usage = {}
+    # chunk=4 leaves decoded-past-the-cut tokens in flight, the exact
+    # overcount case; a char-level tokenizer makes the expected count
+    # deterministic.
+    "".join(pipe.chat_stream(
+        "hello there", max_new_tokens=8, stop=[stop], usage_out=usage,
+        chunk=4,
+    ))
+    assert usage["prompt_tokens"] == counts[0][0]
+    assert usage["completion_tokens"] == counts[0][1]
