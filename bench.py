@@ -29,9 +29,14 @@ Latency: BASELINE config 3 — 64-frame video QA (16x compression) through
 serve/pipeline.OryxInference, greedy, 32 new tokens; p50 over repeats.
 
 `vs_baseline`: BASELINE.json publishes no reference number ("published":
-{}), so the ratio uses a documented 2000 tok/s/chip PLACEHOLDER
-(8xA100 Oryx-7B SFT estimate) and is labeled as such in
-`baseline_source` — it is NOT a measured-reference comparison.
+{}), so the bar is DERIVED from first principles (see the "defended
+baseline" block below and BASELINE.md "Derivation"): 8xA100 bf16 peak x
+the documented HF-Trainer+DeepSpeed multimodal-SFT MFU band / 6N
+flops-per-token, divided over the 16 v5e chips of the north-star slice.
+When the measured geometry is a sub-7B proxy, the comparable number is
+the MFU projection to 7B on this chip (raw proxy tok/s is inflated by
+the smaller model); `baseline_source` labels which regime produced the
+ratio.
 """
 
 from __future__ import annotations
@@ -44,8 +49,52 @@ import time
 
 import numpy as np
 
-PLACEHOLDER_BASELINE_TOK_S_CHIP = 2000.0
-BASELINE_SOURCE = "placeholder_2000_tok_s_chip_unverified"
+# ---- defended baseline (derivation recorded in BASELINE.md) ---------------
+# The reference trains Oryx-7B SFT on 8xA100-80G (HF Trainer + DeepSpeed
+# ZeRO, bf16, flash-attn-2; SURVEY.md §6). No published throughput is
+# readable (/root/reference is empty, BASELINE.json.published == {}), so
+# the bar is derived and carried as a band:
+#   tokens/s(total) = n_gpus * peak_bf16 * MFU / flops_per_token
+#   flops_per_token ~= 6N (dense decoder fwd+bwd; attention FLOPs and the
+#   vision tower push the reference's true flops/token HIGHER, which makes
+#   this bar conservative — i.e. harder for us to beat)
+# with A100 bf16 peak 312 TFLOP/s, N = 7.6e9 (Qwen2-7B incl. embeddings),
+# and MFU band 0.25-0.40 (mid 0.32): the range public HF-Trainer+ZeRO
+# multimodal-SFT runs land in on A100 with flash-attn-2 — dense LLM
+# pretrain reaches ~0.40-0.50, multimodal SFT loses ground to dynamic
+# shapes, per-sample vision towers, and ZeRO comm. The north star is
+# matching the 8-GPU TOTAL on a v5e-16 slice, so the per-chip bar
+# divides by 16.
+A100_BF16_PEAK = 312e12
+REF_N_GPUS = 8
+REF_PARAMS = 7.6e9
+REF_FLOPS_PER_TOK = 6 * REF_PARAMS
+REF_MFU_BAND = (0.25, 0.40)
+REF_MFU_MID = 0.32
+_REF_TOK_S = REF_N_GPUS * A100_BF16_PEAK / REF_FLOPS_PER_TOK  # at MFU 1.0
+V5E16_CHIPS = 16
+BASELINE_TOK_S_CHIP = _REF_TOK_S * REF_MFU_MID / V5E16_CHIPS  # ~1095
+BASELINE_BAND_TOK_S_CHIP = tuple(
+    round(_REF_TOK_S * m / V5E16_CHIPS, 1) for m in REF_MFU_BAND
+)
+
+
+def score_vs_baseline(n_llm: float, tok_s_chip: float, mfu, peak):
+    """(vs_baseline, baseline_source, projected_7b) — most→least direct:
+    a real-7B measurement scores directly per chip; a sub-7B proxy with
+    measured MFU scores as the 7B-at-that-MFU projection on this chip's
+    peak (the proxy's raw tok/s is inflated by the smaller model's fewer
+    flops/token); without a known chip peak (CPU) the raw ratio is
+    labeled geometry-incomparable rather than claimed."""
+    if n_llm >= 6e9:
+        return tok_s_chip / BASELINE_TOK_S_CHIP, \
+            "derived_8xA100_mfu_band/direct", None
+    if mfu is not None and peak:
+        projected = mfu * peak / REF_FLOPS_PER_TOK
+        return projected / BASELINE_TOK_S_CHIP, \
+            "derived_8xA100_mfu_band/projected_7b_at_measured_mfu", projected
+    return tok_s_chip / BASELINE_TOK_S_CHIP, \
+        "derived_8xA100_mfu_band/geometry_incomparable", None
 
 # ---- tunnel defense (parent supervisor) -----------------------------------
 # The axon TPU tunnel degrades for hours at a time; a bare
@@ -647,12 +696,18 @@ def main() -> None:
             print(f"# int8 latency bench failed: {e!r}")
             lat64_q8 = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    vs_baseline, baseline_source, projected_7b = score_vs_baseline(
+        n_llm, tok_s_chip, mfu, peak
+    )
     print(json.dumps({
         "metric": "sft_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 2),
         "unit": "tok/s",
-        "vs_baseline": round(tok_s_chip / PLACEHOLDER_BASELINE_TOK_S_CHIP, 4),
-        "baseline_source": BASELINE_SOURCE,
+        "vs_baseline": round(vs_baseline, 4),
+        "baseline_source": baseline_source,
+        "baseline_tok_s_chip": round(BASELINE_TOK_S_CHIP, 1),
+        "baseline_band_tok_s_chip": list(BASELINE_BAND_TOK_S_CHIP),
+        "projected_7b_tok_s_chip": projected_7b and round(projected_7b, 1),
         "chip": chip,
         "hbm_gb": round(hbm / 1024**3, 1) if hbm else None,
         "geometry": geo_name,

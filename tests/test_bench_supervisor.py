@@ -9,6 +9,10 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# Repo root on sys.path at module scope: `import bench` must work for any
+# isolated test selection (the bare pytest entrypoint does not add it).
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 BENCH = os.path.join(REPO, "bench.py")
 
 
@@ -54,7 +58,6 @@ def test_oom_child_classified_deterministic(monkeypatch, capsys):
     supervisor sees) must be emitted as {"error": "oom"} so sweep callers
     bank it instead of retrying forever; bare gRPC RESOURCE_EXHAUSTED
     without allocator context must stay "bench_failed"/retryable."""
-    sys.path.insert(0, REPO)
     import bench
 
     monkeypatch.setattr(bench, "_probe_once", lambda: (True, ""))
@@ -115,3 +118,35 @@ def test_cpu_pinned_runs_in_process():
     assert d["metric"] == "sft_tokens_per_sec_per_chip"
     # No supervisor chatter in-process: no probe lines on stdout.
     assert "probe attempt" not in proc.stdout
+
+
+def test_score_vs_baseline_regimes():
+    """The defended-baseline scorer picks the right regime and labels it
+    (BASELINE.md "Derivation"): direct for real-7B geometry, MFU
+    projection for a proxy with a known chip peak, raw-but-labeled
+    otherwise."""
+    import bench
+
+    # Direct: 7.6B geometry at the mid-band bar scores 1.0.
+    vs, src, proj = bench.score_vs_baseline(
+        7.6e9, bench.BASELINE_TOK_S_CHIP, 0.4, 197e12
+    )
+    assert src.endswith("/direct") and proj is None
+    assert abs(vs - 1.0) < 1e-9
+
+    # Projection: proxy geometry, measured MFU on a v5e peak.
+    vs, src, proj = bench.score_vs_baseline(0.7e9, 25000.0, 0.485, 197e12)
+    assert src.endswith("/projected_7b_at_measured_mfu")
+    expect = 0.485 * 197e12 / bench.REF_FLOPS_PER_TOK
+    assert abs(proj - expect) < 1e-6
+    assert abs(vs - expect / bench.BASELINE_TOK_S_CHIP) < 1e-9
+    assert 1.5 < vs < 2.5  # the round-3 MFU lands ~1.9x the bar
+
+    # Incomparable: no peak/MFU (CPU) — raw ratio, labeled as such.
+    vs, src, proj = bench.score_vs_baseline(0.02e9, 5000.0, None, 0)
+    assert src.endswith("/geometry_incomparable") and proj is None
+
+    # The derived bar itself: band brackets the mid.
+    lo, hi = bench.BASELINE_BAND_TOK_S_CHIP
+    assert lo < bench.BASELINE_TOK_S_CHIP < hi
+    assert 800 < lo < hi < 1500
