@@ -206,6 +206,11 @@ class OryxConfig:
     dtype: str = "bfloat16"
     # "xla" (portable, CPU-testable) or "pallas" (TPU kernels).
     attn_impl: str = "xla"
+    # Reference parity hook (SURVEY.md §3.4): optional text separator
+    # (e.g. "\n") tokenized and spliced after EACH video frame's visual
+    # span. None/"" = off — the plain contiguous-sentinel layout. See
+    # models/splice.expand_video_sentinels.
+    frame_separator: str | None = None
 
     # ---- (de)serialization -------------------------------------------------
 

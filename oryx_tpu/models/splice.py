@@ -31,6 +31,57 @@ from oryx_tpu.ops.packing import DEFAULT_BUCKETS, PackedVisual, round_up_bucket
 from oryx_tpu.parallel.sharding import constrain
 
 
+def frame_separator_ids(tokenizer, frame_separator: str | None) -> tuple[int, ...]:
+    """Tokenize OryxConfig.frame_separator into the sep_ids tuple for
+    expand_video_sentinels. The ONE tokenization policy for the hook —
+    serving (pipeline) and training (train/cli) both call this, so a
+    policy tweak can never skew train vs serve layout."""
+    if not frame_separator:
+        return ()
+    return tuple(
+        int(t)
+        for t in tokenizer.encode(frame_separator, add_special_tokens=False)
+    )
+
+
+def expand_video_sentinels(
+    ids: np.ndarray,
+    n_frames: int,
+    *,
+    labels: np.ndarray | None = None,
+    sep_ids: tuple[int, ...] = (),
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Expand a video's single IMAGE_TOKEN_INDEX placeholder into one
+    sentinel per frame, optionally followed by separator token ids after
+    EACH frame (the LLaVA-NeXT image-newline convention).
+
+    Reference parity hook (SURVEY.md §3.4 "optional per-frame
+    separators/newlines", exp `oryx/model/oryx_arch.py`): default OFF
+    (`sep_ids=()` reproduces the plain contiguous-sentinel layout). The
+    flag is `OryxConfig.frame_separator` — a string tokenized by the
+    caller — so reference behavior can be matched without surgery once
+    the real checkpoint/template is readable.
+
+    Inserted positions get IGNORE_INDEX labels. Shared by the serving
+    path (pipeline._prepare_request) and the training collator
+    (train/data.collate) so train and serve always agree on layout.
+    """
+    ids = np.asarray(ids)
+    idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
+    per_frame = [IMAGE_TOKEN_INDEX, *sep_ids]
+    mid = np.asarray(per_frame * n_frames, ids.dtype)
+    out = np.concatenate([ids[:idx], mid, ids[idx + 1:]])
+    out_labels = None
+    if labels is not None:
+        labels = np.asarray(labels)
+        out_labels = np.concatenate(
+            [labels[:idx],
+             np.full(len(mid), IGNORE_INDEX, labels.dtype),
+             labels[idx + 1:]]
+        )
+    return out, out_labels
+
+
 def query_slots(packed: PackedVisual) -> list[tuple[int, int]]:
     """Per-image (start, count) slots in the packed query buffer, in pack
     order. Derived from q_grids (queries are image-major, contiguous)."""

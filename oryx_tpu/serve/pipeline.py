@@ -142,6 +142,7 @@ class OryxInference:
         sharding_mode: str = "tp",
     ) -> None:
         self.tokenizer = tokenizer
+        self._frame_sep_cache = None
         # Ring attention is a TRAINING/prefill configuration (sequence
         # parallelism, no KV cache); decode needs the cached path. Models
         # trained under a ring config serve with the equivalent dense
@@ -260,6 +261,15 @@ class OryxInference:
             self.cfg, generation=dataclasses.replace(gen, **updates)
         )
 
+    def _frame_sep_ids(self) -> tuple[int, ...]:
+        """Tokenized cfg.frame_separator (parity hook, default off),
+        cached — it never changes for a pipe."""
+        if self._frame_sep_cache is None:
+            self._frame_sep_cache = splice.frame_separator_ids(
+                self.tokenizer, self.cfg.frame_separator
+            )
+        return self._frame_sep_cache
+
     def _stop_for(self, stop: Sequence[str] | None):
         """Stop-id matrix for the template stop plus request stops."""
         if not stop:
@@ -286,11 +296,8 @@ class OryxInference:
         )
         ids = mm_utils.tokenizer_image_token(prompt, self.tokenizer)
         if is_video and len(images) > 1:
-            idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
-            ids = np.concatenate(
-                [ids[:idx],
-                 np.full(len(images), IMAGE_TOKEN_INDEX, ids.dtype),
-                 ids[idx + 1:]]
+            ids, _ = splice.expand_video_sentinels(
+                ids, len(images), sep_ids=self._frame_sep_ids()
             )
         if not images:
             return ids, [], [], []

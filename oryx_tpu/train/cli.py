@@ -18,6 +18,7 @@ import os
 import jax
 
 from oryx_tpu.config import OryxConfig
+from oryx_tpu.models import splice
 from oryx_tpu.parallel import mesh as mesh_lib
 from oryx_tpu.train import data as data_lib
 from oryx_tpu.train.trainer import Trainer
@@ -127,6 +128,9 @@ def main(argv: list[str] | None = None) -> None:
         patch_size=cfg.vision.patch_size,
         base_grid=cfg.vision.base_grid,
         max_len=cfg.train.max_seq_len,
+        frame_separator_ids=splice.frame_separator_ids(
+            tokenizer, cfg.frame_separator
+        ),
     )
 
     trainer = Trainer(

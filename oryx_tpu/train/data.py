@@ -207,9 +207,15 @@ def collate(
     base_grid: int = 27,
     max_len: int | None = None,
     buckets: tuple[int, ...] = packing.DEFAULT_BUCKETS,
+    frame_separator_ids: tuple[int, ...] = (),
 ) -> dict[str, np.ndarray]:
     """Pack a list of Examples into one static-shape training batch
-    (all BATCH_FIELDS of train.step, numpy)."""
+    (all BATCH_FIELDS of train.step, numpy).
+
+    frame_separator_ids: optional token ids spliced after each video
+    frame's sentinel when the single placeholder expands (parity hook,
+    splice.expand_video_sentinels; tokenize OryxConfig.frame_separator
+    with the training tokenizer to produce them). Default off."""
     all_images: list[np.ndarray] = []
     factors: list[int] = []
     caps: list[int] = []
@@ -220,17 +226,11 @@ def collate(
         ids, labels = ex.input_ids, ex.labels
         n_sent = int(np.sum(ids == IMAGE_TOKEN_INDEX))
         if n_sent == 1 and len(ex.images) > 1:
-            # Expand the single placeholder to one sentinel per frame.
-            idx = int(np.where(ids == IMAGE_TOKEN_INDEX)[0][0])
-            ids = np.concatenate(
-                [ids[:idx],
-                 np.full(len(ex.images), IMAGE_TOKEN_INDEX, ids.dtype),
-                 ids[idx + 1:]]
-            )
-            labels = np.concatenate(
-                [labels[:idx],
-                 np.full(len(ex.images), IGNORE_INDEX, labels.dtype),
-                 labels[idx + 1:]]
+            # Expand the single placeholder to one sentinel per frame
+            # (+ optional per-frame separators), shared with serving.
+            ids, labels = splice.expand_video_sentinels(
+                ids, len(ex.images), labels=labels,
+                sep_ids=frame_separator_ids,
             )
         per_sample_ids.append(ids)
         per_sample_labels.append(labels)
@@ -272,6 +272,9 @@ def collate_packed_text(
     base_grid: int = 27,
     buckets: tuple[int, ...] = packing.DEFAULT_BUCKETS,
     max_len: int | None = None,
+    # Accepted for **collate_kw parity with `collate`; text-only batches
+    # have no video placeholders, so it is inert here.
+    frame_separator_ids: tuple[int, ...] = (),
 ) -> dict[str, np.ndarray]:
     """Sequence-PACKED text-only batch: multiple samples share one
     `bucket`-wide row (first-fit-decreasing), separated by

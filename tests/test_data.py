@@ -300,3 +300,39 @@ def test_golden_prompt_prefix_agreement_all_templates():
             mm_utils.tokenizer_image_token(gen.get_prompt(), FakeTokenizer())
         ]
         assert prefix == prompt_ids, f"template {name!r} train/infer mismatch"
+
+
+def test_collate_frame_separator_ids():
+    """The collator's video-placeholder expansion honors
+    frame_separator_ids (parity hook): separator TEXT tokens follow each
+    frame's visual span, label-masked IGNORE_INDEX; default off keeps
+    the contiguous layout byte-identical."""
+    rng = np.random.default_rng(0)
+    frames = [rng.standard_normal((28, 28, 3)).astype(np.float32)
+              for _ in range(3)]
+    ids = np.array([65, 66, IMAGE_TOKEN_INDEX, 67, 68], np.int64)
+    labels = np.full(ids.shape, IGNORE_INDEX, np.int64)
+    labels[-2:] = ids[-2:]
+    ex = data_lib.Example(ids, labels, frames, "video")
+
+    base = data_lib.collate([ex], buckets=(16, 64, 256), base_grid=8)
+    sep = data_lib.collate(
+        [ex], buckets=(16, 64, 256), base_grid=8,
+        frame_separator_ids=(42,),
+    )
+    n_base = int(np.sum(base["attn_mask"][0]))
+    n_sep = int(np.sum(sep["attn_mask"][0]))
+    assert n_sep == n_base + 3  # one separator per frame
+    toks = sep["token_ids"][0, :n_sep]
+    isv = sep["is_visual"][0, :n_sep]
+    # Non-visual slots: prefix text, one 42 after each frame, suffix.
+    np.testing.assert_array_equal(
+        toks[~isv], [65, 66, 42, 42, 42, 67, 68])
+    # Inserted separators are never supervised: labels are shifted left
+    # by one (label AT slot t supervises slot t+1), so a separator at
+    # slot s would be a predicted target iff lab[s-1] == 42.
+    lab = sep["labels"][0]
+    sep_slots = np.where(toks == 42)[0]
+    assert len(sep_slots) == 3
+    for s in sep_slots:
+        assert lab[s - 1] == IGNORE_INDEX

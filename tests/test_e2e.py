@@ -169,3 +169,55 @@ def test_mm_forward_multi_image_compression():
     )
     assert logits.shape == (1, 16, cfg.llm.vocab_size)
     assert bool(jnp.all(jnp.isfinite(logits[0, : batch.lengths[0]])))
+
+
+def test_expand_video_sentinels_layouts():
+    """The frame-separator parity hook (SURVEY.md §3.4): default off
+    reproduces the contiguous-sentinel layout; with sep_ids each frame's
+    sentinel is followed by the separator tokens, labels IGNORE_INDEX at
+    every inserted slot."""
+    ids = np.array([5, 6, IMAGE_TOKEN_INDEX, 7], np.int64)
+    labels = np.array([IGNORE_INDEX, IGNORE_INDEX, IGNORE_INDEX, 7],
+                      np.int64)
+
+    out, lab = splice.expand_video_sentinels(ids, 3, labels=labels)
+    np.testing.assert_array_equal(
+        out, [5, 6, IMAGE_TOKEN_INDEX, IMAGE_TOKEN_INDEX,
+              IMAGE_TOKEN_INDEX, 7])
+    np.testing.assert_array_equal(
+        lab, [IGNORE_INDEX] * 5 + [7])
+
+    out, lab = splice.expand_video_sentinels(
+        ids, 3, labels=labels, sep_ids=(42, 43))
+    np.testing.assert_array_equal(
+        out, [5, 6,
+              IMAGE_TOKEN_INDEX, 42, 43,
+              IMAGE_TOKEN_INDEX, 42, 43,
+              IMAGE_TOKEN_INDEX, 42, 43,
+              7])
+    np.testing.assert_array_equal(lab, [IGNORE_INDEX] * 11 + [7])
+
+    # No-labels path mirrors the ids layout.
+    out2, lab2 = splice.expand_video_sentinels(ids, 2, sep_ids=(9,))
+    np.testing.assert_array_equal(
+        out2, [5, 6, IMAGE_TOKEN_INDEX, 9, IMAGE_TOKEN_INDEX, 9, 7])
+    assert lab2 is None
+
+
+def test_frame_separator_token_stream_through_splice():
+    """Separator tokens survive the spliced index map: each frame's
+    visual span is followed by the separator TEXT slots, attendable and
+    embedded from the embed table (not the visual buffer)."""
+    sep = (42,)
+    ids, _ = splice.expand_video_sentinels(
+        np.array([5, IMAGE_TOKEN_INDEX, 7], np.int64), 2, sep_ids=sep)
+    # two frames of 3 and 2 visual tokens
+    batch = splice.build_mm_batch([ids], [(0, 3), (3, 2)], buckets=(16,))
+    n = int(batch.lengths[0])
+    toks = batch.token_ids[0, :n]
+    isv = batch.is_visual[0, :n]
+    # layout: 5 | vvv | 42 | vv | 42 | 7
+    np.testing.assert_array_equal(
+        isv, [False, True, True, True, False, True, True, False, False])
+    np.testing.assert_array_equal(
+        toks[~isv], [5, 42, 42, 7])

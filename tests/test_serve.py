@@ -750,3 +750,38 @@ def test_chat_stream_usage_stop_matches_batch(tiny_model):
     ))
     assert usage["prompt_tokens"] == counts[0][0]
     assert usage["completion_tokens"] == counts[0][1]
+
+
+def test_frame_separator_flag_in_pipeline(tiny_model):
+    """cfg.frame_separator (parity hook, default off) splices the
+    tokenized separator after each frame's sentinel in the video path,
+    and the pipe still decodes end-to-end."""
+    import dataclasses
+
+    from oryx_tpu.constants import IMAGE_TOKEN_INDEX
+
+    cfg, params = tiny_model
+    rng = np.random.default_rng(0)
+    frames = [
+        rng.integers(0, 255, size=(28, 28, 3), dtype=np.uint8)
+        for _ in range(3)
+    ]
+    plain = OryxInference(FakeTokenizer(), params, cfg)
+    ids_plain, *_ = plain._prepare_request(
+        {"question": "q", "images": frames, "is_video": True})
+
+    sep_cfg = dataclasses.replace(cfg, frame_separator="\n")
+    pipe = OryxInference(FakeTokenizer(), params, sep_cfg)
+    ids, *_ = pipe._prepare_request(
+        {"question": "q", "images": frames, "is_video": True})
+    sep = FakeTokenizer().encode("\n")
+    # Every sentinel is followed by the separator token(s).
+    pos = np.where(ids == IMAGE_TOKEN_INDEX)[0]
+    assert len(pos) == 3
+    for p in pos:
+        np.testing.assert_array_equal(ids[p + 1: p + 1 + len(sep)], sep)
+    assert len(ids) == len(ids_plain) + 3 * len(sep)
+    # Default-off path is unchanged.
+    assert not np.array_equal(ids, ids_plain) and len(sep) > 0
+    out = pipe.chat("what?", images=frames, is_video=True, max_new_tokens=3)
+    assert isinstance(out, str)
