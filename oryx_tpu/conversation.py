@@ -120,6 +120,13 @@ conv_templates: dict[str, Conversation] = {
     "qwen_1_5": conv_qwen,
     "plain": conv_plain,
     "v1": conv_vicuna,
+    # 34B (Yi backbone) template DECISION (reference mount empty, so the
+    # real oryx_34b template is unverifiable): Yi-34B-Chat speaks ChatML
+    # with the same <|im_start|>/<|im_end|> markers as Qwen, so oryx_34b
+    # maps to the ChatML template. Pinned by tests/test_goldens.py and
+    # documented in docs/MIGRATING.md; revisit the moment the reference
+    # becomes readable.
+    "yi_34b": conv_qwen,
 }
 
 default_conversation = conv_qwen

@@ -2,6 +2,7 @@
 round-trip (batched + streaming SSE), dynamic batching."""
 
 import base64
+import contextlib
 import io
 import json
 import threading
@@ -466,13 +467,13 @@ def test_server_sampling_roundtrip(server):
         assert e.code == 400
 
 
-def test_mixed_max_tokens_batch_matches_solo(server):
-    """Requests with different max_tokens in one bucket batch into ONE
-    device call and still return exactly what a solo call with that cap
-    returns (greedy decode is prefix-stable across the longer shared
-    window). A dedicated server with a wide batch window + a chat_batch
-    spy makes the co-batching assertion deterministic."""
-    _, pipe = server
+@contextlib.contextmanager
+def _spied_server(pipe, batch_window=1.0):
+    """Dedicated server with a wide batch window + a chat_batch spy —
+    `calls` records (n_rows, max_new_tokens, sorted per_row_max) per
+    device call; the pipe is restored and the server shut down on exit.
+    Shared by the co-batching and concurrency tests so the
+    monkeypatch/build_server/shutdown plumbing exists once."""
     orig = pipe.chat_batch
     calls = []
 
@@ -482,10 +483,23 @@ def test_mixed_max_tokens_batch_matches_solo(server):
         return orig(requests, **kw)
 
     pipe.chat_batch = spy
-    srv = api_server.build_server(pipe, port=0, batch_window=1.0)
+    srv = api_server.build_server(pipe, port=0, batch_window=batch_window)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
-    url = f"http://127.0.0.1:{srv.server_address[1]}"
     try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", calls, orig
+    finally:
+        pipe.chat_batch = orig
+        srv.shutdown()
+
+
+def test_mixed_max_tokens_batch_matches_solo(server):
+    """Requests with different max_tokens in one bucket batch into ONE
+    device call and still return exactly what a solo call with that cap
+    returns (greedy decode is prefix-stable across the longer shared
+    window). A dedicated server with a wide batch window + a chat_batch
+    spy makes the co-batching assertion deterministic."""
+    _, pipe = server
+    with _spied_server(pipe) as (url, calls, orig):
         qs_caps = [("hello there", 3), ("what now?", 6),
                    ("tell me more", 9)]
         refs = [orig([{"question": q}], max_new_tokens=c)[0]
@@ -511,13 +525,11 @@ def test_mixed_max_tokens_batch_matches_solo(server):
             t.start()
         for t in threads:
             t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "client hung"
         assert results == refs
         # All three shared one decode of the bucket (16) with their own
         # caps — not three solo batches.
         assert (3, 16, [3, 6, 9]) in calls, calls
-    finally:
-        pipe.chat_batch = orig
-        srv.shutdown()
 
 
 def test_server_rejects_excessive_max_tokens(server):
@@ -530,3 +542,95 @@ def test_server_rejects_excessive_max_tokens(server):
         raise AssertionError("expected HTTP 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_server_concurrent_mixed_clients(server):
+    """VERDICT r4 weak-6: >=8 genuinely simultaneous HTTP clients —
+    mixed stream/non-stream, mixed text/image — through the
+    ThreadingHTTPServer + batch-window path. Every response must equal
+    its single-request answer and at least one >1-size batch must have
+    actually formed (the batcher is not just running solo rows)."""
+    _, pipe = server
+    rng = np.random.default_rng(7)
+    imgs = [
+        rng.integers(0, 255, size=(24, 24, 3), dtype=np.uint8)
+        for _ in range(2)
+    ]
+    with _spied_server(pipe) as (url, calls, orig):
+        text_qs = [("hello there", 4), ("what now?", 6),
+                   ("tell me more", 8), ("and then?", 5)]
+        img_qs = [("what is this?", 4), ("describe it", 6)]
+        stream_qs = [("say something", 5), ("go on", 7)]
+        # Single-request references, computed before the server sees any
+        # traffic (greedy decode: order-independent).
+        refs = {}
+        for q, c in text_qs:
+            refs[q] = orig([{"question": q}], max_new_tokens=c)[0]
+        for (q, c), im in zip(img_qs, imgs):
+            refs[q] = orig(
+                [{"question": q, "images": [im]}], max_new_tokens=c
+            )[0]
+        for q, c in stream_qs:
+            refs[q] = "".join(
+                pipe.chat_stream(q, max_new_tokens=c)
+            )
+        calls.clear()
+
+        results: dict[str, str] = {}
+        errors: list[str] = []
+
+        def nonstream(q, c, image=None):
+            content = q if image is None else [
+                {"type": "text", "text": q},
+                {"type": "image_url", "image_url": {"url": _data_uri(image)}},
+            ]
+            try:
+                with _post(url, {
+                    "max_tokens": c,
+                    "messages": [{"role": "user", "content": content}],
+                }) as resp:
+                    results[q] = json.load(
+                        resp
+                    )["choices"][0]["message"]["content"]
+            except Exception as e:  # surface in the main thread
+                errors.append(f"{q}: {e!r}")
+
+        def stream(q, c):
+            try:
+                with _post(url, {
+                    "max_tokens": c, "stream": True,
+                    "messages": [{"role": "user", "content": q}],
+                }) as resp:
+                    raw = resp.read().decode()
+                chunks = [
+                    json.loads(l[6:]) for l in raw.splitlines()
+                    if l.startswith("data: ") and l != "data: [DONE]"
+                ]
+                results[q] = "".join(
+                    c["choices"][0]["delta"].get("content") or ""
+                    for c in chunks if c.get("choices")
+                )
+            except Exception as e:
+                errors.append(f"{q}: {e!r}")
+
+        threads = (
+            [threading.Thread(target=nonstream, args=(q, c))
+             for q, c in text_qs]
+            + [threading.Thread(target=nonstream, args=(q, c, im))
+               for (q, c), im in zip(img_qs, imgs)]
+            + [threading.Thread(target=stream, args=(q, c))
+               for q, c in stream_qs]
+        )
+        assert len(threads) == 8
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads), "client hung"
+        assert not errors, errors
+        for q, want in refs.items():
+            assert results.get(q) == want, (
+                f"{q!r}: {results.get(q)!r} != single-request {want!r}"
+            )
+        # A real multi-row batch formed out of the concurrent traffic.
+        assert max(n for n, _, _ in calls) > 1, calls

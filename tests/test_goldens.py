@@ -1,0 +1,215 @@
+"""Frozen byte-level goldens for tokenization + label masking.
+
+SURVEY.md §4 names the conversation/prompt layer (exp oryx/conversation.py,
+~400 LoC) as the classic silent-breakage spot: a refactor that perturbs one
+separator or mask boundary changes training data everywhere with no test
+failing. These goldens pin, byte for byte:
+
+  * the template prompt STRING (Conversation.get_prompt) and
+  * the (input_ids, labels) streams from train/data.preprocess_conversation
+    under a FROZEN deterministic tokenizer (specials get fixed ids,
+    characters map to 2000+codepoint — no network/tokenizer assets needed)
+
+for qwen_1_5 (multi-turn + multi-image, video) and plain (stage-1
+captioning), plus the video sentinel expansion with and without the
+frame-separator hook.
+
+Checked-in golden: tests/goldens/conversation_goldens.json. To regenerate
+after an INTENTIONAL behavior change:
+
+    GOLDEN_UPDATE=1 python -m pytest tests/test_goldens.py
+
+and review the golden diff like any other code change.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from oryx_tpu.constants import IGNORE_INDEX, IMAGE_TOKEN_INDEX
+from oryx_tpu.conversation import conv_templates
+from oryx_tpu.models import splice
+from oryx_tpu.train import data as data_lib
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(__file__), "goldens", "conversation_goldens.json"
+)
+
+_SPECIALS = {"<|im_start|>": 1001, "<|im_end|>": 1002, "</s>": 1003}
+
+
+class GoldenTokenizer:
+    """Frozen deterministic tokenizer: multi-char specials get fixed ids,
+    every other character maps to 2000+codepoint. NOT a real tokenizer —
+    its only job is to make the golden streams stable and reviewable."""
+
+    def encode(self, text, add_special_tokens=False):
+        ids, i = [], 0
+        while i < len(text):
+            for s, sid in _SPECIALS.items():
+                if text.startswith(s, i):
+                    ids.append(sid)
+                    i += len(s)
+                    break
+            else:
+                ids.append(2000 + ord(text[i]))
+                i += 1
+        return ids
+
+    def decode(self, ids, skip_special_tokens=True):
+        rev = {v: k for k, v in _SPECIALS.items()}
+        out = []
+        for t in ids:
+            t = int(t)
+            if t in rev:
+                if not skip_special_tokens:
+                    out.append(rev[t])
+            elif t >= 2000:
+                out.append(chr(t - 2000))
+        return "".join(out)
+
+
+RECORDS = {
+    # Multi-turn, multi-image SFT record (qwen_1_5 ChatML template).
+    "qwen_1_5/multi_turn_multi_image": {
+        "template": "qwen_1_5",
+        "rec": {
+            "conversations": [
+                {"from": "human",
+                 "value": "<image>\n<image>\nWhat changed between these?"},
+                {"from": "gpt", "value": "The cat moved to the sofa."},
+                {"from": "human", "value": "Anything else?"},
+                {"from": "gpt", "value": "No."},
+            ]
+        },
+    },
+    # Video QA record: ONE placeholder (expanded per-frame by the
+    # collator; the expansion goldens below pin that layout).
+    "qwen_1_5/video": {
+        "template": "qwen_1_5",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>\nDescribe the video."},
+                {"from": "gpt", "value": "A dog runs across a field."},
+            ]
+        },
+    },
+    # Stage-1 projector pretraining (plain template): caption only.
+    "plain/caption": {
+        "template": "plain",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>"},
+                {"from": "gpt", "value": "a red bicycle leaning on a wall"},
+            ]
+        },
+    },
+}
+
+
+def _prompt_string(name: str) -> str:
+    case = RECORDS[name]
+    conv = conv_templates[case["template"]].copy()
+    role = {"human": conv.roles[0], "gpt": conv.roles[1]}
+    for m in case["rec"]["conversations"]:
+        conv.append_message(role[m["from"]], m["value"])
+    return conv.get_prompt()
+
+
+def _build_goldens() -> dict:
+    tok = GoldenTokenizer()
+    out = {}
+    for name, case in RECORDS.items():
+        conv = conv_templates[case["template"]]
+        ids, labels = data_lib.preprocess_conversation(
+            case["rec"], tok, conv
+        )
+        out[name] = {
+            "prompt": _prompt_string(name),
+            "ids": [int(t) for t in ids],
+            "labels": [int(t) for t in labels],
+        }
+    # Video sentinel expansion layouts (3 frames), separator off and on
+    # ("\n" under the frozen tokenizer is 2010).
+    vids, vlabels = data_lib.preprocess_conversation(
+        RECORDS["qwen_1_5/video"]["rec"], tok, conv_templates["qwen_1_5"]
+    )
+    for key, sep in (("expanded_plain", ()), ("expanded_sep", (2010,))):
+        eids, elabels = splice.expand_video_sentinels(
+            vids, 3, labels=vlabels, sep_ids=sep
+        )
+        out[f"qwen_1_5/video/{key}"] = {
+            "ids": [int(t) for t in eids],
+            "labels": [int(t) for t in elabels],
+        }
+    return out
+
+
+def test_conversation_goldens():
+    got = _build_goldens()
+    if os.environ.get("GOLDEN_UPDATE") == "1":
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(got, f, indent=1, sort_keys=True)
+        raise AssertionError(
+            "goldens regenerated — review the diff and re-run without "
+            "GOLDEN_UPDATE"
+        )
+    with open(GOLDEN_PATH) as f:
+        want = json.load(f)
+    assert set(got) == set(want), (set(got) ^ set(want))
+    for name in want:
+        # Field sets must match too — a field newly emitted by
+        # _build_goldens() is unpinned until regenerated, which this
+        # catches instead of silently skipping it.
+        assert set(got[name]) == set(want[name]), (
+            f"{name}: fields {set(got[name]) ^ set(want[name])} differ — "
+            f"GOLDEN_UPDATE=1 and review the diff"
+        )
+        for field in want[name]:
+            assert got[name][field] == want[name][field], (
+                f"{name}.{field} drifted from the checked-in golden — if "
+                f"intentional, GOLDEN_UPDATE=1 and review the diff"
+            )
+
+
+def test_golden_masking_invariants():
+    """Structural checks the goldens imply (so a reviewer of a golden
+    diff can trust the semantics, not just the bytes): sentinels are
+    IGNORE everywhere; only assistant reply bytes (+ closing separator)
+    are supervised in ChatML; plain supervises exactly the caption."""
+    tok = GoldenTokenizer()
+    ids, labels = data_lib.preprocess_conversation(
+        RECORDS["qwen_1_5/multi_turn_multi_image"]["rec"], tok,
+        conv_templates["qwen_1_5"],
+    )
+    assert int(np.sum(ids == IMAGE_TOKEN_INDEX)) == 2
+    assert all(
+        l == IGNORE_INDEX for i, l in zip(ids, labels)
+        if i == IMAGE_TOKEN_INDEX
+    )
+    # Supervised text decodes to exactly the assistant replies (+ the
+    # closing <|im_end|>\n separators).
+    sup = [int(i) for i, l in zip(ids, labels) if l != IGNORE_INDEX]
+    assert tok.decode(sup, skip_special_tokens=False) == (
+        "The cat moved to the sofa.<|im_end|>\nNo.<|im_end|>\n"
+    )
+
+    pids, plabels = data_lib.preprocess_conversation(
+        RECORDS["plain/caption"]["rec"], tok, conv_templates["plain"]
+    )
+    sup = [int(i) for i, l in zip(pids, plabels) if l != IGNORE_INDEX]
+    assert tok.decode(sup) == "a red bicycle leaning on a wall\n"
+
+
+def test_yi_34b_template_maps_to_chatml():
+    """The 34B (Yi backbone) template decision, documented in
+    MIGRATING.md: Yi-34B-Chat speaks ChatML with the same
+    <|im_start|>/<|im_end|> markers as Qwen, so oryx_34b serves and
+    trains with the SAME ChatML template ("qwen"/"qwen_1_5"); the
+    registry carries an explicit "yi_34b" alias so launch scripts can
+    name it. If the populated reference reveals a different 34B
+    template, update the alias + goldens together."""
+    assert "yi_34b" in conv_templates
+    assert conv_templates["yi_34b"] is conv_templates["qwen"]
