@@ -55,8 +55,23 @@ def init_state(
 
 
 def microbatch_loss(
-    params: Params, cfg: OryxConfig, mb: dict[str, jnp.ndarray]
+    params: Params, cfg: OryxConfig, mb: dict[str, jnp.ndarray],
+    sharding_mode: str = "fsdp",
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    # One sharded-constrained cast of the whole tree to the compute
+    # dtype (sharding.cast_params_for_compute): ZeRO-3 use-site
+    # all-gathers and the grad reduce-scatter then ride bf16, not fp32
+    # — half the ICI bytes and gather temps. The per-use .astype casts
+    # inside the model become no-ops; grads convert back to fp32 here.
+    compute_dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+        cfg.dtype
+    ]
+    if compute_dtype != jnp.float32:
+        from oryx_tpu.parallel.sharding import cast_params_for_compute
+
+        params = cast_params_for_compute(
+            params, compute_dtype, sharding_mode
+        )
     hidden = oryx.forward(
         params, cfg,
         patches=mb["patches"], segment_ids=mb["segment_ids"],
@@ -67,9 +82,7 @@ def microbatch_loss(
         positions=mb["positions"],
         text_segment_ids=mb.get("text_segment_ids"),
         remat=cfg.train.remat_policy if cfg.train.remat else "none",
-        compute_dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
-            cfg.dtype
-        ],
+        compute_dtype=compute_dtype,
         return_hidden=True,
     )
     llm_p = params["llm"]
@@ -88,18 +101,27 @@ def train_step_fn(
     batch: dict[str, jnp.ndarray],
     cfg: OryxConfig,
     tx: optax.GradientTransformation,
+    sharding_mode: str = "fsdp",
 ) -> tuple[TrainState, dict[str, jnp.ndarray]]:
     """One optimizer step over `accum` microbatches (unjitted body).
 
     batch: each leaf has leading [accum, ...] microbatch axis (accum == 1
     for plain steps); visual buffers are packed per-microbatch.
 
+    sharding_mode: the parallel/sharding.py mode the params are placed
+    under — used to constrain the compute-dtype cast of the params (see
+    microbatch_loss) so weight all-gathers ride bf16. Harmless when it
+    merely mismatches the actual placement off-mesh (constrain no-ops).
+
     Callers with explicit state shardings (Trainer) jit this with
     out_shardings pinned to the input state's shardings — otherwise GSPMD
     may re-shard updated params to the optimizer-state sharding (e.g.
     ZeRO-2's replicated params silently become fsdp-sharded after step 1).
     """
-    grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
+    grad_fn = jax.value_and_grad(
+        lambda p, c, m: microbatch_loss(p, c, m, sharding_mode),
+        has_aux=True,
+    )
     accum = jax.tree.leaves(batch)[0].shape[0]
 
     if accum == 1:
@@ -163,5 +185,6 @@ def train_step_fn(
 
 
 train_step = partial(
-    jax.jit, static_argnames=("cfg", "tx"), donate_argnames=("state",)
+    jax.jit, static_argnames=("cfg", "tx", "sharding_mode"),
+    donate_argnames=("state",),
 )(train_step_fn)

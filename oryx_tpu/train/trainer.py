@@ -105,7 +105,7 @@ class Trainer:
             )
             self._step = jax.jit(
                 step_lib.train_step_fn,
-                static_argnames=("cfg", "tx"),
+                static_argnames=("cfg", "tx", "sharding_mode"),
                 donate_argnames=("state",),
                 out_shardings=(state_shardings, None),
             )
@@ -190,7 +190,8 @@ class Trainer:
                     # replicated params to the fsdp opt-state spec after
                     # step 1 (see train_step_fn docstring).
                     self.state, metrics = self._step(
-                        self.state, batch, cfg=cfg, tx=self.tx
+                        self.state, batch, cfg=cfg, tx=self.tx,
+                        sharding_mode=self.sharding_mode,
                     )
                     host_metrics = jax.device_get(metrics)
                     self.logger.log_step(step_i + 1, host_metrics)

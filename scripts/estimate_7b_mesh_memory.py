@@ -1,0 +1,301 @@
+"""AOT per-chip memory proof: Oryx-7B SFT on a 16-device FSDP mesh.
+
+Answers SURVEY.md §7 hard part 5 ("does the 7B train state actually fit
+a v5e-16?") without 16 chips: lowers + compiles the FULL sharded train
+step for the shipped `scripts/configs/oryx_7b_sft.json` (mesh dp=1
+fsdp=16, 128-row optimizer step, the bench 2048-token mixed image+text
+row composition) from ShapeDtypeStructs — no 7B params are ever
+materialized — and reads the compiler's per-device memory analysis for
+each (remat policy, moment dtype, grad accum) point.
+
+Compiler target, in order of preference:
+  * **TPU topology AOT** (default): `jax.experimental.topologies` with
+    the local libtpu compiles for a REAL v5e:4x4 (16-chip) target with
+    no chips attached — argument/temp bytes are the actual XLA:TPU
+    buffer assignment, bf16 at true width.
+  * CPU forced-16-device fallback (`AOT7B_PLATFORM=cpu`): portable, but
+    XLA:CPU's float normalization widens every bf16 buffer to fp32, so
+    temp bytes overstate the TPU footprint by roughly the bf16 share
+    (measured: 15.8 GB CPU-temp vs 9.3 GB TPU-temp for the same
+    attn/accum-8 program). Use only for policy DELTAS.
+
+    python scripts/estimate_7b_mesh_memory.py [policy[:moment_dtype[:accum]] ...]
+
+One JSON line per case:
+  {"policy": ..., "moment_dtype": ..., "grad_accum_steps": ...,
+   "args_gb": ..., "temp_gb": ..., "total_gb": ..., "state_gb_total": ...,
+   "sharded_ok": true, "fits_16gb": ...}
+and a final {"winner": ..., "table": [...]} summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GB = 1024**3
+N_DEV = 16
+_CHILD_ENV = "ORYX_TPU_AOT7B_CHILD"
+V5E_HBM_GB = 16.0
+
+# The optimizer step covers the config's 128 global rows over 16 chips;
+# grad accumulation splits it into microbatches (the scan in
+# train/step.py), which is THE activation-memory lever at fixed global
+# batch. Row composition mirrors the bench geometry (2048-token bucket,
+# one 448px image per row -> 256 patches, 64 visual tokens at 4x).
+ROWS_STEP = 128
+SEQ = 2048
+PATCHES_PER_IMG = 256
+Q_PER_IMG = 64
+
+
+def _devices():
+    """16 compile-target devices: TPU topology (preferred) or forced CPU."""
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("AOT7B_PLATFORM") == "cpu":
+        devs = jax.devices("cpu")
+        if len(devs) < N_DEV:
+            raise RuntimeError(
+                f"need {N_DEV} CPU devices "
+                f"(XLA_FLAGS=--xla_force_host_platform_device_count={N_DEV})"
+            )
+        return np.array(devs[:N_DEV]), "cpu_forced16"
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:4x4")
+    return np.array(topo.devices), "tpu_v5e_4x4_topology"
+
+
+def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.parallel import sharding
+    from oryx_tpu.train import step as step_lib
+    from oryx_tpu.train.optimizer import make_optimizer
+
+    with open(os.path.join(REPO, "scripts/configs/oryx_7b_sft.json")) as f:
+        cfg = cfg_lib.OryxConfig.from_dict(json.load(f))
+    assert cfg.mesh.fsdp == N_DEV and cfg.mesh.num_devices == N_DEV
+    cfg = dataclasses.replace(
+        cfg,
+        attn_impl="xla",  # topology AOT has no Pallas lowering context;
+        # the xla path's residual/activation shapes match
+        train=dataclasses.replace(
+            cfg.train,
+            remat=policy != "none",
+            remat_policy=policy if policy != "none" else "block",
+            moment_dtype=moment_dtype,
+            grad_accum_steps=accum,
+        ),
+    )
+    devs, target = _devices()
+    mesh = jax.sharding.Mesh(
+        devs.reshape(cfg.mesh.dp, cfg.mesh.fsdp, cfg.mesh.tp, cfg.mesh.sp),
+        ("dp", "fsdp", "tp", "sp"),
+    )
+
+    params_shape = jax.eval_shape(
+        lambda: oryx.init_params(cfg, jax.random.key(0))
+    )
+    tx = make_optimizer(cfg.train, params_shape)
+    opt_shape = jax.eval_shape(tx.init, params_shape)
+    pshard = sharding.param_shardings(mesh, params_shape, "fsdp")
+    ospecs = sharding.opt_state_specs(opt_shape, params_shape, "fsdp")
+    oshard = jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+    )
+
+    def sds(shape_struct, shard):
+        return jax.ShapeDtypeStruct(
+            shape_struct.shape, shape_struct.dtype, sharding=shard
+        )
+
+    state_in = step_lib.TrainState(
+        step=sds(
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        ),
+        params=jax.tree.map(sds, params_shape, pshard),
+        opt_state=jax.tree.map(sds, opt_shape, oshard),
+    )
+
+    assert ROWS_STEP % accum == 0
+    rows = ROWS_STEP // accum  # rows per microbatch (scan over accum)
+    P = rows * PATCHES_PER_IMG
+    Q = rows * Q_PER_IMG
+    PS = jax.sharding.PartitionSpec
+
+    def bsds(shape, dtype):
+        # Packed visual buffers and batch rows shard over the data width
+        # when divisible (the dryrun/train placement rule).
+        spec = PS(None, ("dp", "fsdp")) if shape[1] % N_DEV == 0 else PS()
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=jax.sharding.NamedSharding(mesh, spec)
+        )
+
+    patch_dim = cfg.vision.patch_size**2 * 3
+    batch = {
+        "patches": bsds((accum, P, patch_dim), jnp.float32),
+        "segment_ids": bsds((accum, P), jnp.int32),
+        "pos_coords": bsds((accum, P, 2), jnp.float32),
+        "region_ids": bsds((accum, P), jnp.int32),
+        "q_region_ids": bsds((accum, Q), jnp.int32),
+        "token_ids": bsds((accum, rows, SEQ), jnp.int32),
+        "visual_idx": bsds((accum, rows, SEQ), jnp.int32),
+        "is_visual": bsds((accum, rows, SEQ), jnp.bool_),
+        "attn_mask": bsds((accum, rows, SEQ), jnp.int32),
+        "positions": bsds((accum, rows, SEQ), jnp.int32),
+        "labels": bsds((accum, rows, SEQ), jnp.int32),
+    }
+
+    jit_step = jax.jit(
+        step_lib.train_step_fn,
+        static_argnames=("cfg", "tx", "sharding_mode"),
+        donate_argnames=("state",),
+    )
+    base = {
+        "target": target,
+        "policy": policy,
+        "moment_dtype": moment_dtype,
+        "grad_accum_steps": accum,
+        "rows_per_chip_micro": rows // N_DEV,
+    }
+    try:
+        with jax.sharding.set_mesh(mesh):
+            compiled = jit_step.lower(
+                state_in, batch, cfg=cfg, tx=tx, sharding_mode="fsdp"
+            ).compile()
+    except Exception as e:  # XLA:TPU enforces HBM at compile time:
+        # RESOURCE_EXHAUSTED "Used X of Y hbm" IS the does-not-fit
+        # verdict, with the exact required footprint in the message.
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" not in msg:
+            raise
+        m = re.search(r"Used ([\d.]+)G of ([\d.]+)G hbm", msg)
+        return {
+            **base,
+            "oom": True,
+            "total_gb": float(m.group(1)) if m else None,
+            "hbm_gb": float(m.group(2)) if m else None,
+            "sharded_ok": False,
+            "fits_16gb": False,
+        }
+    ma = compiled.memory_analysis()
+
+    param_bytes = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(params_shape)
+    )
+    opt_bytes = sum(
+        int(np.prod(getattr(l, "shape", ()))) * l.dtype.itemsize
+        for l in jax.tree.leaves(opt_shape)
+        if hasattr(l, "dtype")
+    )
+    total_state = param_bytes + opt_bytes
+    per_dev_args = ma.argument_size_in_bytes
+    # ZeRO-3 proof: per-device args ~ state/16 — a replicated 152064x3584
+    # embedding (2.2 GB + its moments) would blow the 5% tolerance.
+    sharded_ok = (
+        abs(per_dev_args - total_state / N_DEV) < 0.05 * total_state / N_DEV
+    )
+    total = (
+        ma.argument_size_in_bytes + ma.temp_size_in_bytes
+        + ma.output_size_in_bytes - ma.alias_size_in_bytes
+    )
+    return {
+        **base,
+        "params_b": round(param_bytes / 4 / 1e9, 2),
+        "state_gb_total": round(total_state / GB, 1),
+        "args_gb": round(per_dev_args / GB, 2),
+        "temp_gb": round(ma.temp_size_in_bytes / GB, 2),
+        "alias_gb": round(ma.alias_size_in_bytes / GB, 2),
+        "total_gb": round(total / GB, 2),
+        "sharded_ok": bool(sharded_ok),
+        "fits_16gb": bool(total < V5E_HBM_GB * GB),
+    }
+
+
+def main() -> None:
+    if os.environ.get(_CHILD_ENV) != "1":
+        # Re-exec in a clean child: the caller's process may hold a
+        # 1-chip TPU backend (axon) or an 8-device test platform. The
+        # child's jax client is CPU; the TPU *compiler* target comes
+        # from the topology API, not the client platform.
+        env = dict(os.environ)
+        env[_CHILD_ENV] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        prior = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            prior + [f"--xla_force_host_platform_device_count={N_DEV}"]
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+            env=env, cwd=REPO,
+        )
+        sys.exit(proc.returncode)
+
+    # Case syntax: policy[:moment_dtype[:accum]] (e.g. attn_o:bfloat16:4).
+    # Default ladder: the accum=1 whole-step compile documents WHY grad
+    # accumulation is required (temps blow 16 GB), then the remat ladder
+    # at the config's production accum (fp32 moments after bf16 at equal
+    # policy, so the winner rule below prefers fp32 when both fit).
+    cases = [("attn", "float32", 1),
+             ("block", "float32", 8), ("attn", "float32", 8),
+             ("attn_qkv", "float32", 8), ("attn_o", "bfloat16", 8),
+             ("attn_o", "float32", 8)]
+    if len(sys.argv) > 1:
+        def parse(p):
+            bits = p.split(":")
+            return (bits[0], bits[1] if len(bits) > 1 else "float32",
+                    int(bits[2]) if len(bits) > 2 else 1)
+        cases = [parse(p) for p in sys.argv[1:]]
+    table = []
+    for policy, mdt, accum in cases:
+        rec = one(policy, mdt, accum)
+        table.append(rec)
+        print(json.dumps(rec), flush=True)
+    fitting = [r for r in table if r["fits_16gb"] and r["sharded_ok"]]
+    # Winner: the fitting policy that saves the most recompute — the
+    # ladder is ordered cheapest-recompute-last (and fp32 moments after
+    # bf16 at equal policy), so take the LAST fit.
+    winner = fitting[-1] if fitting else None
+    print(json.dumps({
+        "winner": winner and (
+            f"{winner['policy']}:{winner['moment_dtype']}"
+            f":{winner['grad_accum_steps']}"
+        ),
+        "n_fitting": len(fitting),
+        "table": [
+            {k: r[k] for k in ("policy", "moment_dtype", "grad_accum_steps",
+                               "total_gb", "fits_16gb", "sharded_ok")}
+            for r in table
+        ],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

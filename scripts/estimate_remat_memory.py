@@ -1,17 +1,23 @@
 """AOT memory estimate of the bench-geometry train step per remat
-policy — no TPU needed.
+policy — no TPU chip needed.
 
 Lowers + compiles the full SFT step for the REAL bench geometry
-(bench._bench_cfg's TPU branch) on one CPU device from
-ShapeDtypeStructs (no 0.7B params materialized) and reads the
-compiler's memory analysis. Argument bytes are exact arithmetic
-(params + AdamW state + batch); temp bytes are the CPU compiler's
-estimate — fusion details differ from TPU, but the DELTAS between remat
-policies are dominated by the saved-residual buffers, which exist
-identically on both backends. Use it to sanity-check whether a policy
-plausibly fits the 16 GB v5e before spending chip time.
+(bench._bench_cfg's TPU branch) from ShapeDtypeStructs (no 0.7B params
+materialized) and reads the compiler's memory analysis.
 
-    python scripts/estimate_remat_memory.py [policy ...]
+Compile target (REMAT_EST_PLATFORM env, default "tpu"): with the local
+libtpu, a v5e:1x1 TOPOLOGY compile gives the actual XLA:TPU buffer
+assignment — bf16 at true width, HBM capacity enforced at compile time
+(RESOURCE_EXHAUSTED is captured and reported as {"oom": true} with the
+required footprint). "cpu" falls back to the one-CPU-device compile;
+XLA:CPU's float normalization widens bf16 buffers to fp32, so those
+temp bytes only support policy DELTAS, not absolute fits. The bench
+cfg's attn_impl is forced to "xla" either way (the Pallas kernel does
+not lower in a deviceless topology compile); Pallas saves strictly
+less than the xla path's logits-shaped residuals, so an xla-path FIT is
+conservative for the real bench.
+
+    python scripts/estimate_remat_memory.py [policy[:moment_dtype] ...]
 """
 
 from __future__ import annotations
@@ -27,8 +33,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 GB = 1024**3
 
 
+def _target_device():
+    """One compile-target device: v5e topology (default) or local CPU."""
+    import jax
+
+    if os.environ.get("REMAT_EST_PLATFORM", "tpu") == "cpu":
+        return jax.devices("cpu")[0], "cpu"
+    from jax.experimental import topologies
+
+    # Smallest valid v5e layout is 2x2 (host bounds); the single-device
+    # program below targets one chip of it.
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    return topo.devices[0], "tpu_v5e_topology"
+
+
 def one(policy: str, moment_dtype: str = "float32") -> dict:
     import dataclasses
+    import re
 
     import jax
     import jax.numpy as jnp
@@ -61,28 +83,55 @@ def one(policy: str, moment_dtype: str = "float32") -> dict:
         params=params_shape,
         opt_state=opt_shape,
     )
+    dev, target = _target_device()
+    shard = jax.sharding.SingleDeviceSharding(dev)
+    state_in = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shard),
+        state_in,
+    )
     batch = {
-        k: jax.ShapeDtypeStruct((1, *v.shape), jnp.asarray(v).dtype)
+        # canonicalize_dtype (x64-off int64->int32 etc.) without
+        # materializing device arrays.
+        k: jax.ShapeDtypeStruct(
+            (1, *v.shape), jax.dtypes.canonicalize_dtype(v.dtype),
+            sharding=shard,
+        )
         for k, v in host.items()
     }
     jit_step = jax.jit(
         step_lib.train_step_fn, static_argnames=("cfg", "tx"),
         donate_argnames=("state",),
     )
-    compiled = jit_step.lower(state_in, batch, cfg=cfg, tx=tx).compile()
-    ma = compiled.memory_analysis()
     overrides = {
         k: os.environ[k]
         for k in ("BENCH_BATCH", "BENCH_SEQ", "BENCH_LOSS_CHUNK")
         if os.environ.get(k)
     }
-    return {
+    base = {
+        "target": target,
         "geometry": geo,
         "policy": policy,
         "moment_dtype": moment_dtype,
         # Inherited bench env overrides, recorded so a sweep-polluted
         # shell can't pass these numbers off as the default geometry.
         **({"env_overrides": overrides} if overrides else {}),
+    }
+    try:
+        compiled = jit_step.lower(state_in, batch, cfg=cfg, tx=tx).compile()
+    except Exception as e:  # XLA:TPU enforces HBM at compile time.
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" not in msg:
+            raise
+        m = re.search(r"Used ([\d.]+)G of ([\d.]+)G hbm", msg)
+        return {
+            **base,
+            "oom": True,
+            "total_gb": float(m.group(1)) if m else None,
+            "hbm_gb": float(m.group(2)) if m else None,
+        }
+    ma = compiled.memory_analysis()
+    return {
+        **base,
         "args_gb": round(ma.argument_size_in_bytes / GB, 2),
         "temp_gb": round(ma.temp_size_in_bytes / GB, 2),
         "total_gb": round(
@@ -92,7 +141,28 @@ def one(policy: str, moment_dtype: str = "float32") -> dict:
     }
 
 
+_CHILD_ENV = "ORYX_TPU_REMAT_EST_CHILD"
+
+
 def main() -> None:
+    if os.environ.get(_CHILD_ENV) != "1":
+        # Re-exec in a clean CPU-client child: the caller's process may
+        # otherwise initialize the default (axon TPU) backend just to
+        # build ShapeDtypeStructs, contending for the single-process
+        # chip claim. The TPU *compiler* target comes from the topology
+        # API, not the client platform.
+        import subprocess
+
+        env = dict(os.environ)
+        env[_CHILD_ENV] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+            env=env,
+        ).returncode)
+
     cases = [("block", "float32"), ("attn", "float32"),
              ("attn_qkv", "float32"), ("attn_o", "float32"),
              ("attn_o", "bfloat16")]
