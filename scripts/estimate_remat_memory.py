@@ -6,16 +6,17 @@ Lowers + compiles the full SFT step for the REAL bench geometry
 materialized) and reads the compiler's memory analysis.
 
 Compile target (REMAT_EST_PLATFORM env, default "tpu"): with the local
-libtpu, a v5e:1x1 TOPOLOGY compile gives the actual XLA:TPU buffer
+libtpu, a v5e TOPOLOGY compile gives the actual XLA:TPU buffer
 assignment — bf16 at true width, HBM capacity enforced at compile time
 (RESOURCE_EXHAUSTED is captured and reported as {"oom": true} with the
-required footprint). "cpu" falls back to the one-CPU-device compile;
-XLA:CPU's float normalization widens bf16 buffers to fp32, so those
-temp bytes only support policy DELTAS, not absolute fits. The bench
-cfg's attn_impl is forced to "xla" either way (the Pallas kernel does
-not lower in a deviceless topology compile); Pallas saves strictly
-less than the xla path's logits-shaped residuals, so an xla-path FIT is
-conservative for the real bench.
+required footprint) — for the REAL bench program including its Pallas
+flash-attention kernels (which lower fine in a chipless topology
+compile; pinned by tests/test_pallas_topology_compile.py). "cpu" falls
+back to the one-CPU-device compile: no Pallas lowering there, so the
+xla attention path substitutes (its larger backward transients make
+those numbers conservative), and XLA:CPU's float normalization widens
+bf16 buffers to fp32 — CPU temp bytes support policy DELTAS only, not
+absolute fits.
 
     python scripts/estimate_remat_memory.py [policy[:moment_dtype] ...]
 """
@@ -63,9 +64,19 @@ def one(policy: str, moment_dtype: str = "float32") -> dict:
     geo, cfg, batch_size, seq_bucket, img_side = _bench_cfg(
         "tpu", 16 * GB
     )
+    # The TPU topology target compiles the bench cfg AS-IS — whatever
+    # attention impl the real bench runs (Pallas lowers fine in a
+    # chipless topology compile). Only the CPU fallback substitutes the
+    # xla path (no Pallas lowering on CPU; its larger backward
+    # transients make those numbers conservative).
+    overrides_impl = (
+        {"attn_impl": "xla"}
+        if os.environ.get("REMAT_EST_PLATFORM", "tpu") == "cpu"
+        else {}
+    )
     cfg = dataclasses.replace(
         cfg,
-        attn_impl="xla",  # CPU-compilable; attention residuals same shape
+        **overrides_impl,
         train=dataclasses.replace(
             cfg.train, remat=policy != "none", moment_dtype=moment_dtype,
             remat_policy=policy if policy != "none" else "block",
