@@ -105,10 +105,11 @@ def forward(
     pooled = sums[q_region_ids] / jnp.maximum(counts[q_region_ids], 1.0)[:, None]
     pooled = pooled.astype(features.dtype)  # [Q, Hv]
     # The query axis shards over the data width exactly like the packing
-    # axis upstream (oryx_vit pins [1, P, H]); without the pin GSPMD
+    # axis upstream (oryx_vit pins [1, P, H], "sp" included — the query
+    # axis is pure data to the compressor); without the pin GSPMD
     # guesses the [Q, Hv] intermediates' shardings on meshes that also
     # carry tp, and the backward pays involuntary-remat reshards.
-    q_spec = (("dp", "fsdp"), None)
+    q_spec = (("dp", "fsdp", "sp"), None)
     pooled = constrain(pooled, *q_spec)
 
     # Region cross-attention: query = pooled token, keys/values = its s×s
@@ -144,7 +145,7 @@ def forward(
     # fc1's kernel is P('fsdp','tp') — pin the intermediate to the tp
     # column sharding the matmul produces so the backward agrees.
     x = jax.nn.gelu(_linear(x, params["projector"]["fc1"]), approximate=True)
-    x = constrain(x, ("dp", "fsdp"), "tp")
+    x = constrain(x, ("dp", "fsdp", "sp"), "tp")
     x = _linear(x, params["projector"]["fc2"])
 
     valid_q = (q_region_ids > 0)[:, None]

@@ -73,6 +73,14 @@ def encode_visual(
     The reference's `encode_images` (SURVEY.md §3.4): one ViT pass over all
     images/frames of the batch, then the Dynamic Compressor.
     """
+    # The vision tower keeps Pallas ONLY for single-program ("pallas")
+    # configs. Under the sequence-parallel decoder modes the packed
+    # patch axis is sharded across the mesh, and a pallas_call is not
+    # GSPMD-partitionable — XLA would all-gather the full packed q/k/v
+    # and run the kernel replicated per chip (+3.1 GB/chip at the
+    # 256-frame 34B/v5e-64 point, AOT-measured, round 5) — so the
+    # partitionable XLA segment-attention path is the right kernel
+    # there, not a fallback.
     feats = oryx_vit.forward(
         params["vit"], cfg.vision, patches, segment_ids, pos_coords,
         remat=remat, attn_impl=cfg.attn_impl, compute_dtype=compute_dtype,

@@ -69,12 +69,20 @@ LOGICAL_RULES: tuple[tuple[str, tuple[str | None, ...]], ...] = (
     ("compressor/*/weight", (None,)),
 )
 
-# logical axis → mesh axis, per mode.
-def mesh_rules(mode: str) -> dict[str, str | None]:
+# logical axis → mesh axis (or tuple of axes), per mode.
+def mesh_rules(mode: str) -> dict[str, str | tuple[str, ...] | None]:
     base = {"layer": None, "vocab": None, "heads": "tp", "mlp": "tp",
             "embed": None}
     if mode == "fsdp":
-        base["embed"] = "fsdp"
+        # ZeRO-3 shards over the COMBINED fsdp x sp width: sequence-
+        # parallel devices hold param shards too (ring attention only
+        # shard_maps activations; weights are use-site all-gathered
+        # across both axes). On an sp=1 mesh this is plain fsdp; on a
+        # long-video mesh like fsdp=16 x sp=4 it keeps the full 64-way
+        # state sharding — fsdp-only sharding there quadruples per-chip
+        # state (measured: the 34B/v5e-64 sp=4 compile OOMs without
+        # this, TPU_VALIDATION round 5).
+        base["embed"] = ("fsdp", "sp")
     elif mode not in ("zero2", "ddp"):
         raise ValueError(f"unknown sharding mode {mode!r}")
     return base
@@ -134,6 +142,31 @@ def shard_params(params: Params, shardings: Params) -> Params:
 def batch_spec() -> P:
     """Activations/batch shard over the full data-parallel width."""
     return P(("dp", "fsdp"))
+
+
+# Packed visual buffer fields of the training batch (ops/packing +
+# splice.query_slots layout): their second axis is the packing axis.
+VISUAL_BATCH_FIELDS = (
+    "patches", "segment_ids", "pos_coords", "region_ids", "q_region_ids",
+)
+
+
+def batch_field_spec(name: str) -> P:
+    """Per-field placement for a [accum, ...] training batch leaf.
+
+    Packed visual buffers ride the FULL (dp, fsdp, sp) width — their
+    packing axis is pure data to the vision tower, which pins its
+    intermediates to the same spec (oryx_vit/compressor), so sequence-
+    parallel devices take patch shards instead of idling through the
+    visual encode. Row-shaped token-stream fields ride the data width
+    only (the decoder's sp axis splits the SEQUENCE dim, not rows).
+    Must stay in lockstep with the AOT memory proofs
+    (scripts/estimate_7b_mesh_memory.py) — the proven program's
+    argument placement is the trainer's.
+    """
+    if name in VISUAL_BATCH_FIELDS:
+        return P(None, ("dp", "fsdp", "sp"))
+    return P(None, ("dp", "fsdp"))
 
 
 def cast_params_for_compute(params: Params, dtype, mode: str = "fsdp"):

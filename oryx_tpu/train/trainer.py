@@ -127,15 +127,13 @@ class Trainer:
         its own packed visual buffer; slicing a globally-packed buffer
         would corrupt visual_idx/region_ids.
 
-        Every field shards its per-microbatch leading axis over the data
-        width: for token-stream fields that is plain data parallelism; for
-        packed visual buffers it is sequence parallelism over the packing
-        axis (ViT projections/MLP shard over patches; GSPMD all-gathers
-        K/V for the segment-masked attention).
+        Placement is per-field (sharding.batch_field_spec): packed
+        visual buffers shard their packing axis over the FULL
+        (dp, fsdp, sp) width — matching the vision tower's pinned specs
+        and the AOT memory proofs — while token-stream rows shard over
+        the data width.
         """
         accum = self.cfg.train.grad_accum_steps
-        bspec = sharding.batch_spec()
-        width = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
 
         def put(name, x):
             x = np.asarray(x)
@@ -148,11 +146,12 @@ class Trainer:
                     )
             else:
                 x = x[None]
-            spec = (
-                jax.sharding.PartitionSpec(None, *bspec)
-                if x.shape[1] % max(width, 1) == 0
-                else jax.sharding.PartitionSpec()
-            )
+            spec = sharding.batch_field_spec(name)
+            width = 1
+            for ax in spec[1]:
+                width *= self.mesh.shape[ax]
+            if x.shape[1] % max(width, 1) != 0:
+                spec = jax.sharding.PartitionSpec()
             return jax.device_put(
                 jnp.asarray(x), jax.sharding.NamedSharding(self.mesh, spec)
             )

@@ -1,23 +1,41 @@
-"""AOT per-chip memory proof: Oryx-7B SFT on a 16-device FSDP mesh.
+"""AOT per-chip memory proof: an Oryx config's SFT step on its full mesh.
 
 Answers SURVEY.md §7 hard part 5 ("does the 7B train state actually fit
-a v5e-16?") without 16 chips: lowers + compiles the FULL sharded train
-step for the shipped `scripts/configs/oryx_7b_sft.json` (mesh dp=1
-fsdp=16, 128-row optimizer step, the bench 2048-token mixed image+text
-row composition) from ShapeDtypeStructs — no 7B params are ever
+a v5e-16?" — and the 34B/longvideo pod questions) without chips: lowers
++ compiles the FULL sharded train step for a shipped config JSON on its
+own `mesh` block from ShapeDtypeStructs — no params are ever
 materialized — and reads the compiler's per-device memory analysis for
 each (remat policy, moment dtype, grad accum) point.
 
+Defaults prove `scripts/configs/oryx_7b_sft.json` on a v5e-16; env
+knobs generalize it:
+  AOT_CONFIG      config JSON path (default scripts/configs/oryx_7b_sft.json);
+                  the device count and mesh shape come from its `mesh`
+  AOT_ROWS_STEP   rows per optimizer step (default 128)
+  AOT_SEQ         token bucket per row (default 2048)
+  AOT_FRAMES      0 (default) = one 448px image per row (256 patches,
+                  64 visual tokens at 4x); N = N-frame video per row
+                  (64 patches and 4 visual tokens per frame at 16x —
+                  BASELINE config 5's long-video shape)
+  AOT_MESH        "dp,fsdp,tp,sp" mesh override (same device count).
+                  sp>1 switches attention to ring_flash (sequence
+                  parallelism) — the long-video lever: a smaller data
+                  width admits deeper grad accumulation, cutting
+                  tokens/chip/microbatch below pure-FSDP's floor of
+                  one full row per chip
+
 Compiler target, in order of preference:
   * **TPU topology AOT** (default): `jax.experimental.topologies` with
-    the local libtpu compiles for a REAL v5e:4x4 (16-chip) target with
-    no chips attached — argument/temp bytes are the actual XLA:TPU
-    buffer assignment, bf16 at true width.
-  * CPU forced-16-device fallback (`AOT7B_PLATFORM=cpu`): portable, but
-    XLA:CPU's float normalization widens every bf16 buffer to fp32, so
-    temp bytes overstate the TPU footprint by roughly the bf16 share
-    (measured: 15.8 GB CPU-temp vs 9.3 GB TPU-temp for the same
-    attn/accum-8 program). Use only for policy DELTAS.
+    the local libtpu compiles for a REAL v5e target (4x4 for 16-chip
+    meshes, 8x8 for 64, ...) with no chips attached — argument/temp
+    bytes are the actual XLA:TPU buffer assignment, bf16 at true width,
+    and the config's shipped attn_impl (Pallas lowers fine) compiles
+    as-is.
+  * CPU forced-N-device fallback (`AOT7B_PLATFORM=cpu`): portable, but
+    the xla attention path substitutes (no Pallas on CPU) and XLA:CPU's
+    float normalization widens every bf16 buffer to fp32 (measured:
+    15.8 GB CPU-temp vs 9.3 GB TPU-temp for the same attn/accum-8
+    program). Use only for policy DELTAS.
 
     python scripts/estimate_7b_mesh_memory.py [policy[:moment_dtype[:accum]] ...]
 
@@ -40,40 +58,51 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 GB = 1024**3
-N_DEV = 16
 _CHILD_ENV = "ORYX_TPU_AOT7B_CHILD"
 V5E_HBM_GB = 16.0
 
-# The optimizer step covers the config's 128 global rows over 16 chips;
-# grad accumulation splits it into microbatches (the scan in
-# train/step.py), which is THE activation-memory lever at fixed global
-# batch. Row composition mirrors the bench geometry (2048-token bucket,
-# one 448px image per row -> 256 patches, 64 visual tokens at 4x).
-ROWS_STEP = 128
-SEQ = 2048
-PATCHES_PER_IMG = 256
-Q_PER_IMG = 64
+CONFIG = os.environ.get("AOT_CONFIG", "scripts/configs/oryx_7b_sft.json")
+# Grad accumulation splits the step's rows into microbatches (the scan
+# in train/step.py) — THE activation-memory lever at fixed global batch.
+ROWS_STEP = int(os.environ.get("AOT_ROWS_STEP", "128"))
+SEQ = int(os.environ.get("AOT_SEQ", "2048"))
+FRAMES = int(os.environ.get("AOT_FRAMES", "0"))
+if FRAMES:
+    # Long-video rows: FRAMES frames x 64 patches, 16x compression.
+    PATCHES_PER_ROW, Q_PER_ROW = FRAMES * 64, FRAMES * 4
+else:
+    # One 448px image per row: 256 patches, 64 visual tokens at 4x.
+    PATCHES_PER_ROW, Q_PER_ROW = 256, 64
+
+_TOPO_BY_N = {16: "v5e:4x4", 32: "v5e:4x8", 64: "v5e:8x8",
+              128: "v5e:8x16", 256: "v5e:16x16"}
 
 
-def _devices():
-    """16 compile-target devices: TPU topology (preferred) or forced CPU."""
+def _devices(n_dev: int):
+    """n compile-target devices: TPU topology (preferred) or forced CPU."""
     import numpy as np
 
     import jax
 
     if os.environ.get("AOT7B_PLATFORM") == "cpu":
         devs = jax.devices("cpu")
-        if len(devs) < N_DEV:
+        if len(devs) < n_dev:
             raise RuntimeError(
-                f"need {N_DEV} CPU devices "
-                f"(XLA_FLAGS=--xla_force_host_platform_device_count={N_DEV})"
+                f"need {n_dev} CPU devices "
+                f"(XLA_FLAGS=--xla_force_host_platform_device_count={n_dev})"
             )
-        return np.array(devs[:N_DEV]), "cpu_forced16"
+        return np.array(devs[:n_dev]), f"cpu_forced{n_dev}"
     from jax.experimental import topologies
 
-    topo = topologies.get_topology_desc(platform="tpu",
-                                        topology_name="v5e:4x4")
-    return np.array(topo.devices), "tpu_v5e_4x4_topology"
+    if n_dev not in _TOPO_BY_N:
+        raise ValueError(
+            f"no v5e topology mapped for {n_dev} devices; supported: "
+            f"{sorted(_TOPO_BY_N)} (or AOT7B_PLATFORM=cpu with a forced "
+            f"device count)"
+        )
+    name = _TOPO_BY_N[n_dev]
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
+    return np.array(topo.devices), f"tpu_{name.replace(':', '_')}_topology"
 
 
 def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
@@ -90,13 +119,29 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
     from oryx_tpu.train import step as step_lib
     from oryx_tpu.train.optimizer import make_optimizer
 
-    with open(os.path.join(REPO, "scripts/configs/oryx_7b_sft.json")) as f:
+    with open(os.path.join(REPO, CONFIG)) as f:
         cfg = cfg_lib.OryxConfig.from_dict(json.load(f))
-    assert cfg.mesh.fsdp == N_DEV and cfg.mesh.num_devices == N_DEV
+    if os.environ.get("AOT_MESH"):
+        dp, fsdp, tp, sp = map(int, os.environ["AOT_MESH"].split(","))
+        cfg = dataclasses.replace(
+            cfg,
+            mesh=dataclasses.replace(cfg.mesh, dp=dp, fsdp=fsdp,
+                                     tp=tp, sp=sp),
+        )
+    n_dev = cfg.mesh.num_devices
+    # As-shipped attn impl on the TPU target (Pallas lowers in topology
+    # compiles); the CPU fallback substitutes the xla path. Sequence
+    # parallelism trains under ring attention (the dryrun's rule).
+    if os.environ.get("AOT7B_PLATFORM") == "cpu":
+        overrides_impl = {"attn_impl": "xla" if cfg.mesh.sp == 1
+                          else "ring"}
+    elif cfg.mesh.sp > 1 and not cfg.attn_impl.startswith("ring"):
+        overrides_impl = {"attn_impl": "ring_flash"}
+    else:
+        overrides_impl = {}
     cfg = dataclasses.replace(
         cfg,
-        attn_impl="xla",  # topology AOT has no Pallas lowering context;
-        # the xla path's residual/activation shapes match
+        **overrides_impl,
         train=dataclasses.replace(
             cfg.train,
             remat=policy != "none",
@@ -105,7 +150,7 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
             grad_accum_steps=accum,
         ),
     )
-    devs, target = _devices()
+    devs, target = _devices(n_dev)
     mesh = jax.sharding.Mesh(
         devs.reshape(cfg.mesh.dp, cfg.mesh.fsdp, cfg.mesh.tp, cfg.mesh.sp),
         ("dp", "fsdp", "tp", "sp"),
@@ -139,32 +184,43 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
 
     assert ROWS_STEP % accum == 0
     rows = ROWS_STEP // accum  # rows per microbatch (scan over accum)
-    P = rows * PATCHES_PER_IMG
-    Q = rows * Q_PER_IMG
+    P = rows * PATCHES_PER_ROW
+    Q = rows * Q_PER_ROW
     PS = jax.sharding.PartitionSpec
+    data_width = cfg.mesh.dp * cfg.mesh.fsdp
 
-    def bsds(shape, dtype):
-        # Packed visual buffers and batch rows shard over the data width
-        # when divisible (the dryrun/train placement rule).
-        spec = PS(None, ("dp", "fsdp")) if shape[1] % N_DEV == 0 else PS()
+    vis_width = data_width * cfg.mesh.sp
+
+    def bsds(name, shape, dtype):
+        # THE trainer placement rule (sharding.batch_field_spec, applied
+        # by field name — a divisibility heuristic would let the row
+        # axis leak onto sp at low accum): packed visual buffers shard
+        # over the full (dp, fsdp, sp) width, token rows over the data
+        # width; non-divisible axes replicate.
+        spec = sharding.batch_field_spec(name)
+        width = vis_width if name in sharding.VISUAL_BATCH_FIELDS \
+            else data_width
+        if shape[1] % width != 0:
+            spec = PS()
         return jax.ShapeDtypeStruct(
             shape, dtype, sharding=jax.sharding.NamedSharding(mesh, spec)
         )
 
     patch_dim = cfg.vision.patch_size**2 * 3
-    batch = {
-        "patches": bsds((accum, P, patch_dim), jnp.float32),
-        "segment_ids": bsds((accum, P), jnp.int32),
-        "pos_coords": bsds((accum, P, 2), jnp.float32),
-        "region_ids": bsds((accum, P), jnp.int32),
-        "q_region_ids": bsds((accum, Q), jnp.int32),
-        "token_ids": bsds((accum, rows, SEQ), jnp.int32),
-        "visual_idx": bsds((accum, rows, SEQ), jnp.int32),
-        "is_visual": bsds((accum, rows, SEQ), jnp.bool_),
-        "attn_mask": bsds((accum, rows, SEQ), jnp.int32),
-        "positions": bsds((accum, rows, SEQ), jnp.int32),
-        "labels": bsds((accum, rows, SEQ), jnp.int32),
+    shapes = {
+        "patches": ((accum, P, patch_dim), jnp.float32),
+        "segment_ids": ((accum, P), jnp.int32),
+        "pos_coords": ((accum, P, 2), jnp.float32),
+        "region_ids": ((accum, P), jnp.int32),
+        "q_region_ids": ((accum, Q), jnp.int32),
+        "token_ids": ((accum, rows, SEQ), jnp.int32),
+        "visual_idx": ((accum, rows, SEQ), jnp.int32),
+        "is_visual": ((accum, rows, SEQ), jnp.bool_),
+        "attn_mask": ((accum, rows, SEQ), jnp.int32),
+        "positions": ((accum, rows, SEQ), jnp.int32),
+        "labels": ((accum, rows, SEQ), jnp.int32),
     }
+    batch = {k: bsds(k, s, d) for k, (s, d) in shapes.items()}
 
     jit_step = jax.jit(
         step_lib.train_step_fn,
@@ -176,7 +232,10 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
         "policy": policy,
         "moment_dtype": moment_dtype,
         "grad_accum_steps": accum,
-        "rows_per_chip_micro": rows // N_DEV,
+        "mesh": f"dp{cfg.mesh.dp}_fsdp{cfg.mesh.fsdp}"
+                f"_tp{cfg.mesh.tp}_sp{cfg.mesh.sp}",
+        "attn_impl": cfg.attn_impl,
+        "tokens_per_chip_micro": rows * SEQ // n_dev,
     }
     try:
         with jax.sharding.set_mesh(mesh):
@@ -211,10 +270,11 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
     )
     total_state = param_bytes + opt_bytes
     per_dev_args = ma.argument_size_in_bytes
-    # ZeRO-3 proof: per-device args ~ state/16 — a replicated 152064x3584
-    # embedding (2.2 GB + its moments) would blow the 5% tolerance.
+    # ZeRO-3 proof: per-device args ~ state/n — a replicated embedding
+    # (2.2 GB at Qwen2-7B vocab, + its moments) would blow the 5%
+    # tolerance.
     sharded_ok = (
-        abs(per_dev_args - total_state / N_DEV) < 0.05 * total_state / N_DEV
+        abs(per_dev_args - total_state / n_dev) < 0.05 * total_state / n_dev
     )
     total = (
         ma.argument_size_in_bytes + ma.temp_size_in_bytes
@@ -244,13 +304,21 @@ def main() -> None:
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
         env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        # Forced device count only matters for the CPU fallback; size it
+        # from the config so any mesh width works.
+        cfg_path = os.path.join(REPO, CONFIG)
+        with open(cfg_path) as f:
+            m = json.load(f).get("mesh", {})
+        n_dev = 1
+        for ax in ("dp", "fsdp", "tp", "sp"):
+            n_dev *= int(m.get(ax, 1))
         prior = [
             f
             for f in env.get("XLA_FLAGS", "").split()
             if not f.startswith("--xla_force_host_platform_device_count")
         ]
         env["XLA_FLAGS"] = " ".join(
-            prior + [f"--xla_force_host_platform_device_count={N_DEV}"]
+            prior + [f"--xla_force_host_platform_device_count={n_dev}"]
         )
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],

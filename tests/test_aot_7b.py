@@ -68,11 +68,12 @@ def test_7b_v5e16_aot_memory():
     # blow the budget — the reason the shipped config accumulates. The
     # TPU compiler enforces HBM at compile time, so this surfaces as a
     # captured RESOURCE_EXHAUSTED with the required footprint (measured
-    # 17.27 GB at pinning time).
+    # 16.00 GB vs 15.75 usable for the shipped Pallas program; 17.27 on
+    # the xla path).
     whole = recs[("attn", 1)]
     assert not whole["fits_16gb"], whole
     assert whole.get("oom"), whole
     if whole.get("total_gb"):
-        assert whole["total_gb"] > 16.0, whole
+        assert whole["total_gb"] > 15.75, whole
 
     assert summary["winner"] == "attn:float32:8", summary
