@@ -142,8 +142,9 @@ def forward(
     x = constrain(pooled + _linear(o, params["o_proj"]), *q_spec)
 
     # MLP projector into LLM embedding space (mlp2x_gelu-equivalent).
-    # fc1's kernel is P('fsdp','tp') — pin the intermediate to the tp
-    # column sharding the matmul produces so the backward agrees.
+    # fc1's kernel is P(('fsdp','sp'),'tp') under fsdp mode — pin the
+    # intermediate to the tp column sharding the matmul produces so the
+    # backward agrees.
     x = jax.nn.gelu(_linear(x, params["projector"]["fc1"]), approximate=True)
     x = constrain(x, ("dp", "fsdp", "sp"), "tp")
     x = _linear(x, params["projector"]["fc2"])

@@ -189,19 +189,25 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
     PS = jax.sharding.PartitionSpec
     data_width = cfg.mesh.dp * cfg.mesh.fsdp
 
-    vis_width = data_width * cfg.mesh.sp
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    batch_bytes_per_chip = [0]
 
     def bsds(name, shape, dtype):
         # THE trainer placement rule (sharding.batch_field_spec, applied
         # by field name — a divisibility heuristic would let the row
         # axis leak onto sp at low accum): packed visual buffers shard
         # over the full (dp, fsdp, sp) width, token rows over the data
-        # width; non-divisible axes replicate.
+        # width; non-divisible axes replicate. Width derives from the
+        # spec itself (the trainer's drift-proof form).
         spec = sharding.batch_field_spec(name)
-        width = vis_width if name in sharding.VISUAL_BATCH_FIELDS \
-            else data_width
+        width = 1
+        for ax in spec[1]:
+            width *= mesh_sizes[ax]
         if shape[1] % width != 0:
-            spec = PS()
+            spec, width = PS(), 1
+        batch_bytes_per_chip[0] += (
+            int(np.prod(shape)) * jnp.dtype(dtype).itemsize // width
+        )
         return jax.ShapeDtypeStruct(
             shape, dtype, sharding=jax.sharding.NamedSharding(mesh, spec)
         )
@@ -270,11 +276,14 @@ def one(policy: str, moment_dtype: str = "float32", accum: int = 1) -> dict:
     )
     total_state = param_bytes + opt_bytes
     per_dev_args = ma.argument_size_in_bytes
-    # ZeRO-3 proof: per-device args ~ state/n — a replicated embedding
-    # (2.2 GB at Qwen2-7B vocab, + its moments) would blow the 5%
-    # tolerance.
+    # ZeRO-3 proof: per-device args minus the batch's own per-chip
+    # share ~ state/n — a replicated embedding (2.2 GB at Qwen2-7B
+    # vocab, + its moments) would blow the 5% tolerance. At long-video
+    # shapes the input buffers are GBs, so they must be accounted, not
+    # assumed negligible.
+    state_args = per_dev_args - batch_bytes_per_chip[0]
     sharded_ok = (
-        abs(per_dev_args - total_state / n_dev) < 0.05 * total_state / n_dev
+        abs(state_args - total_state / n_dev) < 0.05 * total_state / n_dev
     )
     total = (
         ma.argument_size_in_bytes + ma.temp_size_in_bytes
