@@ -1,14 +1,15 @@
-"""Oryx-34B (Yi geometry) AOT sharding + memory validation (SURVEY.md §7
-stage 6): lower + compile the full FSDP train step on the 8-device CPU
-mesh WITHOUT materializing 34B params (ShapeDtypeStructs only), then check
-the compiler's memory analysis against the ZeRO-3 math:
+"""Oryx-34B (Yi geometry) AOT sharding validation (SURVEY.md §7 stage
+6): lower + compile the full FSDP train step on the 8-device CPU mesh
+WITHOUT materializing 34B params (ShapeDtypeStructs only), then check
+the compiler's memory analysis against the ZeRO-3 math: per-device
+argument bytes ≈ total state / 8 → every large leaf is actually sharded
+(an accidentally-replicated embedding would add ~2 GB/device and fail
+the tolerance), and the donated state aliases in place.
 
-  * per-device argument bytes ≈ total state / 8  → every large leaf is
-    actually sharded (an accidentally-replicated embedding would add
-    ~2 GB/device and fail the tolerance);
-  * (arg + temp) extrapolated to a 64-chip pod stays under a v5e's 16 GB
-    HBM — all dominant buffers are param-shaped, hence ∝ 1/N.
-"""
+The 16 GB-per-chip POD fit is no longer extrapolated from CPU temps
+(XLA:CPU widens bf16 buffers and its fusion differs) — it is proven
+directly against the real XLA:TPU compiler on a v5e:8x8 topology by
+test_pod_configs_v5e64_tpu_aot_memory below (round 5)."""
 
 import dataclasses
 import json
@@ -124,29 +125,10 @@ def _aot_fsdp_memory_check(cfg, shape, min_state_gb):
 
     # Donated state aliases in-place (no second copy of the state).
     assert ma.alias_size_in_bytes > 0.95 * per_dev_args
-
-    # Pod extrapolation. Param-shaped buffers (state shards, fp32 grads,
-    # optimizer-update temps ≈ 2 param-sized fp32 copies) scale ∝ 1/N;
-    # activation temps are per-device-batch-shaped (still 1 row/device on
-    # the pod) and must NOT be scaled. Split the measured temp into the
-    # analytic param-shaped part and the (conservatively unscaled) rest.
-    param_temp_at8 = 2 * param_bytes / 8
-    # Guard the split: if XLA materialized fewer param-shaped temps than
-    # assumed, the subtraction would silently swallow real activation
-    # bytes and under-predict the pod footprint.
-    assert ma.temp_size_in_bytes > param_temp_at8, (
-        f"temp {ma.temp_size_in_bytes / GB:.2f} GB below the assumed "
-        f"param-shaped floor {param_temp_at8 / GB:.2f} GB — revisit the "
-        f"grads+updates model in this extrapolation"
-    )
-    act_temp = ma.temp_size_in_bytes - param_temp_at8
-    per_dev_64 = total_state / 64 + 2 * param_bytes / 64 + act_temp
-    assert per_dev_64 < 16 * GB, (
-        f"extrapolated v5e-64 per-chip footprint {per_dev_64 / GB:.2f} GB "
-        f"(state {total_state / 64 / GB:.2f} + grads/updates "
-        f"{2 * param_bytes / 64 / GB:.2f} + activations "
-        f"{act_temp / GB:.2f}) exceeds 16 GB HBM"
-    )
+    # (The former CPU-temp pod extrapolation lived here; the v5e-64 fit
+    # is now proven directly on the real TPU compiler —
+    # test_34b_longvideo_v5e64_tpu_aot_memory — and CPU temp totals are
+    # not comparable across backends, so they are no longer asserted.)
 
 
 @pytest.mark.slow
@@ -178,16 +160,26 @@ def test_oryx_1_5_32b_fsdp_aot_memory():
 
 
 @pytest.mark.slow
-def test_34b_longvideo_v5e64_tpu_aot_memory():
-    """BASELINE config 5 on the REAL compiler: 34B long-video SFT
-    (256-frame rows) compiled for a v5e:8x8 (64-chip) target via the
+@pytest.mark.parametrize(
+    "config,frames",
+    [
+        ("oryx_34b_longvideo.json", 256),  # BASELINE config 5
+        ("oryx_34b_sft.json", 0),
+        ("oryx_1_5_32b_sft.json", 0),
+    ],
+    ids=["34b_longvideo256", "34b_sft", "32b_sft"],
+)
+def test_pod_configs_v5e64_tpu_aot_memory(config, frames):
+    """Every SHIPPED pod-scale config on the REAL compiler: the full
+    sharded train step compiled for a v5e:8x8 (64-chip) target via the
     topology API — no extrapolation, the actual buffer assignment.
 
-    Pins the round-5 recipe that makes pod-scale 34B fit 16 GB/chip
+    Pins the round-5 recipe that makes pod-scale 32B/34B fit 16 GB/chip
     (TPU_VALIDATION round 5): ZeRO-3 over the COMBINED fsdp x sp width
     + vision patch shards riding sp + grad_accum 8 (512 tokens/chip/
-    microbatch) + bf16 moments + block remat (measured 14.71 GB; the
-    shipped-before-round-5 pure-FSDP accum-2 point OOMs at 24.91 GB).
+    microbatch) + bf16 moments + block remat (34B long-video measured
+    14.71 GB, 32B 13.67; the pre-round-5 pure-FSDP accum-2 configs OOM
+    at 21.5-24.9 GB).
     """
     import importlib.util
     import subprocess
@@ -201,8 +193,8 @@ def test_34b_longvideo_v5e64_tpu_aot_memory():
     )
     env = dict(os.environ)
     env.update(
-        AOT_CONFIG="scripts/configs/oryx_34b_longvideo.json",
-        AOT_FRAMES="256",
+        AOT_CONFIG=f"scripts/configs/{config}",
+        AOT_FRAMES=str(frames),
     )
     proc = subprocess.run(
         [sys.executable, script, "block:bfloat16:8"],
@@ -216,7 +208,7 @@ def test_34b_longvideo_v5e64_tpu_aot_memory():
     assert rec["target"] == "tpu_v5e_8x8_topology"
     assert rec["mesh"] == "dp1_fsdp16_tp1_sp4"
     assert rec["attn_impl"] == "ring_flash"
-    # ZeRO-3 over all 64 chips: ~325 GB bf16-moment state / 64.
+    # ZeRO-3 over all 64 chips: ~310-325 GB bf16-moment state / 64.
     assert rec["sharded_ok"], rec
-    assert 4.5 < rec["args_gb"] < 5.8, rec
+    assert 4.3 < rec["args_gb"] < 6.2, rec
     assert rec["fits_16gb"] and rec["total_gb"] < 16.0, rec

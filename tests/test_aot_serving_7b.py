@@ -1,0 +1,58 @@
+"""Real-7B int8 serving fits ONE v5e — real-compiler AOT proof.
+
+MIGRATING.md's "--quantize int8: 7B-class models fit ONE 16 GB v5e"
+claim, compiled against the actual XLA:TPU compiler (chipless v5e
+topology) at the true Oryx-7B geometry via
+scripts/estimate_serving_memory.py: the 64-frame visual encode and the
+jitted prefill+decode generate program, both over the int8 param tree
+(int8 kernels + embedding, bf16 elsewhere). Numbers recorded in
+TPU_VALIDATION.md round 5.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "estimate_serving_memory.py")
+
+
+@pytest.mark.slow
+def test_7b_int8_serving_fits_one_v5e():
+    import importlib.util
+
+    if importlib.util.find_spec("libtpu") is None:
+        pytest.skip("libtpu not installed (TPU topology AOT unavailable)")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        capture_output=True, text=True, timeout=3000,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    recs = {
+        r["program"]: r
+        for r in (json.loads(l) for l in proc.stdout.splitlines()
+                  if l.startswith("{"))
+        if "program" in r
+    }
+    vis = recs["visual_encode_64f"]
+    gen = recs["generate_prefill_decode"]
+    summary = next(
+        json.loads(l) for l in proc.stdout.splitlines()
+        if l.startswith("{") and "serving_peak_gb" in l
+    )
+    # int8 kernels + embedding: ~7.5 GB for the whole 8B-param tree.
+    assert 7.0 < gen["weight_gb"] < 8.5, gen
+    # Decode holds the llm weights + 2048-slot KV cache + activations;
+    # measured 7.62 GB at pinning time.
+    assert gen["fits_16gb"] and gen["total_gb"] < 12.0, gen
+    assert vis["fits_16gb"], vis
+    # The honest serving bound: the whole int8 tree stays resident
+    # across BOTH programs (per-program args only count the subtree each
+    # reads — XLA DCEs the rest), so peak = weights + the larger
+    # program's non-weight working set. Measured 8.03 GB — half the
+    # chip free.
+    assert summary["all_fit"], summary
+    assert summary["serving_peak_gb"] < 12.0, summary
