@@ -118,5 +118,5 @@ def test_two_process_ring_attention_sp8():
     and requires the identical finite loss on both processes."""
     results = _run_workers(RING_WORKER, [])
     ok_lines = [r["_report_lines"][0] for r in results]
-    assert "sp=8 attn=ring" in ok_lines[0], ok_lines
+    assert " sp=8 " in ok_lines[0] and "attn=ring" in ok_lines[0], ok_lines
     assert ok_lines[0] == ok_lines[1], ok_lines
