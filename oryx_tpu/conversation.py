@@ -15,6 +15,7 @@ class SeparatorStyle(Enum):
     PLAIN = auto()      # bare concatenation (stage-1 projector pretraining)
     TWO = auto()        # vicuna-style two separators
     CHATML = auto()     # Qwen/ChatML: <|im_start|>role\n...<|im_end|>\n
+    LLAMA_2 = auto()    # [INST] <<SYS>>...<</SYS>> ... [/INST] reply </s>
 
 
 @dataclasses.dataclass
@@ -60,6 +61,22 @@ class Conversation:
                 if msg is not None:
                     out += msg + (self.sep or "")
             return out
+        if self.sep_style == SeparatorStyle.LLAMA_2:
+            # [INST] turn pairs; the system prompt rides inside the first
+            # user turn's <<SYS>> block (llama-2-chat convention).
+            sys_block = (
+                f"<<SYS>>\n{self.system}\n<</SYS>>\n\n" if self.system else ""
+            )
+            out = ""
+            for i, (role, msg) in enumerate(self.messages):
+                if role == self.roles[0]:
+                    body = (sys_block + (msg or "")) if i == 0 else (msg or "")
+                    out += f"{self.sep}[INST] {body} [/INST]"
+                elif msg is None:
+                    out += ""  # generation prompt: reply follows [/INST]
+                else:
+                    out += f" {msg} {self.sep2}"
+            return out
         raise ValueError(f"unknown sep style {self.sep_style}")
 
     def append_message(self, role: str, message: str | None) -> None:
@@ -80,6 +97,8 @@ class Conversation:
     def stop_str(self) -> str:
         if self.sep_style == SeparatorStyle.CHATML:
             return "<|im_end|>"
+        if self.sep_style == SeparatorStyle.LLAMA_2:
+            return self.sep2 or "</s>"
         return self.sep2 or self.sep
 
 
@@ -115,11 +134,90 @@ conv_vicuna = Conversation(
     version="v1",
 )
 
+conv_llama_2 = Conversation(
+    system=(
+        "You are a helpful language and vision assistant. You are able to "
+        "understand the visual content that the user provides, and assist "
+        "the user with a variety of tasks using natural language."
+    ),
+    roles=("USER", "ASSISTANT"),
+    messages=[],
+    sep_style=SeparatorStyle.LLAMA_2,
+    sep="<s>",
+    sep2="</s>",
+    version="llama_2",
+)
+
+conv_mistral = Conversation(
+    # Mistral-Instruct: same [INST] wire format, no system block and
+    # sep="" (the single leading BOS is the tokenizer's job, never a
+    # mid-sequence literal — the reference registry's
+    # conv_mistral_instruct row).
+    system="",
+    roles=("USER", "ASSISTANT"),
+    messages=[],
+    sep_style=SeparatorStyle.LLAMA_2,
+    sep="",
+    sep2="</s>",
+    version="mistral_instruct",
+)
+
+conv_llava_v1 = Conversation(
+    # llava_v1's system differs from vicuna_v1 by two words
+    # (human/human's vs user/user's) — checkpoints notice.
+    system=(
+        "A chat between a curious human and an artificial intelligence "
+        "assistant. The assistant gives helpful, detailed, and polite "
+        "answers to the human's questions."
+    ),
+    roles=("USER", "ASSISTANT"),
+    messages=[],
+    sep_style=SeparatorStyle.TWO,
+    sep=" ",
+    sep2="</s>",
+    version="llava_v1",
+)
+
+conv_chatml_direct = Conversation(
+    # ChatML with the short llava-v1.6-34b-style system. RECONSTRUCTED
+    # (reference mount empty): the family's chatml_direct row carries
+    # "Answer the questions." — revisit when the reference is readable.
+    system="Answer the questions.",
+    roles=("user", "assistant"),
+    messages=[],
+    sep_style=SeparatorStyle.CHATML,
+    sep="<|im_end|>\n",
+    version="chatml_direct",
+)
+
+conv_mpt = Conversation(
+    # RECONSTRUCTED mpt-style system (reference mount empty).
+    system=(
+        "A conversation between a user and an LLM-based AI assistant. "
+        "The assistant gives helpful and honest answers."
+    ),
+    roles=("user", "assistant"),
+    messages=[],
+    sep_style=SeparatorStyle.CHATML,
+    sep="<|im_end|>\n",
+    version="mpt",
+)
+
 conv_templates: dict[str, Conversation] = {
     "qwen": conv_qwen,
     "qwen_1_5": conv_qwen,
     "plain": conv_plain,
     "v1": conv_vicuna,
+    # Reference-family (LLaVA-derived conversation registry) styles so
+    # records/templates from sibling checkpoints load without surgery.
+    # System strings are reconstructions where marked (empty mount) —
+    # pinned by tests/test_goldens.py so any revision is a visible diff.
+    "llava_v1": conv_llava_v1,
+    "vicuna_v1": conv_vicuna,
+    "llava_llama_2": conv_llama_2,
+    "mistral_instruct": conv_mistral,
+    "chatml_direct": conv_chatml_direct,
+    "mpt": conv_mpt,
     # 34B (Yi backbone) template DECISION (reference mount empty, so the
     # real oryx_34b template is unverifiable): Yi-34B-Chat speaks ChatML
     # with the same <|im_start|>/<|im_end|> markers as Qwen, so oryx_34b

@@ -103,6 +103,19 @@ def _conversation_parts(
         for i, (role, sup, value) in enumerate(msgs):
             parts.append((f"{role}: ", False))
             parts.append((f"{value}{seps[i % 2]}", sup))
+    elif conv.sep_style == SeparatorStyle.LLAMA_2:
+        # [INST]-wrapped user turns (system inside the first one); the
+        # assistant reply + closing </s> is supervised — matching
+        # Conversation.get_prompt's LLAMA_2 formatting.
+        sys_block = (
+            f"<<SYS>>\n{conv.system}\n<</SYS>>\n\n" if conv.system else ""
+        )
+        for i, (role, sup, value) in enumerate(msgs):
+            if not sup:
+                body = (sys_block + value) if i == 0 else value
+                parts.append((f"{conv.sep}[INST] {body} [/INST]", False))
+            else:
+                parts.append((f" {value} {conv.sep2}", True))
     elif conv.sep_style == SeparatorStyle.PLAIN:
         # Stage-1 projector pretraining: bare concatenation; only the
         # assistant (caption) text is supervised.

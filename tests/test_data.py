@@ -336,3 +336,24 @@ def test_collate_frame_separator_ids():
     assert len(sep_slots) == 3
     for s in sep_slots:
         assert lab[s - 1] == IGNORE_INDEX
+
+
+def test_preprocess_llama2_style():
+    """LLAMA_2/[INST] family (llava_llama_2, mistral_instruct): training
+    masking and the inference prompt agree byte-for-byte, and only the
+    assistant reply + closing </s> is supervised."""
+    for name in ("llava_llama_2", "mistral_instruct"):
+        conv = conv_templates[name].copy()
+        ids, labels = data_lib.preprocess_conversation(
+            REC, FakeTokenizer(), conv
+        )
+        ref = conv.copy()
+        ref.append_message(conv.roles[0], "<image>\nQ?")
+        ref.append_message(conv.roles[1], "A!")
+        assert _decode(ids) == ref.get_prompt().replace("<image>", ""), name
+        sup = [i for i, l in zip(ids, labels) if l != IGNORE_INDEX]
+        assert _decode(sup) == " A! " + conv.sep2, name
+        # The system prompt (when present) is inside the first [INST]
+        # block and never supervised.
+        if conv.system:
+            assert "<<SYS>>" in _decode(ids), name

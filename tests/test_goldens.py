@@ -95,6 +95,58 @@ RECORDS = {
             ]
         },
     },
+    # Reference-family [INST] style (llava_llama_2 registry row).
+    "llava_llama_2/multi_turn": {
+        "template": "llava_llama_2",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>\nWhat is shown?"},
+                {"from": "gpt", "value": "A harbor at dusk."},
+                {"from": "human", "value": "Any boats?"},
+                {"from": "gpt", "value": "Two sailboats."},
+            ]
+        },
+    },
+    # Remaining reference-family registry rows — one golden each so any
+    # system-string or separator revision is a reviewable byte diff.
+    "mistral_instruct/multi_turn": {
+        "template": "mistral_instruct",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>\nWhat is shown?"},
+                {"from": "gpt", "value": "A harbor at dusk."},
+                {"from": "human", "value": "Any boats?"},
+                {"from": "gpt", "value": "Two sailboats."},
+            ]
+        },
+    },
+    "llava_v1/single_turn": {
+        "template": "llava_v1",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>\nDescribe this."},
+                {"from": "gpt", "value": "A quiet street."},
+            ]
+        },
+    },
+    "chatml_direct/single_turn": {
+        "template": "chatml_direct",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>\nDescribe this."},
+                {"from": "gpt", "value": "A quiet street."},
+            ]
+        },
+    },
+    "mpt/single_turn": {
+        "template": "mpt",
+        "rec": {
+            "conversations": [
+                {"from": "human", "value": "<image>\nDescribe this."},
+                {"from": "gpt", "value": "A quiet street."},
+            ]
+        },
+    },
     # Stage-1 projector pretraining (plain template): caption only.
     "plain/caption": {
         "template": "plain",
