@@ -99,8 +99,8 @@ step sweep_loss_chunk 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
   python scripts/bench_sweep.py loss_chunk
 step sweep_fwd_blocks 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \
   python scripts/bench_sweep.py fwd_blocks
-# 6 remat configs x 600 s per-config cap: 3600 s would let the outer
-# kill preempt the last config; 4500 leaves margin.
+# 5 remat configs x 600 s per-config cap; 4500 leaves margin so the
+# outer kill can't preempt the last config.
 step sweep_remat 4500 env SWEEP_STATE_DIR="$OUT/sweep_state" \
   python scripts/bench_sweep.py remat
 step sweep_batch 3600 env SWEEP_STATE_DIR="$OUT/sweep_state" \

@@ -106,7 +106,7 @@ def test_best_env_filters_orphans_and_ooms(state_dir):
     # is never exercised.
     banked = [
         ({"BENCH_REMAT_POLICY": "attn"}, {"value": 90.0}),
-        ({"BENCH_REMAT_POLICY": "attn_o"}, {"value": 120.0}),
+        ({"BENCH_REMAT_POLICY": "attn_qkv"}, {"value": 120.0}),
         ({"BENCH_REMAT_POLICY": "attn_o", "BENCH_MOMENT_DTYPE": "bfloat16"},
          {"error": "oom"}),
     ]
@@ -124,7 +124,7 @@ def test_best_env_filters_orphans_and_ooms(state_dir):
         open(os.path.join(str(state_dir), "remat_deadbeef0000.json"), "w"),
     )
     env = bb.best_env(str(state_dir))
-    assert env.get("BENCH_REMAT_POLICY") == "attn_o"
+    assert env.get("BENCH_REMAT_POLICY") == "attn_qkv"
     assert env.get("BENCH_LOSS_CHUNK") == "256"
 
 
