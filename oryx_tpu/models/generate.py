@@ -12,6 +12,7 @@ multimodal prefills need no left-padding shuffle.
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -20,6 +21,7 @@ import numpy as np
 
 from oryx_tpu.config import GenerationConfig, LLMConfig
 from oryx_tpu.models import qwen2
+from oryx_tpu.ops import paged_kv as paged_kv_lib
 
 
 def sample_token(
@@ -35,7 +37,12 @@ def sample_token(
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        # Clamp to the vocab dimension: top_k >= V keeps everything (the
+        # kth value is the row minimum); unclamped it would index out of
+        # range on the sorted axis.
+        kth = jnp.sort(logits, axis=-1)[
+            :, -min(top_k, logits.shape[-1])
+        ][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -47,6 +54,47 @@ def sample_token(
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_token_rows(
+    logits: jnp.ndarray,  # [S, V]
+    keys: jax.Array,  # [S] per-row PRNG keys
+    *,
+    temperature: jnp.ndarray,  # [S] float (0 => greedy for that row)
+    top_p: jnp.ndarray,  # [S] float
+    top_k: jnp.ndarray,  # [S] int
+) -> jnp.ndarray:
+    """Per-ROW sampling for continuous batching (`sample_token` treats
+    its knobs as batch-wide statics; one compiled program per distinct
+    value). Every slot carries its own (temperature, top_p, top_k) as
+    traced arrays and its own key, so a row's draw is a function of that
+    row alone — admitting or finishing a neighbor never perturbs an
+    in-flight request's sample stream, and mixed sampling configs share
+    ONE compiled decode."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    is_greedy = temperature <= 0.0
+    t = jnp.where(is_greedy, 1.0, temperature)[:, None]
+    l = logits / t
+    tk = jnp.clip(top_k.astype(jnp.int32), 0, V)
+    srt = jnp.sort(l, axis=-1)  # ascending
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(V - tk, 0, V - 1)[:, None], axis=-1
+    )
+    l = jnp.where((tk > 0)[:, None] & (l < kth), -jnp.inf, l)
+    srt_d = jnp.sort(l, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt_d, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Smallest prefix with cumulative prob >= top_p (keeps the top token).
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(srt_d, cutoff_idx[:, None], axis=-1)
+    l = jnp.where((top_p < 1.0)[:, None] & (l < cutoff), -jnp.inf, l)
+    # Per-row Gumbel-max with per-row keys (categorical over one shared
+    # key would couple a row's draw to its batch position).
+    u = jax.vmap(lambda k: jax.random.uniform(k, (V,)))(keys)
+    g = -jnp.log(-jnp.log(jnp.maximum(u, jnp.finfo(jnp.float32).tiny)))
+    sampled = jnp.argmax(l + g, axis=-1).astype(jnp.int32)
+    return jnp.where(is_greedy, greedy, sampled)
 
 
 def make_stop_sequences(
@@ -375,3 +423,320 @@ def generate_stream(
         done += n
         if fin[:, -1].all():
             break
+
+
+# ---------------------------------------------------------------------------
+# Paged chunked decode (continuous-batching serving path)
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "attn_impl", "compute_dtype"),
+    donate_argnames=("kv_pages",),
+)
+def paged_prefill(
+    params,
+    cfg: LLMConfig,
+    inputs_embeds: jnp.ndarray,  # [B, T, H] right-padded
+    lengths: jnp.ndarray,  # [B] real TOTAL lengths (incl. cached prefix)
+    block_tables: jnp.ndarray,  # [B, max_pages] int32
+    kv_pages: dict,  # qwen2.init_paged_kv_cache pytree (donated)
+    start: jnp.ndarray,  # [B] int32 first logical slot to write
+    keys: jax.Array,  # [B] per-row PRNG keys
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    *,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+):
+    """Prompt prefill into a PAGED cache + first sampled token.
+
+    The paged twin of `_prefill_carry`: K/V land in the rows' pages
+    (through their block tables) instead of a dense per-batch buffer.
+    With `start` > 0 only the suffix is prefilled at absolute positions
+    (prefix KV reuse). Sampling is per-row (`sample_token_rows`) so one
+    compiled prefill serves every sampling config at a given prompt
+    bucket. Returns (kv_pages, tok0 [B], advanced keys [B])."""
+    B, T, _ = inputs_embeds.shape
+    start = jnp.broadcast_to(start.astype(jnp.int32), (B,))
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    page_size = kv_pages["k"].shape[2]
+    K = block_tables.shape[1] * page_size
+    kv_mask = (
+        jnp.arange(K, dtype=jnp.int32)[None, :] < lengths[:, None]
+    ).astype(jnp.int32)
+    logits, kv_pages = qwen2.forward(
+        params, cfg,
+        inputs_embeds=inputs_embeds, positions=positions,
+        kv_cache=kv_pages, write_slots=start, kv_mask=kv_mask,
+        block_tables=block_tables, kv_lengths=lengths,
+        attn_impl=attn_impl, compute_dtype=compute_dtype,
+    )
+    last = jnp.take_along_axis(
+        logits, (lengths - 1 - start)[:, None, None].astype(jnp.int32),
+        axis=1,
+    )[:, 0]
+    pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    tok0 = sample_token_rows(
+        last, pair[:, 1], temperature=temperature, top_p=top_p, top_k=top_k
+    )
+    return kv_pages, tok0, pair[:, 0]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk", "eos", "attn_impl", "compute_dtype"),
+    donate_argnames=("kv_pages",),
+)
+def paged_decode_chunk(
+    params,
+    cfg: LLMConfig,
+    kv_pages: dict,  # donated
+    block_tables: jnp.ndarray,  # [S, max_pages] int32
+    tok: jnp.ndarray,  # [S] next token to feed per slot
+    lengths: jnp.ndarray,  # [S] kv tokens held per slot (frozen on finish)
+    finished: jnp.ndarray,  # [S] bool (True for finished AND empty slots)
+    recent: jnp.ndarray,  # [S, stop_L] rolling stop window (-2 init)
+    keys: jax.Array,  # [S] per-slot PRNG keys
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    stop_sequences: jnp.ndarray | None = None,  # [Sq, L] (shared, static)
+    *,
+    chunk: int,
+    eos: int,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+):
+    """`chunk` decode steps over a FIXED-SLOT batch with a paged cache —
+    the continuous-batching inner loop. One compiled program per
+    (num_slots, max_pages, chunk) regardless of which slots are live:
+    finished/empty slots still flow through the math but their cache
+    writes are dropped (write_mask) and their lengths freeze, so the
+    scheduler can retire and admit requests BETWEEN chunks by editing
+    the small host-side state arrays — never recompiling, never touching
+    other rows' streams (per-row keys + per-row sampling).
+
+    Step semantics mirror `_make_decode_step` exactly (greedy token ids
+    are bit-identical to the dense path at equal logical KV width).
+    Returns (kv_pages, tok, lengths, finished, recent, keys,
+    toks [S, chunk], fin [S, chunk])."""
+    page_size = kv_pages["k"].shape[2]
+    K = block_tables.shape[1] * page_size
+    slot_ar = jnp.arange(K, dtype=jnp.int32)[None, :]
+
+    def stop_hit(recent):
+        if stop_sequences is None:
+            return jnp.zeros((recent.shape[0],), bool)
+        m = (stop_sequences[None] == -1) | (
+            recent[:, None, :] == stop_sequences[None]
+        )
+        return jnp.any(jnp.all(m, axis=-1), axis=-1)
+
+    def step(carry, _):
+        kv_pages, tok, cur_len, finished, recent, keys = carry
+        pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        pos = cur_len[:, None]
+        kv_mask = (slot_ar <= cur_len[:, None]).astype(jnp.int32)
+        logits, kv_pages = qwen2.forward(
+            params, cfg,
+            input_ids=tok[:, None], positions=pos,
+            kv_cache=kv_pages, write_slots=cur_len, kv_mask=kv_mask,
+            block_tables=block_tables, write_mask=~finished,
+            kv_lengths=cur_len + 1,
+            attn_impl=attn_impl, compute_dtype=compute_dtype,
+        )
+        nxt = sample_token_rows(
+            logits[:, 0], pair[:, 1],
+            temperature=temperature, top_p=top_p, top_k=top_k,
+        )
+        if recent.shape[1]:
+            recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
+        finished = finished | (tok == eos) | stop_hit(recent)
+        nxt = jnp.where(finished, eos, nxt)
+        cur_len = cur_len + (~finished).astype(jnp.int32)
+        return (kv_pages, nxt, cur_len, finished, recent, pair[:, 0]), (
+            tok, finished
+        )
+
+    carry, (toks, fin) = jax.lax.scan(
+        step, (kv_pages, tok, lengths, finished, recent, keys), None,
+        length=chunk,
+    )
+    return carry + (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1))
+
+
+@dataclasses.dataclass
+class PagedState:
+    """Host half of a paged decode: the device page pool plus the
+    block tables and free-list that address it. Returned by
+    `generate_paged(return_state=True)` for cross-turn prefix reuse;
+    owned by serve/scheduler.py for continuous batching."""
+
+    kv_pages: dict
+    block_tables: np.ndarray  # [B, max_pages] int32 (sentinel-padded)
+    allocator: "paged_kv_lib.PageAllocator"
+
+    @property
+    def page_size(self) -> int:
+        return self.allocator.page_size
+
+
+def _grow_block_tables(
+    state: PagedState, row_tokens: list[int], max_pages: int
+) -> np.ndarray:
+    """Ensure each row's block table covers row_tokens[b] logical slots,
+    allocating from the state's free list; widens the table to
+    `max_pages` columns (sentinel-padded). Raises OutOfPagesError with
+    nothing allocated if the pool cannot satisfy the TOTAL ask."""
+    alloc = state.allocator
+    bt = state.block_tables
+    B, old = bt.shape
+    out = np.full((B, max_pages), alloc.sentinel, np.int32)
+    out[:, : min(old, max_pages)] = bt[:, : min(old, max_pages)]
+    if old > max_pages:
+        # Narrowing (a later turn with a smaller window): pages past the
+        # new width would silently vanish from the table — return them
+        # to the free list instead of leaking them.
+        dropped = [
+            int(p) for b in range(B) for p in bt[b, max_pages:]
+            if p != alloc.sentinel
+        ]
+        if dropped:
+            alloc.free(dropped)
+    held = [int((out[b] != alloc.sentinel).sum()) for b in range(B)]
+    need = [
+        max(0, alloc.pages_for(row_tokens[b]) - held[b]) for b in range(B)
+    ]
+    if sum(need) > alloc.num_free:
+        raise paged_kv_lib.OutOfPagesError(
+            f"need {sum(need)} pages, {alloc.num_free} free"
+        )
+    for b in range(B):
+        pages = alloc.alloc(need[b])
+        out[b, held[b]: held[b] + need[b]] = pages
+    state.block_tables = out
+    return out
+
+
+def generate_paged(
+    params,
+    cfg: LLMConfig,
+    gen_cfg: GenerationConfig,
+    *,
+    inputs_embeds: jnp.ndarray,  # [B, T, H] (suffix only when `start`)
+    lengths: jnp.ndarray,  # [B] real TOTAL lengths (incl. cached prefix)
+    max_new_tokens: int,
+    page_size: int = 64,
+    chunk: int = 8,
+    kv_capacity: int | None = None,
+    num_pages: int | None = None,
+    key: jax.Array | None = None,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+    stop_sequences: jnp.ndarray | None = None,
+    state: PagedState | None = None,
+    start: jnp.ndarray | None = None,
+    return_state: bool = False,
+):
+    """`generate`, but over a paged KV cache in `chunk`-step compiled
+    dispatches — the reference driver for the continuous-batching path
+    (the scheduler runs the same `paged_prefill`/`paged_decode_chunk`
+    programs with slots owned by different requests).
+
+    Greedy token ids are bit-identical to `generate` when `kv_capacity`
+    matches the dense call's `cache_len` (identical fp32 reductions;
+    masked kv columns contribute exact zeros either way). Sampled
+    streams draw from per-row keys and so differ from the dense batch
+    sampler by construction.
+
+    kv_capacity: logical KV width per row (max_pages = kv_capacity /
+    page_size); defaults to the bucket of max(lengths) + the chunk-
+    padded decode window. num_pages: pool size; defaults to the exact
+    ragged need — sum over rows of ceil((length + window) / page_size),
+    which is the whole point: a short row costs its own pages, not the
+    batch max. state/start: prefix KV reuse as in `generate`
+    (kv_cache/start); pass the state from the previous turn and prefill
+    only the suffix embeds."""
+    B, T, _ = inputs_embeds.shape
+    if key is None:
+        key = jax.random.key(0)
+    padded_new = -(-max_new_tokens // chunk) * chunk
+    lengths = jnp.asarray(lengths, jnp.int32)
+    host_len = [int(x) for x in np.asarray(lengths)]
+    row_tokens = [n + padded_new for n in host_len]
+    if kv_capacity is None:
+        from oryx_tpu.ops.packing import round_up_bucket
+
+        kv_capacity = round_up_bucket(max(row_tokens))
+    if kv_capacity % page_size:
+        raise ValueError(f"{kv_capacity=} not a multiple of {page_size=}")
+    max_pages = kv_capacity // page_size
+    dtype = compute_dtype or jnp.float32
+
+    if state is None:
+        if num_pages is None:
+            alloc_probe = paged_kv_lib.PageAllocator(1, page_size)
+            num_pages = sum(alloc_probe.pages_for(n) for n in row_tokens)
+        allocator = paged_kv_lib.PageAllocator(num_pages, page_size)
+        state = PagedState(
+            kv_pages=qwen2.init_paged_kv_cache(
+                cfg, num_pages, page_size, dtype=dtype
+            ),
+            block_tables=np.full((B, max_pages), allocator.sentinel,
+                                 np.int32),
+            allocator=allocator,
+        )
+    elif state.block_tables.shape[0] != B:
+        raise ValueError(
+            f"state holds {state.block_tables.shape[0]} rows, batch has {B}"
+        )
+    bt_host = _grow_block_tables(state, row_tokens, max_pages)
+    bt = jnp.asarray(bt_host)
+
+    start_vec = (
+        jnp.zeros((B,), jnp.int32)
+        if start is None
+        else jnp.broadcast_to(jnp.asarray(start, jnp.int32), (B,))
+    )
+    temp = jnp.full((B,), gen_cfg.temperature, jnp.float32)
+    top_p = jnp.full((B,), gen_cfg.top_p, jnp.float32)
+    top_k = jnp.full((B,), gen_cfg.top_k, jnp.int32)
+    key, sk = jax.random.split(key)
+    row_keys = jax.random.split(sk, B)
+    state.kv_pages, tok, row_keys = paged_prefill(
+        params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
+        start_vec, row_keys, temp, top_p, top_k,
+        attn_impl=attn_impl, compute_dtype=compute_dtype,
+    )
+    stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
+    recent = jnp.full((B, stop_L), -2, jnp.int32)
+    finished = jnp.zeros((B,), bool)
+    cur_len = lengths
+    eos = gen_cfg.eos_token_id
+    toks_out = np.full((B, padded_new), eos, np.int32)
+    fin_out = np.ones((B, padded_new), bool)
+    done = 0
+    while done < max_new_tokens:
+        (state.kv_pages, tok, cur_len, finished, recent, row_keys,
+         toks, fin) = paged_decode_chunk(
+            params, cfg, state.kv_pages, bt, tok, cur_len, finished,
+            recent, row_keys, temp, top_p, top_k, stop_sequences,
+            chunk=chunk, eos=eos, attn_impl=attn_impl,
+            compute_dtype=compute_dtype,
+        )
+        toks_out[:, done:done + chunk] = np.asarray(toks)
+        fin_out[:, done:done + chunk] = np.asarray(fin)
+        done += chunk
+        if fin_out[:, done - 1].all():
+            break
+    toks_out = toks_out[:, :max_new_tokens]
+    fin_out = fin_out[:, :max_new_tokens]
+    any_fin = fin_out.any(axis=1)
+    num = np.where(
+        any_fin, fin_out.argmax(axis=1) + 1, max_new_tokens
+    ).astype(np.int32)
+    out = (jnp.asarray(toks_out), jnp.asarray(num), jnp.asarray(any_fin))
+    return out + (state,) if return_state else out

@@ -93,6 +93,20 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def init_paged_kv_cache(
+    cfg: LLMConfig, num_pages: int, page_size: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Params:
+    """Page-pool KV cache (ops/paged_kv.py): one pool of fixed-size
+    pages shared by every sequence; rows address it through per-row
+    block tables passed to `forward`. HBM cost is the POOL size, not
+    batch × max_len."""
+    shape = (
+        cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
 def _cache_write(cache_layer: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray):
     """Write new [B, T, Hk, D] into cache [B, S, Hk, D] at per-row start slots.
 
@@ -193,6 +207,10 @@ def _block(
     write_slots: jnp.ndarray | None,
     kv_mask: jnp.ndarray | None,
     attn_fn,
+    block_tables: jnp.ndarray | None = None,
+    write_mask: jnp.ndarray | None = None,
+    kv_lengths: jnp.ndarray | None = None,
+    attn_impl: str = "xla",
 ):
     """One decoder block. h: [B, T, H]. Returns (h, new_k, new_v)."""
     B, T, _ = h.shape
@@ -207,7 +225,38 @@ def _block(
     k = checkpoint_name(k, "attn_k")
     v = checkpoint_name(v, "attn_v")
 
-    if cache_k is not None:
+    if cache_k is not None and block_tables is not None:
+        # Paged cache: this layer's K/V pool is [P, page, Hk, D] and the
+        # row's logical stream is addressed through its block table.
+        from oryx_tpu.ops import paged_kv
+
+        cache_k = paged_kv.write_pages(
+            cache_k, k, block_tables, write_slots, write_mask=write_mask
+        )
+        cache_v = paged_kv.write_pages(
+            cache_v, v, block_tables, write_slots, write_mask=write_mask
+        )
+        if attn_impl == "pallas" and T == 1 and kv_lengths is not None:
+            # In-place ragged decode: pages are read through the block
+            # table, no contiguous gather.
+            from oryx_tpu.ops.pallas import paged_attention as _ppa
+
+            attn_out = _ppa.ragged_decode_attention(
+                q, cache_k, cache_v, block_tables, kv_lengths
+            )
+        else:
+            # Reference path (and any T > 1 paged prefill): materialize
+            # the logical stream, then the stock cached-attention call —
+            # bit-identical math to the dense cache at equal KV width.
+            kc = paged_kv.gather_pages(cache_k, block_tables)
+            vc = paged_kv.gather_pages(cache_v, block_tables)
+            attn_out = attn_fn(
+                q, kc, vc,
+                q_positions=positions,
+                kv_positions=None,
+                kv_mask=kv_mask,
+            )
+    elif cache_k is not None:
         cache_k = _cache_write(cache_k, k, write_slots)
         cache_v = _cache_write(cache_v, v, write_slots)
         attn_out = attn_fn(
@@ -249,6 +298,9 @@ def forward(
     kv_cache: Params | None = None,
     write_slots: jnp.ndarray | None = None,
     kv_mask: jnp.ndarray | None = None,
+    block_tables: jnp.ndarray | None = None,
+    write_mask: jnp.ndarray | None = None,
+    kv_lengths: jnp.ndarray | None = None,
     remat: bool | str = False,
     attn_impl: str = "xla",
     mesh=None,
@@ -275,6 +327,13 @@ def forward(
         attention runs over the whole cache with `kv_mask` [B, S] validity.
       kv_mask: with no cache, [B, T] padding mask; with cache, [B, S] slot
         validity — caller maintains it (see models/generate.py).
+      block_tables: paged-cache mode — kv_cache is from `init_paged_kv_cache`
+        ([L, P, page, Hk, D]) and each row's logical slots map through
+        block_tables [B, max_pages] (ops/paged_kv.py). kv_mask then spans
+        the LOGICAL stream [B, max_pages*page]. write_mask [B] gates rows'
+        cache writes (finished/empty serving slots). kv_lengths [B] (valid
+        kv count incl. the current token) enables the in-place Pallas
+        ragged decode kernel for single-token steps under attn_impl=pallas.
       segment_ids: [B, T] int32 SAMPLE ids for sequence-packed training
         (0 = pad): attention is causal in SLOT order and masked on
         segment equality, so samples packed into one row never attend
@@ -384,6 +443,10 @@ def forward(
             write_slots=write_slots,
             kv_mask=kv_mask,
             attn_fn=attn_fn,
+            block_tables=block_tables,
+            write_mask=write_mask,
+            kv_lengths=kv_lengths,
+            attn_impl=attn_impl,
         )
         h = constrain(h, *hs_spec)
         return h, (ck, cv) if kv_cache is not None else None

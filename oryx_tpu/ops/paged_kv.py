@@ -1,0 +1,176 @@
+"""Paged KV cache: fixed-size pages, block tables, ragged decode attention.
+
+The serving-side answer to "every (batch, seq) bucket owns a dense
+[B, S, Hk, D] cache": K/V live in a single pool of fixed-size pages
+([num_pages, page_size, Hk, D] per layer) and each sequence owns an
+ordered list of page indices (its *block table*). Logical slot `s` of a
+sequence lives at page `block_table[s // page_size]`, offset
+`s % page_size`. Sequences of wildly different lengths then share one
+pool — the HBM cost of a batch is the sum of its real lengths (rounded
+up to pages), not num_slots × max_len — and a finished sequence's pages
+return to the free list for the next admission (continuous batching,
+arXiv 2604.15464 / 2605.25645).
+
+Three pieces live here:
+  * `PageAllocator` — the host-side free list. Pure Python; the device
+    never sees it. Page 0..num_pages-1 are real; `allocator.sentinel`
+    (== num_pages) marks unallocated block-table entries. Writes routed
+    to the sentinel fall off the end of the pool and are DROPPED by
+    XLA's out-of-bounds scatter rule; gathers CLIP to the last page and
+    the garbage is masked out of attention. Both behaviors are load-
+    bearing: masked rows need no branch on device.
+  * `write_pages` / `gather_pages` — the device-side page I/O, plain
+    scatter/gather in slot order. Shapes are static; the block table is
+    a traced [B, max_pages] int32 operand, so growing a sequence never
+    recompiles.
+  * `ragged_decode_attention` — the pure-JAX reference decode path:
+    gather each row's pages into a contiguous [B, K, Hk, D] view and
+    run the stock fp32-softmax attention. Bit-identical to the dense
+    cache path when the padded KV width matches (masked columns are
+    exactly 0 probability either way). The Pallas twin
+    (`ops/pallas/paged_attention.py`) reads pages in place through the
+    block table instead of gathering.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from oryx_tpu.ops.attention import attention
+
+
+class OutOfPagesError(RuntimeError):
+    """The free list cannot satisfy an allocation (caller should evict
+    or defer admission — this is a scheduling signal, not a crash)."""
+
+
+class PageAllocator:
+    """Host-side free-list allocator over `num_pages` fixed-size pages.
+
+    LIFO recycling: freshly freed pages are handed out first, which
+    keeps the hot working set of pages small and stable (good for any
+    cache layer under the pool). Allocation is all-or-nothing so a
+    failed admission never leaks a partial block table.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 1 or page_size < 1:
+            raise ValueError(f"need >= 1 page/slot, got {num_pages=} {page_size=}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def sentinel(self) -> int:
+        """Block-table filler for unallocated entries: one past the pool
+        (writes drop, gathers clip; see module docstring)."""
+        return self.num_pages
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, num_tokens: int) -> int:
+        """Pages needed to hold `num_tokens` KV slots."""
+        return max(0, -(-num_tokens // self.page_size))
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfPagesError(
+                f"need {n} pages, {len(self._free)} free of {self.num_pages}"
+            )
+        if n <= 0:
+            return []
+        out = self._free[-n:][::-1]
+        del self._free[-n:]
+        return out
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not 0 <= p < self.num_pages:
+                raise ValueError(f"page {p} outside pool of {self.num_pages}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(reversed(pages))
+
+
+def write_pages(
+    cache_layer: jnp.ndarray,  # [P, page_size, Hk, D]
+    new: jnp.ndarray,  # [B, T, Hk, D]
+    block_tables: jnp.ndarray,  # [B, max_pages] int32 (sentinel = P)
+    start: jnp.ndarray,  # [B] int32 first logical slot per row
+    *,
+    write_mask: jnp.ndarray | None = None,  # [B] bool rows that may write
+) -> jnp.ndarray:
+    """Write T contiguous tokens per row into the page pool.
+
+    Row b's token t lands at logical slot start[b] + t, i.e. page
+    block_tables[b, slot // page_size] offset slot % page_size. Rows
+    with write_mask False — and any slot routed through the sentinel —
+    scatter out of bounds and are dropped (the masked-decode idiom:
+    finished/empty slots cost no branch).
+    """
+    P, ps, Hk, D = cache_layer.shape
+    B, T, _, _ = new.shape
+    slots = start[:, None].astype(jnp.int32) + jnp.arange(T, dtype=jnp.int32)
+    page = jnp.take_along_axis(block_tables, slots // ps, axis=1)  # [B, T]
+    flat = page * ps + slots % ps  # sentinel page P -> index >= P*ps -> drop
+    if write_mask is not None:
+        flat = jnp.where(write_mask[:, None], flat, P * ps)
+    pool = cache_layer.reshape(P * ps, Hk, D)
+    pool = pool.at[flat.reshape(-1)].set(
+        new.reshape(B * T, Hk, D).astype(pool.dtype), mode="drop"
+    )
+    return pool.reshape(P, ps, Hk, D)
+
+
+def gather_pages(
+    cache_layer: jnp.ndarray,  # [P, page_size, Hk, D]
+    block_tables: jnp.ndarray,  # [B, max_pages]
+) -> jnp.ndarray:
+    """Materialize each row's logical KV stream: [B, max_pages*ps, Hk, D].
+
+    Sentinel entries clip to the last real page; whatever they read is
+    past every row's valid length and masked out of attention. This is
+    the portable reference path — the Pallas kernel replaces it with
+    in-place page reads on TPU.
+    """
+    B, maxp = block_tables.shape
+    P, ps, Hk, D = cache_layer.shape
+    out = cache_layer[block_tables]  # OOB gather clips
+    return out.reshape(B, maxp * ps, Hk, D)
+
+
+def ragged_decode_attention(
+    q: jnp.ndarray,  # [B, 1, Hq, D] (or [B, Hq, D])
+    k_pages: jnp.ndarray,  # [P, page_size, Hk, D]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_pages]
+    kv_lengths: jnp.ndarray,  # [B] valid kv count INCLUDING the current token
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Pure-JAX reference for single-token paged decode attention.
+
+    Each query attends to its own ragged KV prefix, addressed through
+    its block table. Returns [B, 1, Hq, D] (or [B, Hq, D], matching q).
+    """
+    squeezed = q.ndim == 3
+    if squeezed:
+        q = q[:, None]
+    B = q.shape[0]
+    K = block_tables.shape[1] * k_pages.shape[1]
+    k = gather_pages(k_pages, block_tables)
+    v = gather_pages(v_pages, block_tables)
+    kv_mask = (
+        jnp.arange(K, dtype=jnp.int32)[None, :] < kv_lengths[:, None]
+    ).astype(jnp.int32)
+    out = attention(
+        q, k, v,
+        causal=True,
+        q_positions=(kv_lengths - 1)[:, None].astype(jnp.int32),
+        kv_positions=None,  # arange over logical slots == absolute positions
+        kv_mask=kv_mask,
+        scale=scale,
+    )
+    return out[:, 0] if squeezed else out

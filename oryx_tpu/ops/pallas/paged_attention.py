@@ -1,0 +1,193 @@
+"""Pallas TPU paged decode attention (ragged KV through block tables).
+
+The TPU twin of `ops.paged_kv.ragged_decode_attention`: one decode step
+attends over a sequence's pages IN PLACE — the block table is a
+scalar-prefetch operand, so each kv tile's DMA source address is
+computed from it before the tile runs, and no [B, max_len] contiguous
+copy of the cache is ever materialized (the XLA reference gathers one
+per layer per step; at 7B serving shapes that gather IS the decode
+bandwidth bill).
+
+Shares the flash-attention kernel skeleton (ops/pallas/
+flash_attention.py): grid (B, Hk, num_pages_per_seq) with the page
+dimension innermost and sequential, online-softmax (m, l, acc) state in
+VMEM scratch, fp32 logits/softmax, probs·V in the value dtype. The GQA
+group dimension rides INSIDE the tile (q is reshaped [B, Hk, G, D]), so
+every grid step issues one [G, page_size] logit matmul per kv head —
+the decode-shaped analogue of the prefill kernel's [block_q, block_k]
+tiles.
+
+Ragged handling, per row b with `kv_lengths[b] = n`:
+  * tiles wholly past n skip their compute (`pl.when`) AND their DMA —
+    the index map clamps dead page ids to the last live page, and
+    Pallas elides a DMA whose source block repeats the previous step's.
+  * the tail tile masks slots >= n to -inf before the softmax.
+  * sentinel block-table entries (unallocated tails) clip into the pool
+    for address safety; they are only reachable masked.
+
+Interpret mode runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _decode_kernel(
+    bt_ref,  # [B, maxp] SMEM (scalar prefetch)
+    len_ref,  # [B] SMEM (scalar prefetch)
+    q_ref,  # [1, 1, G, D]
+    k_ref,  # [1, ps, 1, D]
+    v_ref,
+    o_ref,  # [1, 1, G, D]
+    m_scr, l_scr, acc_scr,
+    *,
+    scale: float,
+    page_size: int,
+    num_groups: int,
+):
+    b, ik = pl.program_id(0), pl.program_id(2)
+    nk = pl.num_programs(2)
+    G = num_groups
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+    run = ik * page_size < length
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]  # [G, D]
+        k = k_ref[0, :, 0, :]  # [ps, D]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, ps] fp32
+
+        slot = ik * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        s = jnp.where(slot < length, s, NEG)
+
+        m_prev = m_scr[:G, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [G, ps] fp32
+        l_new = l_scr[:G, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:G, :] = jnp.broadcast_to(m_new, (G, m_scr.shape[1]))
+        l_scr[:G, :] = jnp.broadcast_to(l_new, (G, l_scr.shape[1]))
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:G, :] = acc_scr[:G, :] * alpha + pv
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:G, :1]
+        out = acc_scr[:G, :] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "page_size", "interpret")
+)
+def _paged_decode(
+    q,  # [B, Hk, G, D]
+    k_pages,  # [P, ps, Hk, D]
+    v_pages,
+    block_tables,  # [B, maxp] int32
+    kv_lengths,  # [B] int32
+    *,
+    scale: float,
+    page_size: int,
+    interpret: bool,
+):
+    B, Hk, G, D = q.shape
+    P = k_pages.shape[0]
+    maxp = block_tables.shape[1]
+
+    def kv_map(b, hk, ik, bt_ref, len_ref):
+        # Clamp dead tiles onto the last live page (DMA elision — see
+        # module docstring) and sentinel entries into the pool.
+        last = jnp.maximum(len_ref[b] - 1, 0) // page_size
+        page = bt_ref[b, jnp.minimum(ik, last)]
+        return (jnp.minimum(page, P - 1), 0, hk, 0)
+
+    grid = (B, Hk, maxp)
+    Gp = max(G, 8)  # scratch sublane floor
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale, page_size=page_size, num_groups=G
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G, D), lambda b, hk, ik, *_: (b, hk, 0, 0)
+                ),
+                pl.BlockSpec((1, page_size, 1, D), kv_map),
+                pl.BlockSpec((1, page_size, 1, D), kv_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G, D), lambda b, hk, ik, *_: (b, hk, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((Gp, 128), jnp.float32),
+                pltpu.VMEM((Gp, 128), jnp.float32),
+                pltpu.VMEM((Gp, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lengths.astype(jnp.int32),
+      q, k_pages, v_pages)
+    return out
+
+
+def ragged_decode_attention(
+    q,  # [B, 1, Hq, D] or [B, Hq, D]
+    k_pages,  # [P, page_size, Hk, D]
+    v_pages,
+    block_tables,  # [B, max_pages] int32 (sentinel >= P for unallocated)
+    kv_lengths,  # [B] valid kv count INCLUDING the current token
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """Drop-in for ops.paged_kv.ragged_decode_attention (same contract);
+    pages are read in place through the block table."""
+    squeezed = q.ndim == 3
+    if squeezed:
+        q = q[:, None]
+    B, Tq, Hq, D = q.shape
+    assert Tq == 1, f"paged decode kernel is single-token (got Tq={Tq})"
+    Hk = k_pages.shape[2]
+    assert Hq % Hk == 0, f"GQA requires Hq % Hk == 0, got {Hq=} {Hk=}"
+    G = Hq // Hk
+    if scale is None:
+        scale = D**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # h = hk * G + g (the repo's GQA head order: h // G == hk).
+    qg = q[:, 0].reshape(B, Hk, G, D)
+    out = _paged_decode(
+        qg, k_pages, v_pages, block_tables, kv_lengths,
+        scale=float(scale), page_size=int(k_pages.shape[1]),
+        interpret=bool(interpret),
+    )
+    out = out.reshape(B, Hq, D)
+    return out if squeezed else out[:, None]

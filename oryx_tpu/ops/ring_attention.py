@@ -23,7 +23,10 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map
 
 NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -334,19 +337,30 @@ def ring_attention(
         if kv_mask is not None
         else jnp.ones((B, T), jnp.int32)
     )
-    resolved = mesh or jax.sharding.get_abstract_mesh()
+    from oryx_tpu.parallel.sharding import ambient_mesh
+
+    resolved = mesh or ambient_mesh()
     names = getattr(resolved, "axis_names", ()) or ()
     batch = tuple(a for a in batch_axes if a in names) or None
     seq = P(batch, axis_name, None, None)
     tok = P(batch, axis_name)
+    import inspect
+
+    # Replication checking is off (the accumulator update is manual);
+    # the flag was renamed check_rep -> check_vma across JAX versions.
+    check_kw = (
+        "check_vma"
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else "check_rep"
+    )
     fn = shard_map(
         partial(
             ring_attention_shard, axis_name=axis_name, causal=causal,
             scale=scale, impl=impl,
         ),
-        mesh=mesh,
+        mesh=resolved,
         in_specs=(seq, seq, seq, tok, tok, tok),
         out_specs=seq,
-        check_vma=False,
+        **{check_kw: False},
     )
     return fn(q, k, v, positions, positions, kv_valid)

@@ -202,6 +202,34 @@ def cast_params_for_compute(params: Params, dtype, mode: str = "fsdp"):
     return jax.tree.unflatten(treedef, out)
 
 
+def ambient_mesh():
+    """The ambient named mesh, across JAX versions: the abstract mesh
+    (jax >= 0.5, set via `jax.sharding.set_mesh`) or the thread-local
+    physical mesh (older JAX, set via `with mesh:`). Returns None when
+    no mesh is ambient."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is not None:
+        return get()
+    from jax._src import mesh as _mesh_lib
+
+    m = _mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def mesh_scope(mesh):
+    """Context manager making `mesh` ambient for `constrain`/jit calls:
+    `jax.sharding.set_mesh` on new JAX, the legacy `with mesh:` resource
+    env on old. `mesh=None` is a no-op scope."""
+    from contextlib import nullcontext
+
+    if mesh is None:
+        return nullcontext()
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh  # Mesh is itself a context manager on older JAX
+
+
 def constrain(x, *axes):
     """`with_sharding_constraint` iff a named mesh is ambient, else no-op.
 
@@ -212,7 +240,7 @@ def constrain(x, *axes):
     absent from the ambient mesh are dropped (e.g. calling with "sp" on a
     dp/fsdp-only mesh).
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     if mesh is None or mesh.empty or not mesh.axis_names:
         return x
 

@@ -24,6 +24,12 @@ request (requests batch together only when they match); `n > 1` and
 Dynamic batching: non-streaming requests arriving within `batch_window`
 seconds are decoded as ONE `chat_batch` program (the TPU batching win);
 `stream=true` requests run singly via `chat_stream` and emit SSE chunks.
+With `--engine continuous`, ALL requests (streaming and not) instead
+flow through the continuous-batching scheduler (serve/scheduler.py):
+a fixed slot array decoding over a paged KV cache, with admission and
+retirement at chunk boundaries. `GET /metrics` (Prometheus text format)
+reports queue depth, slot occupancy, admitted/evicted counts and
+TTFT / per-token latency histograms for either engine.
 
     python -m oryx_tpu.serve.api_server --model-path models/oryx7b-sft \
         [--shard tp=8] [--port 8000]
@@ -204,11 +210,15 @@ class Batcher:
         window: float = 0.02,
         max_batch: int = 8,
         device_lock: threading.Lock | None = None,
+        metrics=None,
     ):
+        from oryx_tpu.utils.metrics import ServingMetrics
+
         self.pipe = pipe
         self.window = window
         self.max_batch = max_batch
         self.device_lock = device_lock or threading.Lock()
+        self.metrics = metrics or ServingMetrics()
         self.q: queue.Queue[_Pending] = queue.Queue()
         # A request popped from the queue whose max_tokens mismatched the
         # group in flight; it LEADS the next group (FIFO — re-queueing to
@@ -260,11 +270,24 @@ class Batcher:
                     )
                 for p, r, why, use in zip(group, replies, reasons, counts):
                     p.reply, p.finish_reason, p.usage = r, why, use
+                # Wasted-step accounting (scripts/bench_serving_sched.py
+                # compares this against the continuous scheduler): the
+                # whole group decodes the BUCKET length; a row's useful
+                # steps are the tokens it actually kept.
+                bucket = _decode_bucket(first.max_new)
+                useful = sum(c for _, c in counts)
+                self.metrics.inc("decode_steps_total", len(group) * bucket)
+                self.metrics.inc("decode_steps_useful", useful)
+                self.metrics.inc(
+                    "decode_steps_wasted", len(group) * bucket - useful
+                )
+                self.metrics.inc("completed", len(group))
             except Exception as e:  # surface per-request, keep serving
                 for p in group:
                     p.error = f"{type(e).__name__}: {e}"
             for p in group:
                 p.done.set()
+            self.metrics.set_gauge("queue_depth", self.q.qsize())
 
 
 def _parse_sampling(req: dict[str, Any]) -> dict[str, Any]:
@@ -365,16 +388,44 @@ def build_server(
     max_batch: int = 8,
     allow_local_files: bool = False,
     max_tokens_limit: int = 2048,
+    engine: str = "window",
+    num_slots: int = 4,
+    page_size: int = 64,
+    decode_chunk: int = 8,
+    max_ctx: int = 2048,
 ) -> ThreadingHTTPServer:
-    """Construct (not start) the HTTP server around a pipeline."""
+    """Construct (not start) the HTTP server around a pipeline.
+
+    engine: "window" groups non-streaming requests that arrive within
+    `batch_window` into one decode and runs streams solo (the legacy
+    batcher); "continuous" routes EVERYTHING — streaming and not —
+    through the continuous-batching scheduler (serve/scheduler.py):
+    a fixed slot array over a paged KV cache, admission at chunk
+    boundaries, per-slot sampling. Both engines export GET /metrics.
+    """
+    from oryx_tpu.utils.metrics import ServingMetrics
+
+    metrics = ServingMetrics()
     # chat_stream is not thread-safe against itself or chat_batch (one
     # device, one program at a time) — streaming requests serialize with
-    # each other and with the batcher through this lock.
+    # each other and with the batcher through this lock. (Continuous
+    # engine: the scheduler thread owns the device; no lock needed.)
     stream_lock = threading.Lock()
-    batcher = Batcher(
-        pipe, window=batch_window, max_batch=max_batch,
-        device_lock=stream_lock,
-    )
+    batcher = scheduler = None
+    if engine == "continuous":
+        from oryx_tpu.serve.scheduler import ContinuousScheduler
+
+        scheduler = ContinuousScheduler(
+            pipe, num_slots=num_slots, page_size=page_size,
+            chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
+        )
+    elif engine == "window":
+        batcher = Batcher(
+            pipe, window=batch_window, max_batch=max_batch,
+            device_lock=stream_lock, metrics=metrics,
+        )
+    else:
+        raise ValueError(f"unknown engine {engine!r} (window|continuous)")
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet access log
@@ -391,6 +442,17 @@ def build_server(
         def do_GET(self):
             if self.path == "/healthz":
                 self._json(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                if batcher is not None:
+                    metrics.set_gauge("queue_depth", batcher.q.qsize())
+                data = metrics.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             elif self.path == "/v1/models":
                 self._json(200, {
                     "object": "list",
@@ -453,6 +515,13 @@ def build_server(
                 return
 
             is_video = bool(req.get("video")) and len(images) > 1
+            request_dict = {
+                "question": question, "images": images,
+                "is_video": is_video, "history": history,
+            }
+            if scheduler is not None:
+                self._continuous(req, request_dict, max_new, sampling)
+                return
             if req.get("stream"):
                 # A producer thread owns the device (and the lock); this
                 # handler thread only writes to the socket, so a slow or
@@ -549,14 +618,7 @@ def build_server(
                     gone.set()  # stop the producer at its next chunk
                 return
 
-            pending = batcher.submit(
-                {
-                    "question": question, "images": images,
-                    "is_video": is_video, "history": history,
-                },
-                max_new,
-                sampling,
-            )
+            pending = batcher.submit(request_dict, max_new, sampling)
             pending.done.wait()
             if pending.error is not None:
                 self._json(500, {"error": {"message": pending.error}})
@@ -566,11 +628,96 @@ def build_server(
                     usage=pending.usage,
                 ))
 
+        def _continuous(self, req, request_dict, max_new, sampling) -> None:
+            """Route one request through the continuous-batching
+            scheduler. The scheduler thread owns the device; this
+            handler thread only drains the handle's event queue, so a
+            slow client never blocks decode (a dead one flips
+            `cancelled` and the slot frees at the next harvest)."""
+            handle = scheduler.submit(
+                request_dict, max_new, sampling,
+                streaming=bool(req.get("stream")),
+            )
+            if not req.get("stream"):
+                handle.done.wait()
+                if handle.error is not None:
+                    if handle.error_kind == "invalid_request":
+                        # Admission-time rejection (context too long,
+                        # bad media, ...) is the client's fault — 400,
+                        # matching the window engine's up-front checks.
+                        self._json(400, {"error": {
+                            "message": handle.error,
+                            "type": "invalid_request_error",
+                        }})
+                    else:
+                        self._json(
+                            500, {"error": {"message": handle.error}}
+                        )
+                else:
+                    self._json(200, _completion_body(
+                        model_name, handle.reply, handle.finish_reason,
+                        usage=handle.usage,
+                    ))
+                return
+            want_usage = bool(
+                (req.get("stream_options") or {}).get("include_usage")
+            )
+            cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                usage: tuple[int, int] | None = None
+                errored = False
+                while True:
+                    kind, *payload = handle.events.get()
+                    if kind == "delta":
+                        self._sse(_chunk_body(
+                            model_name, cid, payload[0],
+                            usage_field=want_usage,
+                        ))
+                    elif kind == "error":
+                        # Terminal: no usage chunk, no [DONE] — an
+                        # errored stream must not look like a normal
+                        # completion to OpenAI-style clients.
+                        self._sse({"error": {"message": payload[0]}})
+                        errored = True
+                        break
+                    else:  # ("end", reason, usage)
+                        usage = payload[1]
+                        self._sse(_chunk_body(
+                            model_name, cid, None, payload[0],
+                            usage_field=want_usage,
+                        ))
+                        break
+                if errored:
+                    return
+                if want_usage:
+                    p, c = usage or (0, 0)
+                    self._sse(_chunk_body(
+                        model_name, cid, None,
+                        usage_field=True,
+                        usage={
+                            "prompt_tokens": p,
+                            "completion_tokens": c,
+                            "total_tokens": p + c,
+                        },
+                    ))
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                handle.cancelled = True
+
         def _sse(self, body: dict[str, Any]) -> None:
             self.wfile.write(f"data: {json.dumps(body)}\n\n".encode())
             self.wfile.flush()
 
-    return ThreadingHTTPServer((host, port), Handler)
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.metrics = metrics
+    srv.scheduler = scheduler
+    srv.batcher = batcher
+    return srv
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -582,6 +729,31 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--batch-window", type=float, default=0.02)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument(
+        "--engine", choices=["window", "continuous"], default="window",
+        help="request batching engine: the window batcher (group within "
+        "--batch-window) or the continuous-batching scheduler over a "
+        "paged KV cache (admission at chunk boundaries, per-slot "
+        "sampling, GET /metrics occupancy)",
+    )
+    ap.add_argument(
+        "--num-slots", type=int, default=4,
+        help="continuous engine: decode slot array size",
+    )
+    ap.add_argument(
+        "--page-size", type=int, default=64,
+        help="continuous engine: KV page size in tokens",
+    )
+    ap.add_argument(
+        "--decode-chunk", type=int, default=8,
+        help="continuous engine: decode steps per compiled dispatch "
+        "(admission latency is bounded by one chunk)",
+    )
+    ap.add_argument(
+        "--max-ctx", type=int, default=2048,
+        help="continuous engine: per-request context ceiling "
+        "(prompt + max_tokens; sizes the per-slot block table)",
+    )
     ap.add_argument(
         "--allow-local-files", action="store_true",
         help="let image_url reference server-local file paths (off by "
@@ -621,6 +793,9 @@ def main(argv: list[str] | None = None) -> None:
         batch_window=args.batch_window, max_batch=args.max_batch,
         allow_local_files=args.allow_local_files,
         max_tokens_limit=args.max_tokens_limit,
+        engine=args.engine, num_slots=args.num_slots,
+        page_size=args.page_size, decode_chunk=args.decode_chunk,
+        max_ctx=args.max_ctx,
     )
     print(f"serving {args.model_name} on http://{args.host}:{args.port}")
     srv.serve_forever()

@@ -88,7 +88,7 @@ def _serving_restore_target(meta, cfg: OryxConfig, mesh, mode: str, dtype):
     straight onto their serving devices: param leaves become abstract
     arrays with serving shardings (no host-RAM or single-device copy of
     a 34B tree); TrainState extras (optimizer moments, step) become
-    `ocp.PLACEHOLDER` and are never read. The dtype override applies to
+    `ckpt_lib.PLACEHOLDER` and are never read. The dtype override applies to
     floating leaves only."""
     import orbax.checkpoint as ocp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -120,7 +120,7 @@ def _serving_restore_target(meta, cfg: OryxConfig, mesh, mode: str, dtype):
         keys = tuple(str(p) for p in path)
         wanted = "params" in keys[0] if state_shaped else True
         if not wanted:
-            return ocp.PLACEHOLDER
+            return ckpt_lib.PLACEHOLDER
         spec = P()
         for ppath, s in flat_specs:
             if keys[-len(ppath):] == ppath and len(leaf.shape) == len(s):

@@ -43,6 +43,56 @@ def infer_modality(num_images: int, is_video: bool) -> str:
     return MODALITY_MULTI_IMAGE if num_images > 1 else MODALITY_IMAGE
 
 
+def stop_cut(text: str, stops: Sequence[str]) -> tuple[str, bool]:
+    """Cut `text` at the earliest full stop-string occurrence. Returns
+    (trimmed text, whether a stop fired). Shared by the streaming path
+    and the continuous-batching scheduler."""
+    cut = min(
+        (i for s in stops if (i := text.find(s)) >= 0),
+        default=-1,
+    )
+    return (text[:cut], True) if cut >= 0 else (text, False)
+
+
+def stop_token_count(
+    tokenizer, emitted: Sequence[int], stops: Sequence[str],
+    chunk_start: int,
+) -> int:
+    """Minimal token-prefix length of `emitted` whose decoded text
+    contains a stop string — the usage convention ("completion counts
+    through the token completing the stop"), shared by chat_stream and
+    the continuous scheduler. The stop completed somewhere in the tokens
+    from `chunk_start` on (earlier prefixes were checked and clean), so
+    only that tail is scanned."""
+    for k in range(chunk_start + 1, len(emitted) + 1):
+        if stop_cut(
+            tokenizer.decode(list(emitted[:k]), skip_special_tokens=True),
+            stops,
+        )[1]:
+            return k
+    return len(emitted)
+
+
+def stable_text_prefix(text: str, stops: Sequence[str]) -> str:
+    """The prefix of `text` that can never change as more tokens decode:
+    hold back an incomplete UTF-8 tail (U+FFFD), any suffix that could
+    grow into a stop string, and leading/trailing whitespace (chat()
+    strips both ends; lstrip is consistent across calls, rstripped text
+    re-emits once non-whitespace follows)."""
+    text = text.lstrip()
+    while text.endswith("�"):
+        text = text[:-1]
+    held = 0
+    for s in stops:
+        for i in range(len(s) - 1, 0, -1):
+            if text.endswith(s[:i]):
+                held = max(held, i)
+                break
+    if held:
+        text = text[: len(text) - held]
+    return text.rstrip()
+
+
 @partial(
     jax.jit, static_argnames=("cfg", "max_new_tokens", "cache_len")
 )
@@ -176,13 +226,9 @@ class OryxInference:
         )
 
     def _mesh_scope(self):
-        from contextlib import nullcontext
+        from oryx_tpu.parallel.sharding import mesh_scope
 
-        return (
-            jax.sharding.set_mesh(self.mesh)
-            if self.mesh is not None
-            else nullcontext()
-        )
+        return mesh_scope(self.mesh)
 
     # ---- host-side prompt/media prep ------------------------------------
 
@@ -520,32 +566,10 @@ class OryxInference:
         stop_tok_count: int | None = None
 
         def trim_stops(text: str) -> tuple[str, bool]:
-            """Cut at the earliest full stop-string occurrence."""
-            cut = min(
-                (i for s in stops if (i := text.find(s)) >= 0),
-                default=-1,
-            )
-            return (text[:cut], True) if cut >= 0 else (text, False)
+            return stop_cut(text, stops)
 
         def stable_prefix(text: str) -> str:
-            """The prefix of `text` that can never change as more tokens
-            decode: hold back an incomplete UTF-8 tail (U+FFFD), any
-            suffix that could grow into a stop string, and leading/
-            trailing whitespace (chat() strips both ends; lstrip is
-            consistent across calls, rstripped text re-emits once
-            non-whitespace follows)."""
-            text = text.lstrip()
-            while text.endswith("�"):
-                text = text[:-1]
-            held = 0
-            for s in stops:
-                for i in range(len(s) - 1, 0, -1):
-                    if text.endswith(s[:i]):
-                        held = max(held, i)
-                        break
-            if held:
-                text = text[: len(text) - held]
-            return text.rstrip()
+            return stable_text_prefix(text, stops)
 
         final_cache = None
 
@@ -601,15 +625,10 @@ class OryxInference:
                 text, hit = trim_stops(text)
                 if usage_out is not None and hit and stop_tok_count is None:
                     # The stop string completed somewhere in THIS chunk
-                    # (earlier chunks were trimmed and didn't hit), so a
-                    # short incremental decode finds the minimal token
-                    # prefix containing it — the host-side analogue of
-                    # the device's finishing-token count.
-                    for k in range(chunk_start + 1, len(emitted) + 1):
-                        if trim_stops(self.tokenizer.decode(
-                                emitted[:k], skip_special_tokens=True))[1]:
-                            stop_tok_count = k
-                            break
+                    # (earlier chunks were trimmed and didn't hit).
+                    stop_tok_count = stop_token_count(
+                        self.tokenizer, emitted, stops, chunk_start
+                    )
                 finished = finished or hit
                 safe = text.strip() if finished else stable_prefix(text)
                 if len(safe) > len(text_done):

@@ -1,0 +1,599 @@
+"""Continuous-batching scheduler over the paged decode path.
+
+Replaces the window-batcher model ("wait `batch_window`, decode the
+whole group to the longest row") with a slot array + admission queue:
+
+  * The device runs ONE compiled program shape forever —
+    `paged_decode_chunk` over `num_slots` rows, `chunk` tokens per
+    dispatch. Which request owns a slot is host-side state (block
+    tables, lengths, per-slot sampling arrays) edited between chunks.
+  * A request is admitted the moment a slot AND enough KV pages are
+    free: its prompt prefills into its own pages (`paged_prefill`, the
+    pipeline's prompt prep — text or multimodal — feeds it), and it
+    starts decoding at the next chunk, mid-flight of everyone else.
+  * A finished row's pages return to the free list at the chunk
+    boundary and the head of the queue takes the slot — so decode
+    throughput tracks OCCUPANCY of the slot array instead of the p100
+    of a fixed batch.
+  * Per-slot sampling state (temperature/top_p/top_k as traced arrays,
+    per-slot PRNG keys) means mixed sampling configs share one program
+    and a row's sample stream never depends on its neighbors — which is
+    also what makes EVICTION sound: when the page pool runs dry, the
+    youngest slot is evicted and re-queued, and its deterministic
+    replay (same key, same prompt) re-emits the same tokens, which the
+    scheduler skips (`_Request.replay`) so the client stream never
+    stutters or duplicates.
+
+EOS is detected on device (the chunk program freezes finished rows);
+stop STRINGS and per-row max_tokens are enforced host-side at harvest,
+with the same trim/stable-prefix text rules as `chat_stream` — a
+request's reply through this engine is byte-identical to `pipe.chat`.
+
+Metrics (utils/metrics.ServingMetrics): queue depth, slot occupancy,
+admitted/evicted/completed counts, TTFT and per-token latency
+histograms, wasted vs useful decode steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.models import generate as generate_lib
+from oryx_tpu.models import oryx, qwen2
+from oryx_tpu.ops import paged_kv
+from oryx_tpu.serve import pipeline as pipeline_lib
+from oryx_tpu.utils.metrics import ServingMetrics, TTFT_BUCKETS
+
+
+class RequestHandle:
+    """Consumer side of a scheduled request.
+
+    `events` carries ("delta", text), ("end", finish_reason, usage) or
+    ("error", message) — at most one terminal event. `result()` blocks
+    for the terminal event and returns the assembled reply. Setting
+    `cancelled` (client hung up) releases the slot at the next harvest.
+    """
+
+    def __init__(self) -> None:
+        self.events: queue.Queue[tuple] = queue.Queue()
+        self.done = threading.Event()
+        self.reply: str | None = None
+        self.finish_reason: str = "stop"
+        self.usage: tuple[int, int] | None = None
+        self.error: str | None = None
+        # "invalid_request" when the request itself was rejected at
+        # admission (HTTP 400 material) vs a server-side fault (500).
+        self.error_kind: str = "server_error"
+        self.cancelled = False
+        # Streaming consumers read text deltas off `events`; plain ones
+        # only wait on `done` (set by submit(streaming=...)).
+        self.streaming = False
+        self.debug: dict[str, Any] = {}
+
+    def result(self, timeout: float | None = None):
+        """(reply, finish_reason, usage) or raises RuntimeError."""
+        if not self.done.wait(timeout):
+            raise TimeoutError("request did not complete in time")
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return self.reply, self.finish_reason, self.usage
+
+
+@dataclasses.dataclass
+class _Request:
+    request: dict[str, Any]
+    max_new: int
+    sampling: dict[str, Any]
+    handle: RequestHandle
+    submit_time: float
+    stops: list[str]
+    # Filled at first admission; cached so an evicted request never
+    # re-runs the host-side prompt/media prep.
+    embeds: Any = None
+    length: int = 0
+    key0: Any = None
+    # Host text state (survives eviction: replay re-derives the same
+    # tokens and `replay` skips re-processing them).
+    emitted: list[int] = dataclasses.field(default_factory=list)
+    text_done: str = ""
+    processed: int = 0  # tokens consumed from the device stream
+    replay: int = 0  # tokens to skip after an eviction re-admission
+    admit_seq: int = -1  # admission order (eviction picks the youngest)
+
+
+class ContinuousScheduler:
+    """Slot map + admission queue + paged KV pool around one pipeline.
+
+    Drop-in replacement for api_server.Batcher at the submit() level;
+    also serves streaming consumers through RequestHandle.events.
+    """
+
+    def __init__(
+        self,
+        pipe,
+        *,
+        num_slots: int = 4,
+        page_size: int = 64,
+        chunk: int = 8,
+        max_ctx: int = 2048,
+        num_pages: int | None = None,
+        metrics: ServingMetrics | None = None,
+        seed: int = 0,
+        autostart: bool = True,
+    ):
+        if max_ctx % page_size:
+            raise ValueError(f"{max_ctx=} not a multiple of {page_size=}")
+        self.pipe = pipe
+        self.cfg = pipe.cfg
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.chunk = chunk
+        self.max_ctx = max_ctx
+        self.max_pages = max_ctx // page_size
+        self.num_pages = num_pages or num_slots * self.max_pages
+        self.metrics = metrics or ServingMetrics()
+        self.allocator = paged_kv.PageAllocator(self.num_pages, page_size)
+        dtype = oryx.compute_dtype(self.cfg)
+        self.kv_pages = qwen2.init_paged_kv_cache(
+            self.cfg.llm, self.num_pages, page_size, dtype=dtype
+        )
+        S = num_slots
+        self._sentinel = self.allocator.sentinel
+        self.bt = np.full((S, self.max_pages), self._sentinel, np.int32)
+        self.tok = np.zeros((S,), np.int32)
+        self.lengths = np.zeros((S,), np.int32)
+        self.finished = np.ones((S,), bool)  # empty slots ride as finished
+        self.temp = np.zeros((S,), np.float32)
+        self.top_p = np.ones((S,), np.float32)
+        self.top_k = np.zeros((S,), np.int32)
+        self.stop_sequences = pipe.stop_sequences  # template stop (device)
+        stop_L = (
+            0 if self.stop_sequences is None else self.stop_sequences.shape[1]
+        )
+        self.recent = np.full((S, stop_L), -2, np.int32)
+        self.keys = jax.random.split(jax.random.key(seed), S)
+        self.slots: list[_Request | None] = [None] * S
+        self._queue: deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._shutdown = False
+        self._admit_seq = 0
+        self.chunks_run = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        if autostart:
+            self._thread.start()
+
+    # ---- public API ------------------------------------------------------
+
+    def start(self) -> None:
+        if not self._thread.is_alive():
+            self._thread.start()
+
+    def submit(
+        self,
+        request: dict[str, Any],
+        max_new: int,
+        sampling: dict[str, Any] | None = None,
+        *,
+        streaming: bool = False,
+    ) -> RequestHandle:
+        sampling = sampling or {}
+        h = RequestHandle()
+        h.streaming = streaming
+        stops = (
+            [self.pipe.conv.stop_str] if self.pipe.conv.stop_str else []
+        ) + [s for s in (sampling.get("stop") or []) if s]
+        req = _Request(
+            request=request, max_new=max_new, sampling=sampling,
+            handle=h, submit_time=time.monotonic(), stops=stops,
+        )
+        with self._cond:
+            self._queue.append(req)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify()
+        return h
+
+    def close(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30)
+
+    # ---- slot bookkeeping ------------------------------------------------
+
+    def _reset_pool(self) -> None:
+        """Fresh page pool + allocator + empty slot state (used after a
+        device-step failure invalidated the donated pool). Callers have
+        already errored-out every in-flight request."""
+        self.allocator = paged_kv.PageAllocator(
+            self.num_pages, self.page_size
+        )
+        self.kv_pages = qwen2.init_paged_kv_cache(
+            self.cfg.llm, self.num_pages, self.page_size,
+            dtype=oryx.compute_dtype(self.cfg),
+        )
+        self.bt[:] = self._sentinel
+        self.slots = [None] * self.num_slots
+        self.finished[:] = True
+        self.lengths[:] = 0
+        self.tok[:] = 0
+        self.recent[:] = -2
+
+    def _held(self, s: int) -> int:
+        return int((self.bt[s] != self._sentinel).sum())
+
+    def _free_slot_pages(self, s: int) -> None:
+        pages = [int(p) for p in self.bt[s] if p != self._sentinel]
+        if pages:
+            self.allocator.free(pages)
+        self.bt[s] = self._sentinel
+
+    def _clear_slot(self, s: int) -> None:
+        self._free_slot_pages(s)
+        self.slots[s] = None
+        self.finished[s] = True
+        self.lengths[s] = 0
+        self.tok[s] = 0
+        self.temp[s] = 0.0
+        self.top_p[s] = 1.0
+        self.top_k[s] = 0
+        self.recent[s] = -2
+
+    def _grow_slot(self, s: int, tokens: int) -> bool:
+        """Extend slot s's block table to cover `tokens` logical slots;
+        False when the free list can't satisfy it. The ask is clamped to
+        max_ctx (the table is max_pages wide; near the context ceiling
+        the final chunk's overshoot steps self-confine to the row's own
+        discarded tail)."""
+        tokens = min(tokens, self.max_ctx)
+        need = self.allocator.pages_for(tokens) - self._held(s)
+        if need <= 0:
+            return True
+        if need > self.allocator.num_free:
+            return False
+        held = self._held(s)
+        self.bt[s, held: held + need] = self.allocator.alloc(need)
+        return True
+
+    # ---- scheduling loop -------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                if not self._queue and all(r is None for r in self.slots):
+                    self._cond.wait(timeout=0.1)
+                    continue
+            try:
+                self._admit()
+                if any(r is not None for r in self.slots):
+                    self._ensure_capacity()
+                    self._step_chunk()
+            except Exception as e:  # surface to every in-flight client
+                msg = f"{type(e).__name__}: {e}"
+                for s, req in enumerate(self.slots):
+                    if req is not None:
+                        self._finish_error(s, msg)
+                with self._cond:
+                    while self._queue:
+                        r = self._queue.popleft()
+                        r.handle.error = msg
+                        r.handle.events.put(("error", msg))
+                        r.handle.done.set()
+                # The failed dispatch may have CONSUMED the donated page
+                # pool (donate_argnames=kv_pages): rebuild it so the
+                # engine keeps serving new traffic instead of erroring
+                # forever on a deleted array.
+                self._reset_pool()
+
+    def _admit(self) -> None:
+        gen = self.cfg.generation
+        while True:
+            free = [s for s, r in enumerate(self.slots) if r is None]
+            if not free:
+                break
+            with self._cond:
+                if not self._queue:
+                    break
+                req = self._queue[0]
+            if req.handle.cancelled:
+                with self._cond:
+                    self._queue.popleft()
+                continue
+            if req.embeds is None:
+                try:
+                    ids, imgs, factors, caps = self.pipe._prepare_request(
+                        req.request
+                    )
+                    with self.pipe._mesh_scope():
+                        req.embeds, req.length = self.pipe._prompt_embeds(
+                            self.cfg, ids, imgs, factors, caps
+                        )
+                    s_ = req.sampling
+                    req.temp = float(
+                        s_.get("temperature", gen.temperature) or 0.0
+                    )
+                    req.topp = float(s_.get("top_p", gen.top_p) or 1.0)
+                    req.topk = int(s_.get("top_k", gen.top_k) or 0)
+                    req.key0 = jax.random.key(int(s_.get("seed") or 0))
+                    if req.length + req.max_new > self.max_ctx:
+                        raise ValueError(
+                            f"prompt ({req.length}) + max_tokens "
+                            f"({req.max_new}) exceeds max_ctx {self.max_ctx}"
+                        )
+                    if self.allocator.pages_for(
+                        req.length + self.chunk
+                    ) > self.num_pages:
+                        raise ValueError(
+                            "request needs more pages than the whole pool"
+                        )
+                except Exception as e:
+                    with self._cond:
+                        self._queue.popleft()
+                        self.metrics.set_gauge(
+                            "queue_depth", len(self._queue)
+                        )
+                    msg = f"{type(e).__name__}: {e}"
+                    req.handle.error = msg
+                    if isinstance(e, ValueError):
+                        req.handle.error_kind = "invalid_request"
+                    req.handle.events.put(("error", msg))
+                    req.handle.done.set()
+                    continue
+            s = free[0]
+            # Pages for the prompt plus the first chunk's writes. FIFO
+            # head-of-line: if the head doesn't fit, nobody jumps it
+            # (that is the no-starvation guarantee).
+            if not self._grow_slot(s, req.length + self.chunk):
+                break
+            with self._cond:
+                self._queue.popleft()
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._place(s, req)
+
+    def _place(self, s: int, req: _Request) -> None:
+        """Prefill `req` into slot s and mark it live. The slot's key is
+        (re)seeded from the REQUEST's key0 — a slot must never inherit a
+        previous occupant's RNG state (that would make sampled streams
+        depend on scheduling history, and break eviction replay)."""
+        B1 = np.newaxis
+        with self.pipe._mesh_scope():
+            kv, tok0, key = generate_lib.paged_prefill(
+                self.pipe.params["llm"], self.cfg.llm,
+                req.embeds,
+                jnp.asarray([req.length], np.int32),
+                jnp.asarray(self.bt[s][B1]),
+                self.kv_pages,
+                jnp.zeros((1,), np.int32),
+                req.key0[B1],
+                jnp.asarray([req.temp], np.float32),
+                jnp.asarray([req.topp], np.float32),
+                jnp.asarray([req.topk], np.int32),
+                attn_impl=self.cfg.attn_impl,
+                compute_dtype=oryx.compute_dtype(self.cfg),
+            )
+        self.kv_pages = kv
+        self.slots[s] = req
+        self.tok[s] = int(np.asarray(tok0)[0])
+        self.lengths[s] = req.length
+        self.finished[s] = False
+        self.temp[s] = req.temp
+        self.top_p[s] = req.topp
+        self.top_k[s] = req.topk
+        self.recent[s] = -2
+        self.keys = self.keys.at[s].set(key[0])
+        if req.admit_seq < 0:
+            self.metrics.observe(
+                "ttft_seconds", time.monotonic() - req.submit_time,
+                buckets=TTFT_BUCKETS,
+            )
+            req.handle.debug["admit_chunk"] = self.chunks_run
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        self.metrics.inc("admitted")
+        self._occupancy_gauge()
+        # tok0 is this slot's first generated token — process it now so
+        # a max_tokens=1 request never occupies a chunk. The chunk
+        # program re-emits tok0 as its first output (the scan step emits
+        # the token it was FED, dense-path semantics), so one extra
+        # replay skip keeps the stream exactly-once.
+        self._advance(s, [int(self.tok[s])])
+        if self.slots[s] is not None:
+            req.replay += 1
+
+    def _ensure_capacity(self) -> None:
+        """Every live slot must own pages for lengths + chunk before the
+        next dispatch; under page pressure, preempt YOUNGER slots only —
+        a slot with no younger victim preempts ITSELF (vLLM-style), so
+        the oldest request always makes progress and eviction can never
+        ping-pong two slots at the same growth point forever."""
+        order = sorted(
+            (s for s, r in enumerate(self.slots) if r is not None),
+            key=lambda s: self.slots[s].admit_seq,
+        )
+        for s in order:
+            if self.slots[s] is None or self.finished[s]:
+                continue  # freed or evicted by an earlier iteration
+            while not self._grow_slot(s, int(self.lengths[s]) + self.chunk):
+                me = self.slots[s].admit_seq
+                younger = [
+                    v for v in order
+                    if self.slots[v] is not None
+                    and self.slots[v].admit_seq > me
+                ]
+                if younger:
+                    self._evict(
+                        max(younger, key=lambda v: self.slots[v].admit_seq)
+                    )
+                elif any(
+                    self.slots[v] is not None for v in order if v != s
+                ):
+                    self._evict(s)  # wait for the older slots' pages
+                    break
+                else:
+                    self._finish_error(
+                        s, "page pool exhausted for a single request"
+                    )
+                    break
+
+    def _evict(self, s: int) -> None:
+        """Free slot s and requeue its request at the FRONT; replay
+        (same key0, same prompt) re-derives its stream deterministically
+        and `processed` tokens are skipped on re-admission."""
+        req = self.slots[s]
+        req.replay = req.processed
+        self._clear_slot(s)
+        with self._cond:
+            self._queue.appendleft(req)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        self.metrics.inc("evicted")
+        self._occupancy_gauge()
+
+    def _step_chunk(self) -> None:
+        t0 = time.monotonic()
+        with self.pipe._mesh_scope():
+            (self.kv_pages, tok, lengths, finished, recent, self.keys,
+             toks, fin) = generate_lib.paged_decode_chunk(
+                self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
+                jnp.asarray(self.bt),
+                jnp.asarray(self.tok),
+                jnp.asarray(self.lengths),
+                jnp.asarray(self.finished),
+                jnp.asarray(self.recent),
+                self.keys,
+                jnp.asarray(self.temp),
+                jnp.asarray(self.top_p),
+                jnp.asarray(self.top_k),
+                self.stop_sequences,
+                chunk=self.chunk, eos=self.cfg.generation.eos_token_id,
+                attn_impl=self.cfg.attn_impl,
+                compute_dtype=oryx.compute_dtype(self.cfg),
+            )
+        dt = time.monotonic() - t0
+        self.tok = np.asarray(tok).copy()
+        self.lengths = np.asarray(lengths).copy()
+        self.finished = np.asarray(finished).copy()
+        self.recent = np.asarray(recent).copy()
+        toks, fin = np.asarray(toks), np.asarray(fin)
+        self.chunks_run += 1
+        self.metrics.inc("chunks")
+        self.metrics.observe(
+            "time_per_output_token_seconds", dt / max(1, self.chunk)
+        )
+        useful = 0
+        for s, req in enumerate(self.slots):
+            if req is None:
+                continue
+            useful += self._advance(s, [int(t) for t in toks[s]])
+        total = self.num_slots * self.chunk
+        self.metrics.inc("decode_steps_total", total)
+        self.metrics.inc("decode_steps_useful", useful)
+        self.metrics.inc("decode_steps_wasted", total - useful)
+        self._occupancy_gauge()
+
+    def _occupancy_gauge(self) -> None:
+        live = sum(
+            1 for s, r in enumerate(self.slots)
+            if r is not None and not self.finished[s]
+        )
+        self.metrics.set_gauge("slot_occupancy", live / self.num_slots)
+        u = self.metrics.get("decode_steps_useful")
+        t = self.metrics.get("decode_steps_total")
+        if t:
+            self.metrics.set_gauge("decode_step_utilization", u / t)
+
+    # ---- harvest / text emission ----------------------------------------
+
+    def _advance(self, s: int, tokens: list[int]) -> int:
+        """Feed slot s's newly decoded tokens through the host-side text
+        machine; returns the number of USEFUL steps consumed (replayed
+        steps count as wasted — they are eviction overhead). Mirrors
+        chat_stream's emission rules (stop trim, stable prefix, EOS
+        fill, length cap) AND its cost profile: token-level checks (EOS,
+        max_new) run per token, the tokenizer decode + stop trim run
+        once per CHUNK — host work is linear in the reply, not
+        quadratic."""
+        req = self.slots[s]
+        eos = self.cfg.generation.eos_token_id
+        tokenizer = self.pipe.tokenizer
+        useful = 0
+        if req.handle.cancelled:
+            self.metrics.inc("cancelled")
+            self._clear_slot(s)
+            return useful
+        chunk_start = len(req.emitted)
+        finish = None  # (reason, completion_count)
+        for t in tokens:
+            if req.replay > 0:
+                req.replay -= 1
+                continue
+            req.processed += 1
+            useful += 1
+            if t == eos:
+                finish = ("stop", len(req.emitted) + 1)
+                break
+            req.emitted.append(t)
+            if len(req.emitted) >= req.max_new:
+                finish = ("length", len(req.emitted))
+                break
+        if len(req.emitted) == chunk_start and finish is None:
+            return useful  # pure replay skip: nothing new to decode
+        text = tokenizer.decode(req.emitted, skip_special_tokens=True)
+        text, hit = pipeline_lib.stop_cut(text, req.stops)
+        if hit:
+            # The stop completed in THIS chunk (earlier chunks were
+            # checked clean); it precedes any EOS/length finish seen
+            # later in the same chunk.
+            n = pipeline_lib.stop_token_count(
+                tokenizer, req.emitted, req.stops, chunk_start
+            )
+            if finish is None or n <= finish[1]:
+                finish = ("stop", n)
+        if finish is not None:
+            # Flush the held-back tail (stable_text_prefix may have
+            # withheld whitespace / a stop-string prefix) exactly as
+            # chat_stream does on finish.
+            self._emit_text(req, text.strip())
+            self._finish(s, finish[0], completion=finish[1])
+        else:
+            self._emit_text(
+                req, pipeline_lib.stable_text_prefix(text, req.stops)
+            )
+        return useful
+
+    def _emit_text(self, req: _Request, safe: str) -> None:
+        if len(safe) > len(req.text_done):
+            if req.handle.streaming:
+                # Only streaming consumers drain the event queue; for
+                # plain requests the reply accumulates in text_done and
+                # queued fragments would just sit there.
+                req.handle.events.put(("delta", safe[len(req.text_done):]))
+            req.text_done = safe
+
+    def _finish(self, s: int, reason: str, completion: int) -> None:
+        req = self.slots[s]
+        self._clear_slot(s)
+        req.handle.reply = req.text_done
+        req.handle.finish_reason = reason
+        req.handle.usage = (req.length, completion)
+        req.handle.debug["finish_chunk"] = self.chunks_run
+        req.handle.events.put(("end", reason, req.handle.usage))
+        req.handle.done.set()
+        self.metrics.inc("completed")
+
+    def _finish_error(self, s: int, msg: str) -> None:
+        req = self.slots[s]
+        self._clear_slot(s)
+        req.handle.error = msg
+        req.handle.events.put(("error", msg))
+        req.handle.done.set()

@@ -44,7 +44,7 @@ class Trainer:
         )
         self.ckpt = CheckpointManager(cfg.train.checkpoint_dir)
 
-        with jax.sharding.set_mesh(self.mesh):
+        with sharding.mesh_scope(self.mesh):
             if params is None:
                 params = oryx.init_params(cfg, jax.random.key(cfg.train.seed))
             if cfg.train.tune == "lora" and not cfg.train.lora.enable:
@@ -176,7 +176,7 @@ class Trainer:
             batches = prefetcher = PrefetchIterator(batches, depth=prefetch)
         consecutive_skipped = 0
         try:
-            with jax.sharding.set_mesh(self.mesh):
+            with sharding.mesh_scope(self.mesh):
                 for step_i in range(start, num_steps):
                     try:
                         host_batch = next(batches)

@@ -20,6 +20,23 @@ import orbax.checkpoint as ocp
 Params = dict[str, Any]
 
 
+class _Placeholder:
+    """Stand-in for `ocp.PLACEHOLDER` on orbax versions that predate it
+    (restore_partial then falls back to a full host restore and drops
+    these leaves afterwards)."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return "PLACEHOLDER"
+
+
+# Leaf marker for restore_partial targets: "do not restore this leaf".
+# Native on new orbax; emulated on old (see restore_partial).
+PLACEHOLDER = getattr(ocp, "PLACEHOLDER", None)
+_NATIVE_PLACEHOLDER = PLACEHOLDER is not None
+if PLACEHOLDER is None:
+    PLACEHOLDER = _Placeholder()
+
+
 class CheckpointManager:
     """Async step-numbered checkpoints with retention, plus resume."""
 
@@ -53,9 +70,29 @@ class CheckpointManager:
                 raise FileNotFoundError(f"no checkpoints in {self.directory}")
         if state_like is None:
             return self._mgr.restore(step)
-        return self._mgr.restore(
+        restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(state_like)
         )
+        # Older orbax restores on the default device and silently drops
+        # the template's shardings; re-place any leaf whose sharding
+        # disagrees with the target (no-op copy-wise on new orbax).
+        # Single-device template leaves (step counters, optax schedule
+        # counts) were UNCOMMITTED arrays; orbax hands back committed
+        # ones, which jit refuses to mix with multi-device args —
+        # rebuild those uncommitted.
+        from jax.sharding import SingleDeviceSharding
+
+        def place(t, r):
+            want = getattr(t, "sharding", None)
+            if want is None or not hasattr(r, "sharding"):
+                return r
+            if r.sharding != want:
+                return jax.device_put(r, want)
+            if isinstance(want, SingleDeviceSharding):
+                return jax.numpy.asarray(np.asarray(r))
+            return r
+
+        return jax.tree.map(place, state_like, restored)
 
     def restore_partial(self, target: Any, step: int | None = None) -> Any:
         """Restore only the non-PLACEHOLDER leaves of `target` (abstract
@@ -68,6 +105,22 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(f"no checkpoints in {self.directory}")
         path = os.path.join(self.directory, str(step), "default")
+
+        if not _NATIVE_PLACEHOLDER:
+            # orbax predates PLACEHOLDER: restore the whole tree on the
+            # host, then place only the wanted leaves per the target's
+            # sharding/dtype; placeholder positions pass the restored
+            # value through (callers drop those subtrees anyway).
+            full = ocp.PyTreeCheckpointer().restore(path)
+
+            def place(t, r):
+                if isinstance(t, jax.ShapeDtypeStruct):
+                    return jax.device_put(
+                        np.asarray(r).astype(t.dtype), t.sharding
+                    )
+                return r
+
+            return jax.tree.map(place, target, full)
 
         # PyTreeRestore takes placement from restore_args, NOT from the
         # target's ShapeDtypeStruct.sharding (which it silently ignores,

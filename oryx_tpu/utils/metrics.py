@@ -112,3 +112,108 @@ class MetricLogger:
             self._f.close()
         if self._tb:
             self._tb.close()
+
+
+# ---------------------------------------------------------------------------
+# Serving metrics (api_server GET /metrics)
+# ---------------------------------------------------------------------------
+
+
+class Histogram:
+    """Fixed-bucket histogram in the Prometheus cumulative-`le` shape.
+
+    Buckets are upper bounds; +Inf is implicit (the total count). Thread
+    safety comes from the owning ServingMetrics lock.
+    """
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * len(self.buckets)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.total += 1
+        self.sum += float(value)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+
+    def render(self, name: str, out: list[str]) -> None:
+        out.append(f"# TYPE {name} histogram")
+        for b, c in zip(self.buckets, self.counts):
+            # counts are already cumulative (observe touches every
+            # bucket whose bound covers the value)
+            out.append(f'{name}_bucket{{le="{b:g}"}} {c}')
+        out.append(f'{name}_bucket{{le="+Inf"}} {self.total}')
+        out.append(f"{name}_sum {self.sum:.17g}")
+        out.append(f"{name}_count {self.total}")
+
+
+# Default latency bucket ladders (seconds): TTFT spans prefill compiles;
+# per-token latency spans a decode step.
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0)
+PER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5)
+
+
+class ServingMetrics:
+    """Thread-safe counters / gauges / histograms for the serving path,
+    rendered in the Prometheus text exposition format.
+
+    The scheduler (serve/scheduler.py) and the window batcher both feed
+    one instance; `GET /metrics` renders it. Metric names are created on
+    first touch so callers never pre-register."""
+
+    def __init__(self, prefix: str = "oryx_serving"):
+        import threading
+
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, Histogram] = {
+            "ttft_seconds": Histogram(TTFT_BUCKETS),
+            "time_per_output_token_seconds": Histogram(PER_TOKEN_BUCKETS),
+        }
+
+    def inc(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float,
+                buckets: tuple[float, ...] = PER_TOKEN_BUCKETS) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(buckets)
+            h.observe(value)
+
+    def get(self, name: str) -> float:
+        """Current counter (or gauge) value, 0 when never touched."""
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, 0.0)
+
+    def render(self) -> str:
+        out: list[str] = []
+        with self._lock:
+            # Full precision (%g rounds to 6 significant digits, which
+            # quantizes large counters and hides small increments).
+            for name in sorted(self._counters):
+                full = f"{self.prefix}_{name}"
+                out.append(f"# TYPE {full} counter")
+                out.append(f"{full} {self._counters[name]:.17g}")
+            for name in sorted(self._gauges):
+                full = f"{self.prefix}_{name}"
+                out.append(f"# TYPE {full} gauge")
+                out.append(f"{full} {self._gauges[name]:.17g}")
+            for name in sorted(self._hists):
+                self._hists[name].render(f"{self.prefix}_{name}", out)
+        return "\n".join(out) + "\n"

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CPU microbench: window batcher vs continuous scheduler on a
+skewed-length workload.
+
+Measures the WASTED-STEP FRACTION — decode steps spent on rows that are
+already finished (window batcher: every short row rides the decode
+bucket to its end; scheduler: only the chunk overhang + idle slots) —
+plus slot occupancy, on the tiny CPU model. The acceptance bar for the
+continuous-batching change is a >= 2x drop in wasted fraction
+(tests/test_scheduler.py runs this as a `slow` test).
+
+    JAX_PLATFORMS=cpu python scripts/bench_serving_sched.py \
+        [--shorts 10 --longs 4 --short-cap 4 --long-cap 24] \
+        [--num-slots 4 --chunk 4 --page-size 16] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class _CharTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def _workload(shorts: int, longs: int, short_cap: int, long_cap: int,
+              seed: int = 0):
+    """Skewed request mix, shuffled with a fixed seed (arrival order
+    matters for both engines)."""
+    import numpy as np
+
+    reqs = [("short request %d" % i, short_cap) for i in range(shorts)]
+    reqs += [("long request %d" % i, long_cap) for i in range(longs)]
+    rng = np.random.default_rng(seed)
+    rng.shuffle(reqs)
+    return reqs
+
+
+def run(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--shorts", type=int, default=10)
+    ap.add_argument("--longs", type=int, default=4)
+    ap.add_argument("--short-cap", type=int, default=4)
+    ap.add_argument("--long-cap", type=int, default=24)
+    ap.add_argument("--num-slots", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-ctx", type=int, default=512)
+    ap.add_argument("--json", default=None, help="also write results here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve.api_server import Batcher
+    from oryx_tpu.serve.pipeline import OryxInference
+    from oryx_tpu.serve.scheduler import ContinuousScheduler
+    from oryx_tpu.utils.metrics import ServingMetrics
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_CharTokenizer(), params, cfg)
+    reqs = _workload(args.shorts, args.longs, args.short_cap, args.long_cap)
+
+    # ---- window batcher (the legacy engine) -----------------------------
+    wm = ServingMetrics()
+    batcher = Batcher(
+        pipe, window=0.2, max_batch=args.num_slots, metrics=wm
+    )
+    pending = [
+        batcher.submit({"question": q}, cap) for q, cap in reqs
+    ]
+    for p in pending:
+        assert p.done.wait(timeout=600)
+        assert p.error is None, p.error
+    w_total = wm.get("decode_steps_total")
+    w_wasted = wm.get("decode_steps_wasted")
+
+    # ---- continuous scheduler -------------------------------------------
+    sm = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=args.num_slots, page_size=args.page_size,
+        chunk=args.chunk, max_ctx=args.max_ctx, metrics=sm,
+        autostart=False,
+    )
+    handles = [sched.submit({"question": q}, cap) for q, cap in reqs]
+    sched.start()
+    for h in handles:
+        h.result(timeout=600)
+    sched.close()
+    s_total = sm.get("decode_steps_total")
+    s_wasted = sm.get("decode_steps_wasted")
+
+    w_frac = w_wasted / max(w_total, 1)
+    s_frac = s_wasted / max(s_total, 1)
+    out = {
+        "workload": {
+            "shorts": args.shorts, "longs": args.longs,
+            "short_cap": args.short_cap, "long_cap": args.long_cap,
+        },
+        "window": {
+            "decode_steps_total": w_total,
+            "decode_steps_wasted": w_wasted,
+            "wasted_frac": w_frac,
+        },
+        "scheduler": {
+            "decode_steps_total": s_total,
+            "decode_steps_wasted": s_wasted,
+            "wasted_frac": s_frac,
+            "slot_occupancy_final": sm.get("slot_occupancy"),
+            "step_utilization": sm.get("decode_step_utilization"),
+            "chunks": sm.get("chunks"),
+            "admitted": sm.get("admitted"),
+            "evicted": sm.get("evicted"),
+        },
+        "wasted_frac_ratio": w_frac / max(s_frac, 1e-9),
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run(sys.argv[1:]), indent=2))

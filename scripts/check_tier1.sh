@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# One-command local tier-1 gate: runs the ROADMAP "Tier-1 verify"
+# command VERBATIM (in a subshell, so its trailing `exit $rc` is its
+# own exit code), then fails on any regression vs the recorded
+# DOTS_PASSED baseline below.
+#
+# Bump BASELINE_DOTS deliberately when green tests are ADDED; never
+# lower it to paper over a regression. Override for experiments with
+# ORYX_TIER1_BASELINE=<n>.
+set -u
+cd "$(dirname "$0")/.."
+
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-274}
+
+# --- ROADMAP.md "Tier-1 verify", verbatim -----------------------------------
+bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
+rc=$?
+# ----------------------------------------------------------------------------
+
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+echo "tier-1: $dots passed (baseline $BASELINE_DOTS, pytest rc=$rc)"
+if [ "$dots" -lt "$BASELINE_DOTS" ]; then
+    echo "TIER-1 REGRESSION: $dots < baseline $BASELINE_DOTS" >&2
+    exit 1
+fi
+echo "tier-1 OK: no regression vs recorded baseline"

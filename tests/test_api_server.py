@@ -544,6 +544,158 @@ def test_server_rejects_excessive_max_tokens(server):
         assert e.code == 400
 
 
+@pytest.fixture(scope="module")
+def continuous_server():
+    """Server on the continuous-batching engine (paged KV scheduler)."""
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512,
+    )
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", pipe
+    srv.scheduler.close()
+    srv.shutdown()
+
+
+def test_continuous_server_matches_pipeline(continuous_server):
+    """Non-streaming and streaming through the scheduler both return
+    exactly the solo pipeline reply, with real usage accounting."""
+    url, pipe = continuous_server
+    ref = pipe.chat("hello there", max_new_tokens=5)
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5,
+    }) as r:
+        out = json.load(r)
+    assert out["choices"][0]["message"]["content"] == ref
+    assert out["choices"][0]["finish_reason"] == "length"
+    u = out["usage"]
+    assert u["prompt_tokens"] > 0 and u["completion_tokens"] == 5
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5, "stream": True,
+        "stream_options": {"include_usage": True},
+    }) as r:
+        raw = r.read().decode()
+    assert raw.strip().endswith("data: [DONE]")
+    chunks = [
+        json.loads(l[6:]) for l in raw.splitlines()
+        if l.startswith("data: ") and l != "data: [DONE]"
+    ]
+    deltas = "".join(
+        c["choices"][0]["delta"].get("content") or ""
+        for c in chunks if c.get("choices")
+    )
+    assert deltas == ref
+    with_usage = [c for c in chunks if c.get("usage")]
+    assert len(with_usage) == 1
+    assert with_usage[0]["usage"]["completion_tokens"] == 5
+
+
+def _parse_prometheus(text: str) -> dict[str, float]:
+    """Well-formedness check + name->value map (labels folded in)."""
+    import re
+
+    values = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE \S+ (counter|gauge|histogram)$",
+                            line), line
+            continue
+        m = re.match(r"^([a-zA-Z_:][\w:]*)(\{[^}]*\})? (-?[\d.e+-]+|inf)$",
+                     line)
+        assert m, f"malformed metrics line: {line!r}"
+        values[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return values
+
+
+def test_metrics_endpoint_under_concurrent_load(continuous_server):
+    """VERDICT-style load test: >= 5 simultaneous clients (streaming +
+    non-streaming) through the scheduler, then GET /metrics must return
+    well-formed Prometheus text with the serving counters/histograms."""
+    url, pipe = continuous_server
+    qs = [("hello there", 4), ("what now?", 6), ("tell me more", 5),
+          ("and then?", 4)]
+    stream_qs = [("say something", 5)]
+    errors: list[str] = []
+
+    def nonstream(q, c):
+        try:
+            with _post(url, {
+                "max_tokens": c,
+                "messages": [{"role": "user", "content": q}],
+            }) as resp:
+                json.load(resp)
+        except Exception as e:
+            errors.append(f"{q}: {e!r}")
+
+    def stream(q, c):
+        try:
+            with _post(url, {
+                "max_tokens": c, "stream": True,
+                "messages": [{"role": "user", "content": q}],
+            }) as resp:
+                resp.read()
+        except Exception as e:
+            errors.append(f"{q}: {e!r}")
+
+    threads = [
+        threading.Thread(target=nonstream, args=qc) for qc in qs
+    ] + [threading.Thread(target=stream, args=qc) for qc in stream_qs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not any(t.is_alive() for t in threads), "client hung"
+    assert not errors, errors
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    values = _parse_prometheus(text)
+    assert values["oryx_serving_admitted"] >= 5
+    assert values["oryx_serving_completed"] >= 5
+    assert "oryx_serving_slot_occupancy" in values
+    assert "oryx_serving_queue_depth" in values
+    assert values["oryx_serving_ttft_seconds_count"] >= 5
+    assert values["oryx_serving_time_per_output_token_seconds_count"] > 0
+    # Histogram buckets are cumulative and end at the total count.
+    ttft_inf = values['oryx_serving_ttft_seconds_bucket{le="+Inf"}']
+    assert ttft_inf == values["oryx_serving_ttft_seconds_count"]
+    # Wasted + useful partition the total.
+    assert (
+        values["oryx_serving_decode_steps_useful"]
+        + values["oryx_serving_decode_steps_wasted"]
+        == values["oryx_serving_decode_steps_total"]
+    )
+
+
+def test_window_engine_metrics_endpoint(server):
+    """The legacy window engine exports /metrics too (queue depth +
+    batch accounting)."""
+    url, _ = server
+    # Ensure at least one request has flowed through the batcher.
+    with _post(url, {
+        "max_tokens": 3,
+        "messages": [{"role": "user", "content": "ping"}],
+    }) as r:
+        json.load(r)
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    values = _parse_prometheus(text)
+    assert values["oryx_serving_completed"] >= 1
+    assert "oryx_serving_queue_depth" in values
+    assert values["oryx_serving_decode_steps_total"] > 0
+
+
 def test_server_concurrent_mixed_clients(server):
     """VERDICT r4 weak-6: >=8 genuinely simultaneous HTTP clients —
     mixed stream/non-stream, mixed text/image — through the

@@ -1,0 +1,363 @@
+"""Paged KV cache + chunked decode: allocator behavior, ragged decode
+attention (XLA reference and Pallas twin), and greedy bit-parity between
+the dense `_decode_while` path and the paged chunked path — with and
+without prefix KV reuse."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import generate as gen_lib
+from oryx_tpu.models import qwen2
+from oryx_tpu.ops import attention as att_lib
+from oryx_tpu.ops import paged_kv
+
+
+# ---------------------------------------------------------------------------
+# Allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_recycle():
+    a = paged_kv.PageAllocator(4, 8)
+    assert a.num_free == 4 and a.sentinel == 4
+    p1 = a.alloc(2)
+    p2 = a.alloc(2)
+    assert sorted(p1 + p2) == [0, 1, 2, 3]
+    assert a.num_free == 0
+    with pytest.raises(paged_kv.OutOfPagesError):
+        a.alloc(1)
+    a.free(p1)
+    # LIFO recycling: freshly freed pages come back first.
+    assert a.alloc(2) == p1
+    a.free(p1)
+    a.free(p2)
+    assert a.num_free == 4
+    with pytest.raises(ValueError):
+        a.free(p2)  # double free
+    assert a.pages_for(0) == 0
+    assert a.pages_for(1) == 1
+    assert a.pages_for(8) == 1
+    assert a.pages_for(9) == 2
+
+
+def test_allocator_all_or_nothing():
+    a = paged_kv.PageAllocator(3, 4)
+    a.alloc(2)
+    with pytest.raises(paged_kv.OutOfPagesError):
+        a.alloc(2)
+    assert a.num_free == 1  # the failed alloc leaked nothing
+
+
+# ---------------------------------------------------------------------------
+# Page I/O + ragged attention vs the dense reference
+# ---------------------------------------------------------------------------
+
+
+def _ragged_fixture(seed=0, B=3, Hq=4, Hk=2, D=16, ps=8, maxp=4, P=16):
+    """Pages + block tables + an equivalent dense [B, K, Hk, D] view."""
+    rng = np.random.default_rng(seed)
+    lengths = np.array([5, 17, maxp * ps], np.int32)[:B]
+    alloc = paged_kv.PageAllocator(P, ps)
+    bt = np.full((B, maxp), alloc.sentinel, np.int32)
+    k_pool = rng.standard_normal((P, ps, Hk, D)).astype(np.float32)
+    v_pool = rng.standard_normal((P, ps, Hk, D)).astype(np.float32)
+    K = maxp * ps
+    k_dense = np.zeros((B, K, Hk, D), np.float32)
+    v_dense = np.zeros((B, K, Hk, D), np.float32)
+    for b in range(B):
+        pages = alloc.alloc(alloc.pages_for(int(lengths[b])))
+        bt[b, : len(pages)] = pages
+        for s in range(int(lengths[b])):
+            k_dense[b, s] = k_pool[pages[s // ps], s % ps]
+            v_dense[b, s] = v_pool[pages[s // ps], s % ps]
+    q = rng.standard_normal((B, 1, Hq, D)).astype(np.float32)
+    return q, k_pool, v_pool, bt, lengths, k_dense, v_dense
+
+
+def test_ragged_decode_attention_matches_dense():
+    q, kp, vp, bt, lengths, kd, vd = _ragged_fixture()
+    K = kd.shape[1]
+    kv_mask = (np.arange(K)[None] < lengths[:, None]).astype(np.int32)
+    ref = att_lib.attention(
+        jnp.asarray(q), jnp.asarray(kd), jnp.asarray(vd), causal=True,
+        q_positions=jnp.asarray(lengths - 1)[:, None],
+        kv_mask=jnp.asarray(kv_mask),
+    )
+    got = paged_kv.ragged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(lengths),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_pallas_paged_decode_matches_reference():
+    from oryx_tpu.ops.pallas import paged_attention as ppa
+
+    q, kp, vp, bt, lengths, _, _ = _ragged_fixture(seed=3)
+    ref = paged_kv.ragged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(lengths),
+    )
+    got = ppa.ragged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(lengths),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-6, rtol=2e-6
+    )
+
+
+def test_write_pages_masks_and_sentinels():
+    rng = np.random.default_rng(1)
+    P, ps, Hk, D = 4, 4, 2, 8
+    alloc = paged_kv.PageAllocator(P, ps)
+    bt = np.full((2, 2), alloc.sentinel, np.int32)
+    bt[0, :2] = alloc.alloc(2)
+    bt[1, :1] = alloc.alloc(1)  # row 1 holds ONE page: slots >= 4 drop
+    pool = jnp.zeros((P, ps, Hk, D), jnp.float32)
+    new = jnp.asarray(rng.standard_normal((2, 3, Hk, D)), jnp.float32)
+    out = paged_kv.write_pages(
+        pool, new, jnp.asarray(bt), jnp.asarray([2, 3], jnp.int32)
+    )
+    g = paged_kv.gather_pages(out, jnp.asarray(bt))
+    # Row 0: slots 2..4 all covered.
+    np.testing.assert_array_equal(np.asarray(g)[0, 2:5], np.asarray(new)[0])
+    # Row 1: slot 3 lands, slots 4..5 routed through the sentinel drop.
+    np.testing.assert_array_equal(np.asarray(g)[1, 3], np.asarray(new)[1, 0])
+    untouched = [p for p in range(P) if p not in list(bt[0]) + list(bt[1])]
+    for p in untouched:
+        np.testing.assert_array_equal(np.asarray(out)[p], 0.0)
+    # write_mask False rows drop everything.
+    out2 = paged_kv.write_pages(
+        out, new * 7, jnp.asarray(bt), jnp.asarray([2, 3], jnp.int32),
+        write_mask=jnp.asarray([False, False]),
+    )
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out))
+
+
+# ---------------------------------------------------------------------------
+# Greedy parity: dense while-loop decode vs paged chunked decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_llm():
+    cfg = cfg_lib.tiny_llm(vocab_size=128)
+    params = qwen2.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _embed(params, ids):
+    return params["embed"]["weight"][jnp.asarray(ids)]
+
+
+def test_paged_greedy_parity_mixed_lengths(tiny_llm):
+    cfg, params = tiny_llm
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(0)
+    B, Tb, max_new, cache_len = 3, 16, 12, 32
+    lengths = np.array([5, 11, 16], np.int32)
+    ids = rng.integers(1, 128, size=(B, Tb)).astype(np.int32)
+    toks, num, fin = gen_lib.generate(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids),
+        lengths=jnp.asarray(lengths), max_new_tokens=max_new,
+        cache_len=cache_len,
+    )
+    # kv_capacity == the dense cache_len: identical fp32 reductions,
+    # masked kv columns contribute exact zeros either way → BIT parity.
+    ptoks, pnum, pfin = gen_lib.generate_paged(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids),
+        lengths=lengths, max_new_tokens=max_new, page_size=8, chunk=4,
+        kv_capacity=cache_len,
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ptoks))
+    np.testing.assert_array_equal(np.asarray(num), np.asarray(pnum))
+    np.testing.assert_array_equal(np.asarray(fin), np.asarray(pfin))
+
+
+def test_paged_greedy_parity_with_stop_sequences(tiny_llm):
+    """Stop-sequence rows must freeze identically on both paths: run
+    dense once, turn its second emitted token into a stop sequence, and
+    demand bit-equal tokens AND finish accounting."""
+    cfg, params = tiny_llm
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(2)
+    B, Tb, max_new, cache_len = 2, 16, 12, 32
+    lengths = np.array([9, 14], np.int32)
+    ids = rng.integers(1, 128, size=(B, Tb)).astype(np.int32)
+    toks, _, _ = gen_lib.generate(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids),
+        lengths=jnp.asarray(lengths), max_new_tokens=max_new,
+        cache_len=cache_len,
+    )
+    stop = np.full((1, 4), -1, np.int32)
+    stop[0, -1] = int(np.asarray(toks)[0, 1])  # fires early on row 0
+    stop = jnp.asarray(stop)
+    args = dict(
+        inputs_embeds=_embed(params, ids), max_new_tokens=max_new,
+        stop_sequences=stop,
+    )
+    toks, num, fin = gen_lib.generate(
+        params, cfg, gcfg, lengths=jnp.asarray(lengths),
+        cache_len=cache_len, **args,
+    )
+    ptoks, pnum, pfin = gen_lib.generate_paged(
+        params, cfg, gcfg, lengths=lengths, page_size=8, chunk=4,
+        kv_capacity=cache_len, **args,
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ptoks))
+    np.testing.assert_array_equal(np.asarray(num), np.asarray(pnum))
+    np.testing.assert_array_equal(np.asarray(fin), np.asarray(pfin))
+    assert bool(np.asarray(fin)[0])  # the stop actually fired
+
+
+def test_paged_greedy_parity_prefix_reuse(tiny_llm):
+    """Two-turn conversation: turn 2 prefills only the suffix against
+    the turn-1 KV (dense kv_cache/start vs paged state/start) — token
+    ids must stay bit-identical."""
+    cfg, params = tiny_llm
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(1)
+    max_new, cache_len = 8, 64
+    ids1 = rng.integers(1, 128, size=(1, 16)).astype(np.int32)
+    L1 = 9
+    t1, n1, _, cache = gen_lib.generate(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids1),
+        lengths=jnp.asarray([L1], np.int32), max_new_tokens=max_new,
+        cache_len=cache_len, return_cache=True,
+    )
+    pt1, pn1, _, state = gen_lib.generate_paged(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids1),
+        lengths=np.asarray([L1]), max_new_tokens=max_new, page_size=8,
+        chunk=4, kv_capacity=cache_len, num_pages=8, return_state=True,
+    )
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(pt1))
+    # Turn 2: keep prompt + generated KV, append a 6-token suffix.
+    common = L1 + int(np.asarray(n1)[0])
+    suf = rng.integers(1, 128, size=(1, 8)).astype(np.int32)
+    L2 = common + 6
+    t2, n2, f2 = gen_lib.generate(
+        params, cfg, gcfg, inputs_embeds=_embed(params, suf),
+        lengths=jnp.asarray([L2], np.int32), max_new_tokens=max_new,
+        cache_len=cache_len, kv_cache=cache,
+        start=jnp.asarray(common, jnp.int32),
+    )
+    pt2, pn2, pf2 = gen_lib.generate_paged(
+        params, cfg, gcfg, inputs_embeds=_embed(params, suf),
+        lengths=np.asarray([L2]), max_new_tokens=max_new, page_size=8,
+        chunk=4, kv_capacity=cache_len, state=state,
+        start=np.asarray([common]),
+    )
+    np.testing.assert_array_equal(np.asarray(t2), np.asarray(pt2))
+    np.testing.assert_array_equal(np.asarray(n2), np.asarray(pn2))
+    np.testing.assert_array_equal(np.asarray(f2), np.asarray(pf2))
+
+
+def test_generate_paged_ragged_pool_sizing(tiny_llm):
+    """The default pool is the exact ragged need — a short row costs its
+    own pages, not the batch max (the perf claim behind the change)."""
+    cfg, params = tiny_llm
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(4)
+    ids = rng.integers(1, 128, size=(2, 32)).astype(np.int32)
+    lengths = np.array([4, 32], np.int32)
+    _, _, _, state = gen_lib.generate_paged(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids),
+        lengths=lengths, max_new_tokens=8, page_size=8, chunk=8,
+        kv_capacity=64, return_state=True,
+    )
+    # ceil((4+8)/8)=2 + ceil((32+8)/8)=5 pages, vs 2*8 for dense capacity.
+    assert state.allocator.num_pages == 7
+    assert state.allocator.num_free == 0
+
+
+def test_paged_decode_pallas_matches_xla(tiny_llm):
+    """The chunked decode with attn_impl=pallas (in-place page reads via
+    the Pallas kernel, interpret mode on CPU) emits the same greedy
+    tokens as the gather-based XLA reference path."""
+    cfg, params = tiny_llm
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(1, 128, size=(2, 16)).astype(np.int32)
+    lengths = np.array([7, 13], np.int32)
+    common = dict(
+        inputs_embeds=_embed(params, ids), lengths=lengths,
+        max_new_tokens=6, page_size=8, chunk=2, kv_capacity=32,
+    )
+    xt, xn, xf = gen_lib.generate_paged(
+        params, cfg, gcfg, attn_impl="xla", **common
+    )
+    pt, pn, pf = gen_lib.generate_paged(
+        params, cfg, gcfg, attn_impl="pallas", **common
+    )
+    np.testing.assert_array_equal(np.asarray(xt), np.asarray(pt))
+    np.testing.assert_array_equal(np.asarray(xn), np.asarray(pn))
+
+
+# ---------------------------------------------------------------------------
+# Samplers
+# ---------------------------------------------------------------------------
+
+
+def test_sample_token_top_k_clamps_to_vocab():
+    """Regression: top_k >= vocab_size used to index out of range in
+    jnp.sort(logits)[:, -top_k]; it must behave as 'keep everything'."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    key = jax.random.key(0)
+    huge = gen_lib.sample_token(
+        logits, key, temperature=0.7, top_p=1.0, top_k=50
+    )
+    nofilter = gen_lib.sample_token(
+        logits, key, temperature=0.7, top_p=1.0, top_k=0
+    )
+    exact = gen_lib.sample_token(
+        logits, key, temperature=0.7, top_p=1.0, top_k=8
+    )
+    np.testing.assert_array_equal(np.asarray(huge), np.asarray(nofilter))
+    np.testing.assert_array_equal(np.asarray(huge), np.asarray(exact))
+
+
+def test_sample_token_rows_per_row_behavior():
+    rng = np.random.default_rng(0)
+    V = 16
+    logits = jnp.asarray(rng.standard_normal((3, V)), jnp.float32)
+    keys = jax.random.split(jax.random.key(1), 3)
+    # Row 0 greedy, row 1 heavily top-k-1 (=> argmax too), row 2 free.
+    out = gen_lib.sample_token_rows(
+        logits, keys,
+        temperature=jnp.asarray([0.0, 1.0, 1.0]),
+        top_p=jnp.asarray([1.0, 1.0, 1.0]),
+        top_k=jnp.asarray([0, 1, 0]),
+    )
+    assert int(out[0]) == int(jnp.argmax(logits[0]))
+    assert int(out[1]) == int(jnp.argmax(logits[1]))
+    assert 0 <= int(out[2]) < V
+    # A row's draw is independent of its neighbors: same row alone gives
+    # the same token (continuous-batching invariant).
+    solo = gen_lib.sample_token_rows(
+        logits[2:], keys[2:],
+        temperature=jnp.asarray([1.0]),
+        top_p=jnp.asarray([1.0]),
+        top_k=jnp.asarray([0]),
+    )
+    assert int(solo[0]) == int(out[2])
+    # top_k above V clamps rather than erroring.
+    clamped = gen_lib.sample_token_rows(
+        logits, keys,
+        temperature=jnp.asarray([1.0, 1.0, 1.0]),
+        top_p=jnp.asarray([1.0, 1.0, 1.0]),
+        top_k=jnp.asarray([V + 50, V + 50, V + 50]),
+    )
+    unfiltered = gen_lib.sample_token_rows(
+        logits, keys,
+        temperature=jnp.asarray([1.0, 1.0, 1.0]),
+        top_p=jnp.asarray([1.0, 1.0, 1.0]),
+        top_k=jnp.asarray([0, 0, 0]),
+    )
+    np.testing.assert_array_equal(np.asarray(clamped),
+                                  np.asarray(unfiltered))
