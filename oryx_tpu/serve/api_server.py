@@ -11,6 +11,11 @@ web-framework dependency.
      "max_tokens": 64, "stream": false}
   GET /v1/models
   GET /healthz
+  GET /metrics          (Prometheus text format, build_info gauge)
+  GET /debug/requests   (flight recorder: last N requests, in-flight too)
+  GET /debug/trace?id=  (one request's span tree as Chrome trace JSON —
+                         loads in Perfetto; id from the X-Request-Id
+                         header every response carries)
 
 Content may be a plain string or OpenAI content-part lists; image parts
 (`{"type": "image_url", "image_url": {"url": "data:image/...;base64,..."
@@ -41,14 +46,34 @@ import argparse
 import base64
 import io
 import json
+import os
 import queue
+import subprocess
 import threading
 import time
+import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 import numpy as np
+
+from oryx_tpu.utils import trace as trace_lib
+
+
+def _git_revision() -> str:
+    """Best-effort build identity for the build_info metric: git HEAD
+    of the source tree, or ORYX_GIT_REV when deployed from an export."""
+    if rev := os.environ.get("ORYX_GIT_REV"):
+        return rev
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
 
 
 def _decode_image(url: str, *, allow_local_files: bool) -> np.ndarray:
@@ -164,6 +189,7 @@ class _Pending:
     def __init__(
         self, request: dict[str, Any], max_new: int,
         sampling: dict[str, Any] | None = None,
+        trace: trace_lib.Trace | None = None,
     ):
         self.request = request
         self.max_new = max_new
@@ -175,6 +201,9 @@ class _Pending:
         self.finish_reason: str = "stop"
         self.usage: tuple[int, int] | None = None
         self.error: str | None = None
+        self.trace = trace
+        self.request_id = trace.id if trace else trace_lib.new_request_id()
+        self._qw = trace.begin("queue_wait") if trace else -1
 
     @property
     def batch_key(self) -> tuple:
@@ -211,6 +240,7 @@ class Batcher:
         max_batch: int = 8,
         device_lock: threading.Lock | None = None,
         metrics=None,
+        tracer: trace_lib.Tracer | None = None,
     ):
         from oryx_tpu.utils.metrics import ServingMetrics
 
@@ -219,6 +249,10 @@ class Batcher:
         self.max_batch = max_batch
         self.device_lock = device_lock or threading.Lock()
         self.metrics = metrics or ServingMetrics()
+        # Same span vocabulary as the continuous scheduler (queue_wait /
+        # decode / emission in one "decode" window here), so /debug
+        # traces from both engines are directly comparable.
+        self.tracer = tracer or trace_lib.Tracer()
         self.q: queue.Queue[_Pending] = queue.Queue()
         # A request popped from the queue whose max_tokens mismatched the
         # group in flight; it LEADS the next group (FIFO — re-queueing to
@@ -231,7 +265,10 @@ class Batcher:
         self, request: dict[str, Any], max_new: int,
         sampling: dict[str, Any] | None = None,
     ) -> _Pending:
-        p = _Pending(request, max_new, sampling)
+        tr = self.tracer.start_trace(
+            "request", label=f"chat max_new={max_new}"
+        )
+        p = _Pending(request, max_new, sampling, trace=tr)
         self.q.put(p)
         return p
 
@@ -255,6 +292,10 @@ class Batcher:
                     break
                 group.append(nxt)
             s = first.sampling
+            for p in group:
+                if p.trace is not None:
+                    p.trace.end(p._qw)
+            t0_ns = trace_lib.now_ns()
             try:
                 with self.device_lock:
                     replies, reasons, counts = self.pipe.chat_batch(
@@ -270,6 +311,20 @@ class Batcher:
                     )
                 for p, r, why, use in zip(group, replies, reasons, counts):
                     p.reply, p.finish_reason, p.usage = r, why, use
+                    if p.trace is not None:
+                        # One shared window-batch decode: the whole
+                        # group's device call lands on each member, the
+                        # parity view of the scheduler's decode_chunk.
+                        p.trace.add_complete(
+                            "decode", t0_ns,
+                            batch_size=len(group),
+                            bucket=_decode_bucket(first.max_new),
+                        )
+                        p.trace.finish(
+                            finish_reason=why,
+                            prompt_tokens=use[0],
+                            completion_tokens=use[1],
+                        )
                 # Wasted-step accounting (scripts/bench_serving_sched.py
                 # compares this against the continuous scheduler): the
                 # whole group decodes the BUCKET length; a row's useful
@@ -285,6 +340,8 @@ class Batcher:
             except Exception as e:  # surface per-request, keep serving
                 for p in group:
                     p.error = f"{type(e).__name__}: {e}"
+                    if p.trace is not None:
+                        p.trace.finish(error=p.error)
             for p in group:
                 p.done.set()
             self.metrics.set_gauge("queue_depth", self.q.qsize())
@@ -330,9 +387,13 @@ def _parse_sampling(req: dict[str, Any]) -> dict[str, Any]:
 def _completion_body(
     model: str, reply: str, finish_reason: str = "stop",
     usage: tuple[int, int] | None = None,
+    request_id: str | None = None,
 ) -> dict[str, Any]:
     body = {
-        "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        # The completion id embeds the server-side request id, so a
+        # client log line can be joined to /debug/trace without the
+        # header plumbing.
+        "id": f"chatcmpl-{request_id or uuid.uuid4().hex[:24]}",
         "object": "chat.completion",
         "created": int(time.time()),
         "model": model,
@@ -393,6 +454,8 @@ def build_server(
     page_size: int = 64,
     decode_chunk: int = 8,
     max_ctx: int = 2048,
+    stall_timeout: float | None = None,
+    flight_recorder_size: int = 256,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline.
 
@@ -406,6 +469,15 @@ def build_server(
     from oryx_tpu.utils.metrics import ServingMetrics
 
     metrics = ServingMetrics()
+    metrics.set_info("build_info", {
+        "revision": _git_revision(), "engine": engine,
+        "model": model_name,
+    })
+    # One flight recorder for the whole server: the last
+    # `flight_recorder_size` requests — in-flight and finished — served
+    # by GET /debug/requests, with per-request span trees (queue-wait →
+    # prefill → decode chunks → emission) at GET /debug/trace?id=.
+    tracer = trace_lib.Tracer(flight_recorder_size)
     # chat_stream is not thread-safe against itself or chat_batch (one
     # device, one program at a time) — streaming requests serialize with
     # each other and with the batcher through this lock. (Continuous
@@ -418,11 +490,12 @@ def build_server(
         scheduler = ContinuousScheduler(
             pipe, num_slots=num_slots, page_size=page_size,
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
+            tracer=tracer, stall_timeout=stall_timeout,
         )
     elif engine == "window":
         batcher = Batcher(
             pipe, window=batch_window, max_batch=max_batch,
-            device_lock=stream_lock, metrics=metrics,
+            device_lock=stream_lock, metrics=metrics, tracer=tracer,
         )
     else:
         raise ValueError(f"unknown engine {engine!r} (window|continuous)")
@@ -431,17 +504,48 @@ def build_server(
         def log_message(self, *a):  # quiet access log
             pass
 
-        def _json(self, code: int, body: dict[str, Any]) -> None:
+        def _json(self, code: int, body: dict[str, Any],
+                  request_id: str | None = None) -> None:
             data = json.dumps(body).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if request_id:
+                self.send_header("X-Request-Id", request_id)
             self.end_headers()
             self.wfile.write(data)
 
         def do_GET(self):
             if self.path == "/healthz":
                 self._json(200, {"status": "ok"})
+            elif self.path == "/debug/requests":
+                # Flight recorder: newest-first summaries of the last N
+                # requests (in-flight included).
+                self._json(200, {
+                    "engine": engine,
+                    "requests": tracer.snapshot(),
+                })
+            elif self.path.startswith("/debug/trace"):
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                rid = (q.get("id") or [""])[0]
+                if not rid:
+                    self._json(400, {"error": "missing ?id=<request id>"})
+                    return
+                tr = tracer.get(rid)
+                if tr is None:
+                    self._json(404, {
+                        "error": f"no trace for id {rid!r} (the flight "
+                        "recorder keeps the last "
+                        f"{tracer.capacity} requests)"
+                    })
+                    return
+                # Chrome trace-event JSON: loads directly in Perfetto /
+                # chrome://tracing; also carries the raw summary.
+                body = tracer.chrome_trace([tr])
+                body["request"] = tr.summary()
+                self._json(200, body, request_id=rid)
             elif self.path == "/metrics":
                 if batcher is not None:
                     metrics.set_gauge("queue_depth", batcher.q.qsize())
@@ -547,6 +651,12 @@ def build_server(
                     (req.get("stream_options") or {}).get("include_usage")
                 )
                 usage: dict[str, int] = {}
+                # Solo streams bypass the Batcher, so they get their own
+                # flight-recorder entry; activate() propagates it into
+                # chat_stream's prefill / decode_chunk spans.
+                tr = tracer.start_trace(
+                    "request", label=f"stream max_new={max_new}"
+                )
 
                 def produce():
                     gen = pipe.chat_stream(
@@ -556,27 +666,40 @@ def build_server(
                         **sampling,
                     )
                     try:
-                        with stream_lock:
+                        with stream_lock, trace_lib.activate(tr):
                             while not gone.is_set():
                                 try:
                                     d = next(gen)
                                 except StopIteration as s:
                                     # Generator return value = reason.
-                                    put(("end", s.value or "stop"))
+                                    reason = s.value or "stop"
+                                    tr.finish(
+                                        finish_reason=reason,
+                                        **usage,
+                                    )
+                                    put(("end", reason))
                                     return
                                 if not put(("delta", d)):
+                                    tr.finish(cancelled=True)
                                     return
+                            # Client gone at the loop-top check: the
+                            # trace must still close, or the recorder
+                            # shows a forever-in-flight request.
+                            tr.finish(cancelled=True)
                     except Exception as e:
-                        put(("error", f"{type(e).__name__}: {e}"))
+                        msg = f"{type(e).__name__}: {e}"
+                        tr.finish(error=msg)
+                        put(("error", msg))
                     finally:
                         gen.close()
 
                 threading.Thread(target=produce, daemon=True).start()
-                cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+                cid = f"chatcmpl-{tr.id}"
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
+                    self.send_header("X-Request-Id", tr.id)
                     self.end_headers()
                     while True:
                         kind, payload = deltas.get()
@@ -621,12 +744,13 @@ def build_server(
             pending = batcher.submit(request_dict, max_new, sampling)
             pending.done.wait()
             if pending.error is not None:
-                self._json(500, {"error": {"message": pending.error}})
+                self._json(500, {"error": {"message": pending.error}},
+                           request_id=pending.request_id)
             else:
                 self._json(200, _completion_body(
                     model_name, pending.reply, pending.finish_reason,
-                    usage=pending.usage,
-                ))
+                    usage=pending.usage, request_id=pending.request_id,
+                ), request_id=pending.request_id)
 
         def _continuous(self, req, request_dict, max_new, sampling) -> None:
             """Route one request through the continuous-batching
@@ -638,6 +762,7 @@ def build_server(
                 request_dict, max_new, sampling,
                 streaming=bool(req.get("stream")),
             )
+            rid = handle.request_id
             if not req.get("stream"):
                 handle.done.wait()
                 if handle.error is not None:
@@ -648,25 +773,27 @@ def build_server(
                         self._json(400, {"error": {
                             "message": handle.error,
                             "type": "invalid_request_error",
-                        }})
+                        }}, request_id=rid)
                     else:
                         self._json(
-                            500, {"error": {"message": handle.error}}
+                            500, {"error": {"message": handle.error}},
+                            request_id=rid,
                         )
                 else:
                     self._json(200, _completion_body(
                         model_name, handle.reply, handle.finish_reason,
-                        usage=handle.usage,
-                    ))
+                        usage=handle.usage, request_id=rid,
+                    ), request_id=rid)
                 return
             want_usage = bool(
                 (req.get("stream_options") or {}).get("include_usage")
             )
-            cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+            cid = f"chatcmpl-{rid}"
             try:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 usage: tuple[int, int] | None = None
                 errored = False
@@ -717,6 +844,7 @@ def build_server(
     srv.metrics = metrics
     srv.scheduler = scheduler
     srv.batcher = batcher
+    srv.tracer = tracer
     return srv
 
 
@@ -753,6 +881,17 @@ def main(argv: list[str] | None = None) -> None:
         "--max-ctx", type=int, default=2048,
         help="continuous engine: per-request context ceiling "
         "(prompt + max_tokens; sizes the per-slot block table)",
+    )
+    ap.add_argument(
+        "--stall-timeout", type=float, default=120.0,
+        help="continuous engine: dump all thread stacks + the request "
+        "flight recorder to stderr when no decode chunk completes for "
+        "this many seconds (0 disables the watchdog)",
+    )
+    ap.add_argument(
+        "--flight-recorder-size", type=int, default=256,
+        help="how many recent requests GET /debug/requests retains "
+        "(span trees at GET /debug/trace?id=)",
     )
     ap.add_argument(
         "--allow-local-files", action="store_true",
@@ -796,6 +935,8 @@ def main(argv: list[str] | None = None) -> None:
         engine=args.engine, num_slots=args.num_slots,
         page_size=args.page_size, decode_chunk=args.decode_chunk,
         max_ctx=args.max_ctx,
+        stall_timeout=args.stall_timeout or None,
+        flight_recorder_size=args.flight_recorder_size,
     )
     print(f"serving {args.model_name} on http://{args.host}:{args.port}")
     srv.serve_forever()

@@ -33,6 +33,7 @@ from oryx_tpu.data import mm_utils
 from oryx_tpu.models import generate as generate_lib
 from oryx_tpu.models import oryx, qwen2, splice
 from oryx_tpu.ops import packing
+from oryx_tpu.utils import trace as trace_lib
 
 Params = dict[str, Any]
 
@@ -539,8 +540,12 @@ class OryxInference:
         padded_new = -(-max_new // chunk) * chunk
         kv_cache = start = flat = None
         media_key = ()
+        # Spans land on the context-active trace (the API server's
+        # flight recorder) and cost nothing outside one — the window
+        # engine's streams get the same prefill/decode_chunk/emission
+        # attribution as the continuous scheduler's requests.
         if cache_state is not None:
-            with self._mesh_scope():
+            with self._mesh_scope(), trace_lib.span("prefill", cached=True):
                 flat, L, common, embeds, kv_cache, cache_len, media_key = (
                     self._prefix_plan(
                         cache_state, cfg, ids, images, factors, caps,
@@ -550,7 +555,7 @@ class OryxInference:
             lengths = jnp.asarray([L], np.int32)
             start = jnp.asarray(common, jnp.int32)
         else:
-            with self._mesh_scope():
+            with self._mesh_scope(), trace_lib.span("prefill"):
                 embeds, L = self._prompt_embeds(
                     cfg, ids, images, factors, caps
                 )
@@ -600,8 +605,22 @@ class OryxInference:
                 media_key=media_key,
             )
 
+        def traced_blocks(gen):
+            """Time each device chunk (the window between successive
+            yields) as a decode_chunk span on the active trace."""
+            n = 0
+            while True:
+                t0 = trace_lib.now_ns()
+                try:
+                    b = next(gen)
+                except StopIteration:
+                    return
+                trace_lib.add_complete("decode_chunk", t0, chunk=n)
+                n += 1
+                yield b
+
         with self._mesh_scope():
-            for block in generate_lib.generate_stream(
+            for block in traced_blocks(generate_lib.generate_stream(
                 self.params["llm"], cfg.llm, cfg.generation,
                 inputs_embeds=embeds, lengths=lengths,
                 max_new_tokens=max_new, cache_len=cache_len, key=key,
@@ -610,9 +629,10 @@ class OryxInference:
                 stop_sequences=stop_seqs, chunk=chunk,
                 kv_cache=kv_cache, start=start,
                 yield_cache=cache_state is not None,
-            ):
+            )):
                 if cache_state is not None:
                     block, final_cache = block
+                t_emit = trace_lib.now_ns()
                 chunk_start = len(emitted)
                 for t in block[0]:
                     if int(t) == eos:
@@ -631,6 +651,7 @@ class OryxInference:
                     )
                 finished = finished or hit
                 safe = text.strip() if finished else stable_prefix(text)
+                trace_lib.add_complete("emission", t_emit, chars=len(safe))
                 if len(safe) > len(text_done):
                     yield safe[len(text_done):]
                     text_done = safe

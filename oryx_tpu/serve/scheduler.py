@@ -37,6 +37,7 @@ histograms, wasted vs useful decode steps.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
@@ -51,7 +52,13 @@ from oryx_tpu.models import generate as generate_lib
 from oryx_tpu.models import oryx, qwen2
 from oryx_tpu.ops import paged_kv
 from oryx_tpu.serve import pipeline as pipeline_lib
+from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.metrics import ServingMetrics, TTFT_BUCKETS
+
+# Every line carries the request id — grep one id end-to-end across
+# queue/admission/eviction/finish (same id as X-Request-Id and
+# /debug/trace).
+_LOG = logging.getLogger("oryx.serve.scheduler")
 
 
 class RequestHandle:
@@ -78,6 +85,10 @@ class RequestHandle:
         # only wait on `done` (set by submit(streaming=...)).
         self.streaming = False
         self.debug: dict[str, Any] = {}
+        # Observability: the id the API server returns as X-Request-Id
+        # and the span tree /debug/trace?id= serves.
+        self.request_id: str = ""
+        self.trace: trace_lib.Trace | None = None
 
     def result(self, timeout: float | None = None):
         """(reply, finish_reason, usage) or raises RuntimeError."""
@@ -108,6 +119,12 @@ class _Request:
     processed: int = 0  # tokens consumed from the device stream
     replay: int = 0  # tokens to skip after an eviction re-admission
     admit_seq: int = -1  # admission order (eviction picks the youngest)
+    # Span handles into `trace` for regions that outlive one method:
+    # queue_wait opens at submit (and again at eviction), admission
+    # opens when the request reaches the queue head. -1 = not open.
+    trace: trace_lib.Trace | None = None
+    qw_span: int = -1
+    adm_span: int = -1
 
 
 class ContinuousScheduler:
@@ -129,6 +146,8 @@ class ContinuousScheduler:
         metrics: ServingMetrics | None = None,
         seed: int = 0,
         autostart: bool = True,
+        tracer: trace_lib.Tracer | None = None,
+        stall_timeout: float | None = None,
     ):
         if max_ctx % page_size:
             raise ValueError(f"{max_ctx=} not a multiple of {page_size=}")
@@ -167,6 +186,17 @@ class ContinuousScheduler:
         self._shutdown = False
         self._admit_seq = 0
         self.chunks_run = 0
+        # Flight recorder of the last N requests (shared with the API
+        # server's /debug endpoints when it passes its own tracer) plus
+        # an optional stall watchdog: no decode chunk completing within
+        # stall_timeout while slots are live dumps every thread stack +
+        # the recorder tail to stderr, once per stall.
+        self.tracer = tracer or trace_lib.Tracer()
+        self.watchdog: trace_lib.StallWatchdog | None = None
+        if stall_timeout is not None:
+            self.watchdog = trace_lib.StallWatchdog(
+                self.tracer, stall_timeout, name="continuous-scheduler"
+            ).start()
         self._thread = threading.Thread(target=self._run, daemon=True)
         if autostart:
             self._thread.start()
@@ -191,10 +221,19 @@ class ContinuousScheduler:
         stops = (
             [self.pipe.conv.stop_str] if self.pipe.conv.stop_str else []
         ) + [s for s in (sampling.get("stop") or []) if s]
+        tr = self.tracer.start_trace(
+            "request", label=f"chat max_new={max_new}"
+        )
+        h.request_id = tr.id
+        h.trace = tr
+        h.debug["request_id"] = tr.id
         req = _Request(
             request=request, max_new=max_new, sampling=sampling,
             handle=h, submit_time=time.monotonic(), stops=stops,
+            trace=tr,
         )
+        req.qw_span = tr.begin("queue_wait")
+        _LOG.info("request %s queued (max_new=%d)", tr.id, max_new)
         with self._cond:
             self._queue.append(req)
             self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -207,6 +246,8 @@ class ContinuousScheduler:
             self._cond.notify()
         if self._thread.is_alive():
             self._thread.join(timeout=30)
+        if self.watchdog is not None:
+            self.watchdog.stop()
 
     # ---- slot bookkeeping ------------------------------------------------
 
@@ -272,8 +313,12 @@ class ContinuousScheduler:
                 if self._shutdown:
                     return
                 if not self._queue and all(r is None for r in self.slots):
+                    if self.watchdog is not None:
+                        self.watchdog.set_active(False)
                     self._cond.wait(timeout=0.1)
                     continue
+            if self.watchdog is not None:
+                self.watchdog.set_active(True)
             try:
                 self._admit()
                 if any(r is not None for r in self.slots):
@@ -290,6 +335,8 @@ class ContinuousScheduler:
                         r.handle.error = msg
                         r.handle.events.put(("error", msg))
                         r.handle.done.set()
+                        if r.trace is not None:
+                            r.trace.finish(error=msg)
                 # The failed dispatch may have CONSUMED the donated page
                 # pool (donate_argnames=kv_pages): rebuild it so the
                 # engine keeps serving new traffic instead of erroring
@@ -309,16 +356,27 @@ class ContinuousScheduler:
             if req.handle.cancelled:
                 with self._cond:
                     self._queue.popleft()
+                req.trace.finish(cancelled=True)
+                _LOG.info("request %s cancelled in queue", req.trace.id)
                 continue
             if req.embeds is None:
+                # The request reached the queue head: queue_wait ends,
+                # admission (prompt prep + validation + the wait for
+                # pages + prefill) begins.
+                req.trace.end(req.qw_span)
+                req.qw_span = -1
+                req.adm_span = req.trace.begin("admission")
                 try:
-                    ids, imgs, factors, caps = self.pipe._prepare_request(
-                        req.request
-                    )
-                    with self.pipe._mesh_scope():
-                        req.embeds, req.length = self.pipe._prompt_embeds(
-                            self.cfg, ids, imgs, factors, caps
+                    with req.trace.span("prompt_prep"):
+                        ids, imgs, factors, caps = (
+                            self.pipe._prepare_request(req.request)
                         )
+                        with self.pipe._mesh_scope():
+                            req.embeds, req.length = (
+                                self.pipe._prompt_embeds(
+                                    self.cfg, ids, imgs, factors, caps
+                                )
+                            )
                     s_ = req.sampling
                     req.temp = float(
                         s_.get("temperature", gen.temperature) or 0.0
@@ -349,6 +407,11 @@ class ContinuousScheduler:
                         req.handle.error_kind = "invalid_request"
                     req.handle.events.put(("error", msg))
                     req.handle.done.set()
+                    req.trace.finish(error=msg)
+                    _LOG.info(
+                        "request %s rejected at admission: %s",
+                        req.trace.id, msg,
+                    )
                     continue
             s = free[0]
             # Pages for the prompt plus the first chunk's writes. FIFO
@@ -367,6 +430,18 @@ class ContinuousScheduler:
         previous occupant's RNG state (that would make sampled streams
         depend on scheduling history, and break eviction replay)."""
         B1 = np.newaxis
+        # Close whichever wait span is open: first admission closes the
+        # "admission" span opened at the queue head; a re-admission
+        # after eviction closes the reopened "queue_wait".
+        if req.adm_span >= 0:
+            req.trace.end(req.adm_span)
+            req.adm_span = -1
+        if req.qw_span >= 0:
+            req.trace.end(req.qw_span)
+            req.qw_span = -1
+        pf = req.trace.begin(
+            "prefill", slot=s, tokens=req.length, replay=req.replay > 0
+        )
         with self.pipe._mesh_scope():
             kv, tok0, key = generate_lib.paged_prefill(
                 self.pipe.params["llm"], self.cfg.llm,
@@ -382,6 +457,17 @@ class ContinuousScheduler:
                 attn_impl=self.cfg.attn_impl,
                 compute_dtype=oryx.compute_dtype(self.cfg),
             )
+        req.trace.end(pf)
+        if self.watchdog is not None:
+            # A completed prefill is progress too — without this, a
+            # burst of admissions (each a full prompt prefill, possibly
+            # a compile) could out-wait the deadline with the engine
+            # perfectly healthy.
+            self.watchdog.beat()
+        _LOG.info(
+            "request %s %s slot=%d prompt=%d", req.trace.id,
+            "re-admitted" if req.replay else "admitted", s, req.length,
+        )
         self.kv_pages = kv
         self.slots[s] = req
         self.tok[s] = int(np.asarray(tok0)[0])
@@ -453,6 +539,12 @@ class ContinuousScheduler:
         req = self.slots[s]
         req.replay = req.processed
         self._clear_slot(s)
+        req.trace.event("evicted", slot=s, replay_tokens=req.processed)
+        req.qw_span = req.trace.begin("queue_wait", requeued=True)
+        _LOG.info(
+            "request %s evicted from slot %d (replay %d tokens)",
+            req.trace.id, s, req.processed,
+        )
         with self._cond:
             self._queue.appendleft(req)
             self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -461,6 +553,7 @@ class ContinuousScheduler:
 
     def _step_chunk(self) -> None:
         t0 = time.monotonic()
+        t0_ns = trace_lib.now_ns()
         with self.pipe._mesh_scope():
             (self.kv_pages, tok, lengths, finished, recent, self.keys,
              toks, fin) = generate_lib.paged_decode_chunk(
@@ -479,21 +572,35 @@ class ContinuousScheduler:
                 attn_impl=self.cfg.attn_impl,
                 compute_dtype=oryx.compute_dtype(self.cfg),
             )
-        dt = time.monotonic() - t0
+        # Host copies BLOCK on the device result — measure dt after
+        # them, or async dispatch makes the window (and the per-token
+        # histogram) cover only dispatch time, and the span<->xplane
+        # join would land the decode ops outside every window.
         self.tok = np.asarray(tok).copy()
         self.lengths = np.asarray(lengths).copy()
         self.finished = np.asarray(finished).copy()
         self.recent = np.asarray(recent).copy()
         toks, fin = np.asarray(toks), np.asarray(fin)
+        dt = time.monotonic() - t0
         self.chunks_run += 1
         self.metrics.inc("chunks")
         self.metrics.observe(
             "time_per_output_token_seconds", dt / max(1, self.chunk)
         )
+        if self.watchdog is not None:
+            self.watchdog.beat()
         useful = 0
         for s, req in enumerate(self.slots):
             if req is None:
                 continue
+            # The same device window lands on every live request: decode
+            # chunks are shared dispatches, and per-request attribution
+            # is exactly what makes occupancy problems visible in a
+            # single request's /debug/trace.
+            req.trace.add_complete(
+                "decode_chunk", t0_ns, int(dt * 1e9),
+                chunk=self.chunks_run, slot=s,
+            )
             useful += self._advance(s, [int(t) for t in toks[s]])
         total = self.num_slots * self.chunk
         self.metrics.inc("decode_steps_total", total)
@@ -530,6 +637,8 @@ class ContinuousScheduler:
         if req.handle.cancelled:
             self.metrics.inc("cancelled")
             self._clear_slot(s)
+            req.trace.finish(cancelled=True)
+            _LOG.info("request %s cancelled mid-decode", req.trace.id)
             return useful
         chunk_start = len(req.emitted)
         finish = None  # (reason, completion_count)
@@ -548,6 +657,7 @@ class ContinuousScheduler:
                 break
         if len(req.emitted) == chunk_start and finish is None:
             return useful  # pure replay skip: nothing new to decode
+        t_emit = trace_lib.now_ns()
         text = tokenizer.decode(req.emitted, skip_special_tokens=True)
         text, hit = pipeline_lib.stop_cut(text, req.stops)
         if hit:
@@ -564,10 +674,16 @@ class ContinuousScheduler:
             # withheld whitespace / a stop-string prefix) exactly as
             # chat_stream does on finish.
             self._emit_text(req, text.strip())
+            req.trace.add_complete(
+                "emission", t_emit, chars=len(req.text_done)
+            )
             self._finish(s, finish[0], completion=finish[1])
         else:
             self._emit_text(
                 req, pipeline_lib.stable_text_prefix(text, req.stops)
+            )
+            req.trace.add_complete(
+                "emission", t_emit, chars=len(req.text_done)
             )
         return useful
 
@@ -589,6 +705,14 @@ class ContinuousScheduler:
         req.handle.debug["finish_chunk"] = self.chunks_run
         req.handle.events.put(("end", reason, req.handle.usage))
         req.handle.done.set()
+        req.trace.finish(
+            finish_reason=reason, prompt_tokens=req.length,
+            completion_tokens=completion,
+        )
+        _LOG.info(
+            "request %s finished (%s, %d tokens)",
+            req.trace.id, reason, completion,
+        )
         self.metrics.inc("completed")
 
     def _finish_error(self, s: int, msg: str) -> None:
@@ -597,3 +721,5 @@ class ContinuousScheduler:
         req.handle.error = msg
         req.handle.events.put(("error", msg))
         req.handle.done.set()
+        req.trace.finish(error=msg)
+        _LOG.info("request %s errored: %s", req.trace.id, msg)

@@ -124,13 +124,18 @@ def train_step_fn(
     )
     accum = jax.tree.leaves(batch)[0].shape[0]
 
+    # named_scope: phase names land in the XLA op metadata, so xplane
+    # profiles (scripts/capture_trace.py) and the span<->device join can
+    # attribute device time to forward/backward vs optimizer — the
+    # device-side half of the trainer's host-side phase spans.
     if accum == 1:
         # No accumulation: skip the scan and its fp32 zeros buffer (a full
         # param-sized temp — ~17 GB/device for 34B on an 8-way mesh).
-        (loss_sum, metrics), grads = grad_fn(
-            state.params, cfg, jax.tree.map(lambda x: x[0], batch)
-        )
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        with jax.named_scope("forward_backward"):
+            (loss_sum, metrics), grads = grad_fn(
+                state.params, cfg, jax.tree.map(lambda x: x[0], batch)
+            )
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         ntok = metrics["num_tokens"]
     else:
         def one_micro(carry, mb):
@@ -143,19 +148,22 @@ def train_step_fn(
                 grads_acc, loss_acc + loss, ntok_acc + metrics["num_tokens"]
             ), metrics
 
-        zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-        )
-        (grads, loss_sum, ntok), _ = jax.lax.scan(
-            one_micro,
-            (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-            batch,
-        )
-        grads = jax.tree.map(lambda g: g / accum, grads)
+        with jax.named_scope("forward_backward_accum"):
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (grads, loss_sum, ntok), _ = jax.lax.scan(
+                one_micro,
+                (zeros, jnp.zeros((), jnp.float32),
+                 jnp.zeros((), jnp.int32)),
+                batch,
+            )
+            grads = jax.tree.map(lambda g: g / accum, grads)
 
-    updates, opt_state = tx.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    gnorm = optax.global_norm(grads)
+    with jax.named_scope("optimizer_update"):
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
     metrics = {
         "loss": loss_sum / accum,
         "grad_norm": gnorm,
@@ -167,17 +175,20 @@ def train_step_fn(
         # into params/moments). The update is computed regardless and
         # SELECTED against — a lax.cond would re-shard both branches'
         # state under GSPMD for no real saving, while the select fuses.
-        ok = jnp.isfinite(loss_sum) & jnp.isfinite(gnorm)
-        params = jax.tree.map(
-            lambda new, old: jnp.where(ok, new, old), params, state.params
-        )
-        opt_state = jax.tree.map(
-            lambda new, old: (
-                jnp.where(ok, new, old) if hasattr(new, "dtype") else new
-            ),
-            opt_state, state.opt_state,
-        )
-        metrics["skipped"] = (~ok).astype(jnp.int32)
+        with jax.named_scope("nonfinite_guard"):
+            ok = jnp.isfinite(loss_sum) & jnp.isfinite(gnorm)
+            params = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                params, state.params,
+            )
+            opt_state = jax.tree.map(
+                lambda new, old: (
+                    jnp.where(ok, new, old) if hasattr(new, "dtype")
+                    else new
+                ),
+                opt_state, state.opt_state,
+            )
+            metrics["skipped"] = (~ok).astype(jnp.int32)
     return (
         TrainState(step=state.step + 1, params=params, opt_state=opt_state),
         metrics,

@@ -21,8 +21,25 @@ from oryx_tpu.parallel import mesh as mesh_lib
 from oryx_tpu.parallel import sharding
 from oryx_tpu.train import step as step_lib
 from oryx_tpu.train.optimizer import make_optimizer
+from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.checkpoint import CheckpointManager
 from oryx_tpu.utils.metrics import MetricLogger, rank0_print
+
+
+def validate_train_batch(cfg: OryxConfig, batch: dict) -> None:
+    """Fail fast on config x data combinations that would otherwise die
+    deep inside jit tracing (or train silently wrong). Today: packed
+    text under ring attention — ring has no segment support
+    (docs/MIGRATING.md), so samples packed into one row would attend
+    across sample boundaries."""
+    if "text_segment_ids" in batch and cfg.attn_impl.startswith("ring"):
+        raise ValueError(
+            f"packed-text batches (text_segment_ids) cannot train under "
+            f"attn_impl={cfg.attn_impl!r}: ring attention has no "
+            "segment support, so packed samples would attend across "
+            "sample boundaries. Use attn_impl='xla'|'pallas' (sp=1) "
+            "or disable text packing (see docs/MIGRATING.md)."
+        )
 
 
 class Trainer:
@@ -34,6 +51,9 @@ class Trainer:
         sharding_mode: str = "fsdp",
         metrics_path: str | None = None,
         tensorboard_dir: str | None = None,
+        tracer: trace_lib.Tracer | None = None,
+        flight_recorder_size: int = 64,
+        stall_timeout: float | None = None,
     ) -> None:
         self.cfg = cfg
         self.mesh = mesh_lib.build_mesh(cfg.mesh)
@@ -43,6 +63,18 @@ class Trainer:
             tensorboard_dir=tensorboard_dir,
         )
         self.ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+        # Per-step flight recorder (same Trace/Span model as serving):
+        # each step records data / h2d / step_dispatch / device_sync /
+        # checkpoint_save spans, and the phase seconds also land in the
+        # MetricLogger record. stall_timeout arms a watchdog that dumps
+        # thread stacks + the recorder tail when no step completes in
+        # time (a hung collective, a wedged data loader, ...).
+        self.tracer = tracer or trace_lib.Tracer(flight_recorder_size)
+        self.watchdog: trace_lib.StallWatchdog | None = None
+        if stall_timeout is not None:
+            self.watchdog = trace_lib.StallWatchdog(
+                self.tracer, stall_timeout, name="trainer"
+            ).start()
 
         with sharding.mesh_scope(self.mesh):
             if params is None:
@@ -110,6 +142,16 @@ class Trainer:
                 out_shardings=(state_shardings, None),
             )
 
+    def close(self) -> None:
+        """Release background resources: the stall-watchdog thread (a
+        forever-polling daemon otherwise — N constructed Trainers would
+        leak N of them) and the metric writer. fit() can still be
+        called again before close()."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        self.logger.close()
+
     def resume_if_available(self) -> int:
         """Restore latest checkpoint if present; returns start step."""
         if self.ckpt.latest_step() is None:
@@ -175,24 +217,46 @@ class Trainer:
 
             batches = prefetcher = PrefetchIterator(batches, depth=prefetch)
         consecutive_skipped = 0
+        if self.watchdog is not None and start < num_steps:
+            self.watchdog.set_active(True)
         try:
             with sharding.mesh_scope(self.mesh):
                 for step_i in range(start, num_steps):
+                    tr = self.tracer.start_trace(
+                        "train_step", label=f"step {step_i + 1}"
+                    )
                     try:
-                        host_batch = next(batches)
+                        with tr.span("data") as sp_data:
+                            host_batch = next(batches)
                     except StopIteration:
+                        tr.finish(exhausted=True)
                         rank0_print("data exhausted; stopping")
                         break
-                    batch = self._device_batch(host_batch)
+                    validate_train_batch(cfg, host_batch)
+                    with tr.span("h2d"):
+                        batch = self._device_batch(host_batch)
                     # Must use self._step (out_shardings pinned): the plain
                     # step_lib.train_step jit lets GSPMD reshard zero2's
                     # replicated params to the fsdp opt-state spec after
                     # step 1 (see train_step_fn docstring).
-                    self.state, metrics = self._step(
-                        self.state, batch, cfg=cfg, tx=self.tx,
-                        sharding_mode=self.sharding_mode,
-                    )
-                    host_metrics = jax.device_get(metrics)
+                    with tr.span("step_dispatch") as sp_disp:
+                        self.state, metrics = self._step(
+                            self.state, batch, cfg=cfg, tx=self.tx,
+                            sharding_mode=self.sharding_mode,
+                        )
+                    # Async dispatch returns immediately; the sync span
+                    # is where the device actually runs the step (plus
+                    # the compile on step 1).
+                    with tr.span("device_sync") as sp_sync:
+                        host_metrics = jax.device_get(metrics)
+                    if self.watchdog is not None:
+                        self.watchdog.beat()
+                    # Phase seconds ride the metric record too, so the
+                    # JSONL/TensorBoard stream shows where a slow step
+                    # went without pulling the flight recorder.
+                    host_metrics["data_s"] = sp_data.dur_ns / 1e9
+                    host_metrics["dispatch_s"] = sp_disp.dur_ns / 1e9
+                    host_metrics["sync_s"] = sp_sync.dur_ns / 1e9
                     self.logger.log_step(step_i + 1, host_metrics)
                     if int(host_metrics.get("skipped", 0)):
                         consecutive_skipped += 1
@@ -211,8 +275,15 @@ class Trainer:
                     else:
                         consecutive_skipped = 0
                     if (step_i + 1) % cfg.train.checkpoint_every == 0:
-                        self.ckpt.save(step_i + 1, self.state)
+                        with tr.span("checkpoint_save"):
+                            self.ckpt.save(step_i + 1, self.state)
+                    tr.finish(
+                        step=step_i + 1,
+                        skipped=int(host_metrics.get("skipped", 0)),
+                    )
         finally:
+            if self.watchdog is not None:
+                self.watchdog.set_active(False)
             if prefetcher is not None:
                 prefetcher.close()
         final_step = int(jax.device_get(self.state.step))

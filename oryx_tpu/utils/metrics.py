@@ -150,6 +150,13 @@ class Histogram:
         out.append(f"{name}_count {self.total}")
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (
+        v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 # Default latency bucket ladders (seconds): TTFT spans prefill compiles;
 # per-token latency spans a decode step.
 TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -173,6 +180,10 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        # name -> label dict, rendered as a constant-1 gauge with the
+        # labels attached (the Prometheus "info metric" convention,
+        # e.g. oryx_serving_build_info{revision=...,engine=...} 1).
+        self._infos: dict[str, dict[str, str]] = {}
         self._hists: dict[str, Histogram] = {
             "ttft_seconds": Histogram(TTFT_BUCKETS),
             "time_per_output_token_seconds": Histogram(PER_TOKEN_BUCKETS),
@@ -185,6 +196,12 @@ class ServingMetrics:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
+
+    def set_info(self, name: str, labels: dict[str, str]) -> None:
+        """Info metric: a gauge pinned to 1 whose labels carry build /
+        deploy identity (git revision, engine, model)."""
+        with self._lock:
+            self._infos[name] = {str(k): str(v) for k, v in labels.items()}
 
     def observe(self, name: str, value: float,
                 buckets: tuple[float, ...] = PER_TOKEN_BUCKETS) -> None:
@@ -214,6 +231,14 @@ class ServingMetrics:
                 full = f"{self.prefix}_{name}"
                 out.append(f"# TYPE {full} gauge")
                 out.append(f"{full} {self._gauges[name]:.17g}")
+            for name in sorted(self._infos):
+                full = f"{self.prefix}_{name}"
+                labels = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(self._infos[name].items())
+                )
+                out.append(f"# TYPE {full} gauge")
+                out.append(f"{full}{{{labels}}} 1")
             for name in sorted(self._hists):
                 self._hists[name].render(f"{self.prefix}_{name}", out)
         return "\n".join(out) + "\n"

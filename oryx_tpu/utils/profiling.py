@@ -25,10 +25,17 @@ def start_server(port: int = 9999) -> None:
 
 @contextlib.contextmanager
 def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
-    """Capture a trace viewable in TensorBoard/Perfetto."""
-    opts = jax.profiler.ProfileOptions()
-    opts.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(logdir, profiler_options=opts)
+    """Capture a trace viewable in TensorBoard/Perfetto.
+
+    ProfileOptions only exists on newer jax; older versions take no
+    options and default to host tracing on — fall back rather than
+    making every profile capture version-locked."""
+    if hasattr(jax.profiler, "ProfileOptions"):
+        opts = jax.profiler.ProfileOptions()
+        opts.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=opts)
+    else:
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:
@@ -52,6 +59,13 @@ class OpProfile:
     source: str
     xplane_path: str
     plane_names: list[str]
+    # Wall-clock ns bracketing the profiler session: xplane lines may
+    # stamp timestamps on a process-local clock, and
+    # xplane.attribute_device_time aligns them by anchoring the last
+    # event end at trace_end_ns when joining host spans to device
+    # events.
+    trace_start_ns: int = 0
+    trace_end_ns: int = 0
 
 
 def op_profile(
@@ -69,14 +83,17 @@ def op_profile(
     last result and must block on it (default: jax.block_until_ready;
     pass a device_get-based sync over remote transports where
     block_until_ready is a no-op)."""
+    from oryx_tpu.utils import trace as trace_lib
     from oryx_tpu.utils import xplane
 
     sync = sync or jax.block_until_ready
     with trace(trace_dir):
+        t_start = trace_lib.now_ns()
         out = None
         for _ in range(steps):
             out = fn(*args)
         sync(out)
+        t_end = trace_lib.now_ns()
     files = xplane.find_xplane_files(trace_dir)
     if not files:
         raise RuntimeError(f"no xplane.pb written under {trace_dir}")
@@ -86,13 +103,16 @@ def op_profile(
         planes, n=top_n, plane_filter="TPU", line_filter="Ops"
     )
     if device:
-        return OpProfile(device, "tpu_xla_ops", files[-1], names)
+        return OpProfile(
+            device, "tpu_xla_ops", files[-1], names, t_start, t_end
+        )
     host = [
         xplane.Plane(p.name, [l for l in p.lines if "Modules" not in l.name])
         for p in planes
     ]
     return OpProfile(
-        xplane.top_ops(host, n=top_n), "host_fallback", files[-1], names
+        xplane.top_ops(host, n=top_n), "host_fallback", files[-1], names,
+        t_start, t_end,
     )
 
 

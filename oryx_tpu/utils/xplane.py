@@ -8,9 +8,17 @@ fields, so this module decodes just the subset an op-level summary
 needs: planes -> lines -> events, with per-plane event-metadata names.
 
 Field numbers follow tsl/profiler/protobuf/xplane.proto:
-  XSpace.planes=1; XPlane.name=2 .lines=3 .event_metadata=4(map);
-  XLine.name=2 .events=4; XEvent.metadata_id=1 .duration_ps=3;
-  XEventMetadata(map value).id=1 .name=2 .display_name=4.
+  XSpace.planes=1; XPlane.name=2 .lines=3 .event_metadata=4(map)
+  .stat_metadata=5(map) .stats=6;
+  XLine.name=2 .timestamp_ns=3 .events=4;
+  XEvent.metadata_id=1 .offset_ps=2 .duration_ps=3;
+  XEventMetadata(map value).id=1 .name=2 .display_name=4;
+  XStat.metadata_id=1 .uint64_value=3 .int64_value=4.
+
+Timestamps: an event's absolute start is line.timestamp_ns +
+event.offset_ps/1000 (unix-epoch ns, the same clock utils/trace.py
+anchors host spans to) — which is what lets attribute_device_time()
+join device op time back onto host-side decode-chunk/step spans.
 
 No dependency on tensorflow or protobuf. Used by
 scripts/capture_trace.py for the on-chip "profile, iterate" loop.
@@ -68,28 +76,36 @@ def _fields(buf: bytes):
 class Event:
     name: str
     duration_ps: int
+    offset_ps: int = 0  # start offset within the owning line
 
 
 @dataclass
 class Line:
     name: str
     events: list[Event] = field(default_factory=list)
+    timestamp_ns: int = 0  # line start (unix epoch)
 
 
 @dataclass
 class Plane:
     name: str
     lines: list[Line] = field(default_factory=list)
+    # Integer-valued plane stats (e.g. the "Task Environment" plane's
+    # profile_start_time / profile_stop_time in epoch ns — the clock
+    # anchor the span<->device join needs).
+    stats: dict[str, int] = field(default_factory=dict)
 
 
-def _parse_event(buf: bytes) -> tuple[int, int]:
-    meta_id = dur = 0
+def _parse_event(buf: bytes) -> tuple[int, int, int]:
+    meta_id = dur = offset = 0
     for fnum, _, val in _fields(buf):
         if fnum == 1:
             meta_id = val
+        elif fnum == 2:
+            offset = val
         elif fnum == 3:
             dur = val
-    return meta_id, dur
+    return meta_id, dur, offset
 
 
 def _parse_metadata_entry(buf: bytes) -> tuple[int, str]:
@@ -112,15 +128,21 @@ def _parse_line(buf: bytes, names: dict[int, str]) -> Line:
     for fnum, _, val in _fields(buf):
         if fnum == 2:
             line.name = val.decode("utf-8", "replace")
+        elif fnum == 3:
+            line.timestamp_ns = val
         elif fnum == 4:
-            meta_id, dur = _parse_event(val)
-            line.events.append(Event(names.get(meta_id, str(meta_id)), dur))
+            meta_id, dur, offset = _parse_event(val)
+            line.events.append(
+                Event(names.get(meta_id, str(meta_id)), dur, offset)
+            )
     return line
 
 
 def _parse_plane(buf: bytes) -> Plane:
     name = ""
     metadata: dict[int, str] = {}
+    stat_names: dict[int, str] = {}
+    stat_vals: list[tuple[int, int]] = []  # (metadata_id, int value)
     line_bufs: list[bytes] = []
     for fnum, _, val in _fields(buf):
         if fnum == 2:
@@ -130,8 +152,25 @@ def _parse_plane(buf: bytes) -> Plane:
         elif fnum == 4:
             k, v = _parse_metadata_entry(val)
             metadata[k] = v
+        elif fnum == 5:
+            k, v = _parse_metadata_entry(val)
+            stat_names[k] = v
+        elif fnum == 6:
+            mid = ival = None
+            for f2, _, v2 in _fields(val):
+                if f2 == 1:
+                    mid = v2
+                elif f2 in (3, 4):  # uint64 / int64 value
+                    ival = v2
+            if mid is not None and ival is not None:
+                stat_vals.append((mid, ival))
     return Plane(
-        name, [_parse_line(b, metadata) for b in line_bufs]
+        name,
+        [_parse_line(b, metadata) for b in line_bufs],
+        {
+            stat_names[mid]: v for mid, v in stat_vals
+            if mid in stat_names
+        },
     )
 
 
@@ -185,3 +224,107 @@ def top_ops(
     totals = op_totals(planes, **kw)
     ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:n]
     return [(name, ps / 1e9) for name, ps in ranked]
+
+
+# Line timestamps below this are clearly not unix-epoch ns (10**15 ns
+# past 1970 is mid-2001; any real wall clock is ~1.7e18): such a
+# timeline is relative to some process-local clock and needs aligning.
+_EPOCH_THRESHOLD_NS = 10**15
+
+
+def profile_start_time_ns(planes: list[Plane]) -> int:
+    """Epoch-ns start of the profiler session, from the "Task
+    Environment" plane's stats (0 when absent). Relative line
+    timestamps are offsets from this instant."""
+    for plane in planes:
+        if (t := plane.stats.get("profile_start_time", 0)):
+            return t
+    return 0
+
+
+def _plane_shift_ns(plane: Plane, session_end_ns: int) -> int:
+    """Fallback alignment shift for a relative-timeline plane in a
+    file with no profile_start_time stat. Anchor on the trace END:
+    every event a profiler session records ends at or before
+    stop_trace, and the last one (thread/session-lifetime events
+    included) ends AT it — so `session_end_ns - max(event end)` maps
+    the plane's timeline onto the wall clock to within the stop_trace
+    teardown latency (~ms)."""
+    max_end = 0
+    for line in plane.lines:
+        for ev in line.events:
+            end = line.timestamp_ns + (
+                ev.offset_ps + ev.duration_ps
+            ) // 1000
+            max_end = max(max_end, end)
+    return session_end_ns - max_end
+
+
+def attribute_device_time(
+    planes: list[Plane],
+    windows: list[tuple[str, int, int]],
+    plane_filter: str = "",
+    line_filter: str = "",
+    session_end_ns: int = 0,
+) -> dict[str, int]:
+    """Attribute device-event time onto host-side span windows.
+
+    windows: (label, start_ns, end_ns) in unix-epoch ns — e.g. the
+    decode-chunk / train-step spans a utils/trace.py flight recorder
+    produced (trace.windows_from_traces). Each matching device event is
+    credited, by its midpoint, to the window containing it; events
+    outside every window land in "_unattributed". Returns
+    label -> total duration_ps. Windows with zero matching events still
+    appear (value 0), so a run whose clocks don't line up reads as
+    all-unattributed instead of silently empty.
+
+    Relative (non-epoch) line timestamps are offsets from the
+    profiler-session start, which the file itself records (the "Task
+    Environment" plane's profile_start_time stat) — that is the
+    preferred anchor. session_end_ns (wall-clock ns at
+    jax.profiler.stop_trace; profiling.op_profile records it as
+    OpProfile.trace_end_ns) is the fallback for writers without the
+    stat: the plane's last event end is anchored at it. Epoch-stamped
+    planes need no alignment.
+    """
+    totals: dict[str, int] = {label: 0 for label, _, _ in windows}
+    totals["_unattributed"] = 0
+    spans = sorted(windows, key=lambda w: w[1])
+    start_anchor = profile_start_time_ns(planes)
+    for plane in planes:
+        if plane_filter and plane_filter not in plane.name:
+            continue
+        relative = any(
+            line.timestamp_ns < _EPOCH_THRESHOLD_NS
+            for line in plane.lines if line.events
+        )
+        shift = 0
+        if relative:
+            shift = start_anchor or _plane_shift_ns(
+                plane, session_end_ns
+            )
+        for line in plane.lines:
+            if line_filter and line_filter not in line.name:
+                continue
+            base = line.timestamp_ns + shift
+            for ev in line.events:
+                mid_ns = base + (
+                    ev.offset_ps + ev.duration_ps // 2
+                ) // 1000
+                hits = [
+                    label for label, t0, t1 in spans
+                    if t0 <= mid_ns < t1
+                ]
+                if not hits:
+                    totals["_unattributed"] += ev.duration_ps
+                    continue
+                # Overlapping windows split the credit: the scheduler
+                # stamps one shared decode dispatch onto EVERY live
+                # request, so identical windows are the normal case in
+                # a live-recorder join — first-match-wins would hand
+                # all device time to one request and 0 to the rest.
+                share = ev.duration_ps // len(hits)
+                for label in hits:
+                    totals[label] += share
+                totals[hits[0]] += ev.duration_ps - share * len(hits)
+    return totals

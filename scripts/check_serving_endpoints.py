@@ -1,0 +1,130 @@
+"""CI well-formedness gate for the serving observability surface.
+
+Boots a short-lived CPU server (tiny geometry, continuous engine),
+pushes one request through it, then checks:
+
+  * GET /metrics — exact Prometheus content type
+    (`text/plain; version=0.0.4`), every metric name carries the
+    `oryx_serving_` prefix (an unprefixed name would collide in any
+    shared Prometheus), and the build_info gauge is present with
+    revision + engine labels;
+  * GET /debug/requests — valid JSON, the request we sent is recorded;
+  * GET /debug/trace?id= — valid Chrome trace JSON with a non-empty
+    traceEvents list covering prefill and decode.
+
+Exit 0 = all good; nonzero prints what broke. Wired into
+scripts/check_tier1.sh after the pytest gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+
+class _Tokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def main() -> None:
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve import api_server
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_Tokenizer(), params, cfg)
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hello there"}],
+                "max_tokens": 4,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            rid = r.headers.get("X-Request-Id")
+            json.load(r)
+        if not rid:
+            fail("completion response missing X-Request-Id header")
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            ctype = r.headers.get("Content-Type")
+            metrics_text = r.read().decode()
+        if ctype != "text/plain; version=0.0.4":
+            fail(f"/metrics content type {ctype!r}, want the Prometheus "
+                 "text exposition type")
+        bad = [
+            line for line in metrics_text.splitlines()
+            if line and not line.startswith("#")
+            and not line.startswith("oryx_serving_")
+        ]
+        if bad:
+            fail(f"unprefixed metric names: {bad[:5]}")
+        if not re.search(
+            r'^oryx_serving_build_info\{[^}]*engine="[^"]+"[^}]*\} 1$',
+            metrics_text, re.M,
+        ) or 'revision="' not in metrics_text:
+            fail("oryx_serving_build_info gauge with engine+revision "
+                 "labels missing from /metrics")
+
+        with urllib.request.urlopen(
+            base + "/debug/requests", timeout=30
+        ) as r:
+            recorder = json.load(r)
+        ids = [e.get("id") for e in recorder.get("requests", [])]
+        if rid not in ids:
+            fail(f"/debug/requests does not list request {rid} "
+                 f"(got {ids})")
+
+        with urllib.request.urlopen(
+            base + f"/debug/trace?id={rid}", timeout=30
+        ) as r:
+            tracejs = json.load(r)
+        names = {
+            e.get("name") for e in tracejs.get("traceEvents", [])
+        }
+        for want in ("queue_wait", "prefill", "decode_chunk"):
+            if want not in names:
+                fail(f"/debug/trace missing span {want!r} (got "
+                     f"{sorted(names)})")
+    finally:
+        if srv.scheduler is not None:
+            srv.scheduler.close()
+        srv.shutdown()
+    print("serving endpoints OK: /metrics (content-type, prefix, "
+          "build_info) + /debug/requests + /debug/trace")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,11 @@
 set -u
 cd "$(dirname "$0")/.."
 
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-274}
+# 300 = the 274 recorded at PR 1 plus the observability suite added in
+# PR 2 (trace/watchdog, debug endpoints, xplane join, conftest guard;
+# 305 observed with a warm /tmp/jax_cache), with headroom for the 4
+# trainer-family tests that flip with cache state (see CHANGES.md).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-300}
 
 # --- ROADMAP.md "Tier-1 verify", verbatim -----------------------------------
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
@@ -24,3 +28,14 @@ if [ "$dots" -lt "$BASELINE_DOTS" ]; then
     exit 1
 fi
 echo "tier-1 OK: no regression vs recorded baseline"
+
+# --- serving observability surface ------------------------------------------
+# Boot a short-lived CPU server and verify /metrics (content type,
+# oryx_serving_ name prefix, build_info gauge) and the /debug flight
+# recorder + trace endpoints are well-formed.
+echo "checking serving endpoints (/metrics, /debug/requests, /debug/trace)"
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_serving_endpoints.py; then
+    echo "SERVING ENDPOINT CHECK FAILED" >&2
+    exit 1
+fi

@@ -786,3 +786,158 @@ def test_server_concurrent_mixed_clients(server):
             )
         # A real multi-row batch formed out of the concurrent traffic.
         assert max(n for n, _, _ in calls) > 1, calls
+
+
+def test_continuous_request_id_and_debug_endpoints(continuous_server):
+    """Acceptance: a request through --engine continuous yields (a) an
+    X-Request-Id header, (b) a /debug/trace?id= span tree covering
+    queue-wait -> prefill -> decode chunks -> emission as loadable
+    Chrome trace JSON, and (c) a flight-recorder entry in
+    /debug/requests."""
+    url, pipe = continuous_server
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 5,
+    }) as r:
+        rid = r.headers["X-Request-Id"]
+        out = json.load(r)
+    assert rid
+    # The completion id embeds the request id (client-side join key).
+    assert out["id"] == f"chatcmpl-{rid}"
+
+    with urllib.request.urlopen(url + "/debug/requests", timeout=30) as r:
+        recorder = json.load(r)
+    entry = next(
+        e for e in recorder["requests"] if e["id"] == rid
+    )
+    assert entry["done"] and entry["kind"] == "request"
+    assert entry["meta"]["finish_reason"] == "length"
+    assert entry["meta"]["completion_tokens"] == 5
+    assert entry["num_spans"] >= 4
+
+    with urllib.request.urlopen(
+        url + f"/debug/trace?id={rid}", timeout=30
+    ) as r:
+        assert r.headers["X-Request-Id"] == rid
+        tracejs = json.load(r)
+    events = tracejs["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    # Perfetto-loadable complete events: required keys, µs timestamps.
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    names = [e["name"] for e in xs]
+    for want in ("queue_wait", "admission", "prompt_prep", "prefill",
+                 "decode_chunk", "emission"):
+        assert want in names, (want, names)
+    # Spans are causally ordered: queue_wait starts first.
+    first = min(xs, key=lambda e: e["ts"])
+    assert first["name"] == "queue_wait"
+    assert tracejs["request"]["id"] == rid
+
+    # Unknown / missing ids fail cleanly.
+    for path, code in (("/debug/trace?id=deadbeef", 404),
+                       ("/debug/trace", 400)):
+        try:
+            urllib.request.urlopen(url + path, timeout=30)
+            raise AssertionError(f"expected HTTP {code}")
+        except urllib.error.HTTPError as e:
+            assert e.code == code
+
+
+def test_continuous_streaming_request_id(continuous_server):
+    """SSE streams carry the X-Request-Id header and the chunk ids
+    embed it; the trace is recorded like a non-streaming request."""
+    url, _ = continuous_server
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 4, "stream": True,
+    }) as r:
+        rid = r.headers["X-Request-Id"]
+        raw = r.read().decode()
+    assert rid
+    chunks = [
+        json.loads(l[6:]) for l in raw.splitlines()
+        if l.startswith("data: ") and l != "data: [DONE]"
+    ]
+    assert all(c["id"] == f"chatcmpl-{rid}" for c in chunks)
+    with urllib.request.urlopen(
+        url + f"/debug/trace?id={rid}", timeout=30
+    ) as r:
+        names = {
+            e["name"] for e in json.load(r)["traceEvents"]
+            if e.get("ph") == "X"
+        }
+    assert {"queue_wait", "prefill", "decode_chunk"} <= names
+
+
+def test_metrics_content_type_and_build_info(continuous_server):
+    """Satellite: /metrics serves the exact Prometheus exposition
+    content type, every name is oryx_serving_-prefixed, and the
+    build_info gauge carries revision + engine labels."""
+    import re
+
+    url, _ = continuous_server
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
+        text = r.read().decode()
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert line.startswith("oryx_serving_"), line
+    m = re.search(
+        r'^oryx_serving_build_info\{([^}]*)\} 1$', text, re.M
+    )
+    assert m, text
+    labels = m.group(1)
+    assert 'engine="continuous"' in labels
+    assert 'revision="' in labels and 'revision=""' not in labels
+    assert 'model="oryx-tpu"' in labels
+
+
+def test_window_engine_request_id_and_debug(server):
+    """The window engine gets the same observability surface: request
+    ids on responses, flight-recorder entries, and parity spans
+    (queue_wait + shared decode window; prefill/decode_chunk via
+    chat_stream for solo streams)."""
+    url, _ = server
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 4,
+    }) as r:
+        rid = r.headers["X-Request-Id"]
+        json.load(r)
+    with urllib.request.urlopen(
+        url + f"/debug/trace?id={rid}", timeout=30
+    ) as r:
+        tj = json.load(r)
+    names = {e["name"] for e in tj["traceEvents"] if e.get("ph") == "X"}
+    assert {"queue_wait", "decode"} <= names
+    decode = next(
+        e for e in tj["traceEvents"] if e.get("name") == "decode"
+    )
+    assert decode["args"]["batch_size"] >= 1
+    assert tj["request"]["meta"]["finish_reason"] == "length"
+
+    # Streaming (solo chat_stream): pipeline spans via the active trace.
+    with _post(url, {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 4, "stream": True,
+    }) as r:
+        srid = r.headers["X-Request-Id"]
+        r.read()
+    with urllib.request.urlopen(
+        url + f"/debug/trace?id={srid}", timeout=30
+    ) as r:
+        snames = {
+            e["name"] for e in json.load(r)["traceEvents"]
+            if e.get("ph") == "X"
+        }
+    assert {"prefill", "decode_chunk", "emission"} <= snames
+
+    with urllib.request.urlopen(url + "/debug/requests", timeout=30) as r:
+        ids = [e["id"] for e in json.load(r)["requests"]]
+    assert rid in ids and srid in ids
+
+    # Window engine build_info says so.
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        assert 'engine="window"' in r.read().decode()

@@ -1,0 +1,54 @@
+"""The conftest bootstrap guard (VERDICT r5 weak 5): the hazard
+decision that keeps a naive `python -m pytest tests` from sleeping
+forever in axon/TPU-tunnel backend init must trip on every known
+hazard and stay quiet on the sanitized environment the suite actually
+runs under."""
+
+import importlib.util
+import os
+
+
+def _load_hazard():
+    spec = importlib.util.spec_from_file_location(
+        "_oryx_conftest_under_test",
+        os.path.join(os.path.dirname(__file__), "conftest.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod._axon_hazard
+
+
+def test_sanitized_env_is_safe():
+    hazard = _load_hazard()
+    env = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    assert hazard(env, {}) is None
+    assert hazard({}, {}) is None  # nothing set at all
+    assert hazard({"JAX_PLATFORMS": ""}, {}) is None
+
+
+def test_hazards_detected():
+    hazard = _load_hazard()
+    # axon plugin already imported (sitecustomize ran before us).
+    assert "axon" in hazard({}, {"axon": object()})
+    assert "axon" in hazard({}, {"axon.register": object()})
+    # ...but a module merely containing "axon" in its name is fine.
+    assert hazard({}, {"saxonparser": object()}) is None
+    # Env that would make sitecustomize dial the tunnel.
+    assert "PALLAS_AXON_POOL_IPS" in hazard(
+        {"PALLAS_AXON_POOL_IPS": "10.0.0.1"}, {}
+    )
+    assert "JAX_PLATFORMS" in hazard({"JAX_PLATFORMS": "tpu"}, {})
+
+
+def test_jax_preimport_only_hazardous_with_noncpu_backend():
+    hazard = _load_hazard()
+    # jax imported pre-conftest with only-CPU (or no) backends is the
+    # normal re-exec'd / warm state — must NOT trip (a false positive
+    # here would re-exec-loop into the fail-fast path).
+    import jax  # noqa: F401 - real module, CPU backend from conftest
+    import sys
+
+    assert hazard(
+        {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+        dict(sys.modules),
+    ) is None

@@ -176,3 +176,95 @@ def test_bench_wasted_step_fraction_drops_2x():
     out = mod.run([])
     assert out["wasted_frac_ratio"] >= 2.0, out
     assert 0.0 < out["scheduler"]["step_utilization"] <= 1.0
+
+
+def test_request_traces_cover_lifecycle_and_eviction(pipe):
+    """Flight-recorder span trees: every request records queue_wait ->
+    admission -> prefill -> decode chunks -> emission; an evicted
+    request additionally records the evicted event, a reopened
+    queue_wait, and a replay prefill."""
+    import math
+
+    from oryx_tpu.utils import trace as trace_lib
+
+    q1, q2 = "hello there", "tell me more"
+    chunk, ps = 4, 16
+    ids1 = len(pipe._prepare_request({"question": q1})[0])
+    ids2 = len(pipe._prepare_request({"question": q2})[0])
+    admit1 = math.ceil((ids1 + chunk) / ps)
+    admit2 = math.ceil((ids2 + chunk) / ps)
+    cap = (admit1 * ps - ids1) + ps
+    metrics = ServingMetrics()
+    tracer = trace_lib.Tracer()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
+        num_pages=admit1 + admit2 + 1, metrics=metrics, autostart=False,
+        tracer=tracer,
+    )
+    handles, results = _run_all(
+        sched, [(q1, cap, None), (q2, cap, None)]
+    )
+    assert metrics.get("evicted") >= 1
+    for h, (reply, reason, usage) in zip(handles, results):
+        tr = h.trace
+        assert tr is tracer.get(h.request_id)
+        assert tr.done
+        assert tr.meta["finish_reason"] == reason
+        assert tr.meta["completion_tokens"] == usage[1]
+        names = [s.name for s in tr.spans]
+        for want in ("queue_wait", "admission", "prefill",
+                     "decode_chunk", "emission"):
+            assert want in names, (want, names)
+        assert all(s.dur_ns is not None for s in tr.spans)
+    # The evicted request (the younger one) carries the eviction story.
+    evicted = next(
+        h.trace for h in handles
+        if any(s.name == "evicted" for s in h.trace.spans)
+    )
+    names = [s.name for s in evicted.spans]
+    assert names.count("queue_wait") >= 2  # submit + requeue
+    prefills = [s for s in evicted.spans if s.name == "prefill"]
+    assert len(prefills) >= 2
+    assert prefills[-1].args["replay"] is True
+    ev = next(s for s in evicted.spans if s.name == "evicted")
+    assert ev.args["replay_tokens"] > 0
+
+
+def test_forced_stall_triggers_exactly_one_watchdog_dump(pipe):
+    """Acceptance: a test-injected stall (one decode chunk held past
+    the deadline) produces exactly ONE watchdog dump, containing the
+    thread stacks and the flight-recorder tail with the stuck
+    request."""
+    import io
+    import time as time_lib
+
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        autostart=False, stall_timeout=0.25,
+    )
+    out = io.StringIO()
+    sched.watchdog.out = out
+    orig = sched._step_chunk
+    stalled = []
+
+    def slow_chunk():
+        if not stalled:
+            stalled.append(1)
+            time_lib.sleep(1.2)  # > 4x the deadline, no beat
+        return orig()
+
+    sched._step_chunk = slow_chunk
+    h = sched.submit({"question": "hello there"}, 6)
+    sched.start()
+    reply, _, _ = h.result(timeout=600)
+    assert reply == pipe.chat("hello there", max_new_tokens=6)
+    # Allow the watchdog thread its final tick, then close.
+    deadline = time_lib.monotonic() + 5
+    while sched.watchdog.dumps == 0 and time_lib.monotonic() < deadline:
+        time_lib.sleep(0.02)
+    sched.close()
+    assert sched.watchdog.dumps == 1, sched.watchdog.dumps
+    text = out.getvalue()
+    assert "STALL WATCHDOG" in text
+    assert h.request_id in text  # recorder tail names the stuck request
+    assert "slow_chunk" in text  # the stack shows where it hung

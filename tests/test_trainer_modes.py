@@ -117,3 +117,57 @@ def test_trainer_mode_one_step(tmp_path, mode):
         assert any(not m.sharding.is_fully_replicated for m in mu_like)
     else:
         assert all(m.sharding.is_fully_replicated for m in mu_like)
+
+
+def test_trainer_step_traces_and_phase_metrics(tmp_path):
+    """Observability: each step lands in the trainer's flight recorder
+    with data/h2d/step_dispatch/device_sync phase spans, and the phase
+    seconds ride the MetricLogger JSONL record."""
+    import json
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh (conftest)")
+    cfg = _cfg(tmp_path, "traced")
+    b = _batch(cfg)
+    mpath = tmp_path / "metrics.jsonl"
+    t = Trainer(cfg, sharding_mode="fsdp", metrics_path=str(mpath))
+    t.fit(iter([b]), num_steps=1, resume=False, prefetch=0)
+
+    traces = t.tracer.traces()
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr.kind == "train_step" and tr.done
+    assert tr.meta["step"] == 1
+    names = [s.name for s in tr.spans]
+    for want in ("data", "h2d", "step_dispatch", "device_sync"):
+        assert want in names, names
+    assert all(s.dur_ns is not None for s in tr.spans)
+
+    rec = json.loads(mpath.read_text().splitlines()[-1])
+    for key in ("data_s", "dispatch_s", "sync_s"):
+        assert key in rec and rec[key] >= 0
+    # Chrome export of a step trace is loadable JSON with X events.
+    body = t.tracer.chrome_trace([tr])
+    assert any(e.get("ph") == "X" for e in body["traceEvents"])
+    json.dumps(body)
+
+
+def test_trainer_rejects_packed_text_under_ring():
+    """VERDICT item 4 (satellite): the ring x packed-text trap fails
+    fast at the trainer boundary with an actionable message instead of
+    dying deep in jit (or training silently wrong)."""
+    import numpy as np
+
+    from oryx_tpu.train.trainer import validate_train_batch
+
+    packed = {"text_segment_ids": np.ones((1, 2, 8), np.int32)}
+    for impl in ("ring", "ring_flash"):
+        cfg = dataclasses.replace(cfg_lib.oryx_tiny(), attn_impl=impl)
+        with pytest.raises(ValueError, match="no.*segment support"):
+            validate_train_batch(cfg, packed)
+    # Packed text under xla/pallas is fine; ring without packing is fine.
+    validate_train_batch(cfg_lib.oryx_tiny(), packed)
+    validate_train_batch(
+        dataclasses.replace(cfg_lib.oryx_tiny(), attn_impl="ring_flash"),
+        {"token_ids": np.zeros((1, 2, 8), np.int32)},
+    )
