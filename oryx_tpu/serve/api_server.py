@@ -10,8 +10,11 @@ web-framework dependency.
     {"model": "...", "messages": [{"role": "user", "content": ...}],
      "max_tokens": 64, "stream": false}
   GET /v1/models
-  GET /healthz
-  GET /metrics          (Prometheus text format, build_info gauge)
+  GET /healthz          (liveness: the process is up)
+  GET /readyz           (readiness: engine loop alive + un-stalled;
+                         503 with a reason otherwise)
+  GET /metrics          (Prometheus text format, build_info gauge,
+                         HBM gauges, oryx_anomaly_total on SLO breach)
   GET /debug/requests   (flight recorder: last N requests, in-flight too)
   GET /debug/trace?id=  (one request's span tree as Chrome trace JSON —
                          loads in Perfetto; id from the X-Request-Id
@@ -456,6 +459,9 @@ def build_server(
     max_ctx: int = 2048,
     stall_timeout: float | None = None,
     flight_recorder_size: int = 256,
+    ttft_slo: float | None = None,
+    queue_depth_slo: int | None = None,
+    events_path: str | None = None,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline.
 
@@ -464,15 +470,39 @@ def build_server(
     batcher); "continuous" routes EVERYTHING — streaming and not —
     through the continuous-batching scheduler (serve/scheduler.py):
     a fixed slot array over a paged KV cache, admission at chunk
-    boundaries, per-slot sampling. Both engines export GET /metrics.
+    boundaries, per-slot sampling. Both engines export GET /metrics;
+    GET /readyz says whether the engine loop is actually alive (and,
+    continuous engine, un-stalled per the watchdog beat) so load
+    balancers never have to probe with real completions.
+
+    ttft_slo / queue_depth_slo arm the serving anomaly detectors
+    (utils/anomaly.py): breaches increment oryx_anomaly_total{kind=}
+    and, with events_path, append structured JSONL events.
     """
+    from oryx_tpu.utils.anomaly import AnomalyMonitor, AnomalyThresholds
     from oryx_tpu.utils.metrics import ServingMetrics
 
+    if engine != "continuous" and (ttft_slo or queue_depth_slo):
+        # Only the continuous scheduler feeds the SLO detectors; a
+        # window-engine server accepting these flags would look armed
+        # while every breach went unobserved.
+        raise ValueError(
+            "--ttft-slo/--queue-depth-slo require --engine continuous "
+            "(the window batcher does not feed the SLO detectors)"
+        )
     metrics = ServingMetrics()
     metrics.set_info("build_info", {
         "revision": _git_revision(), "engine": engine,
         "model": model_name,
     })
+    anomaly = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(
+            ttft_slo_s=ttft_slo, queue_depth_slo=queue_depth_slo,
+        ),
+        events_path=events_path,
+        registry=metrics.registry,
+    )
     # One flight recorder for the whole server: the last
     # `flight_recorder_size` requests — in-flight and finished — served
     # by GET /debug/requests, with per-request span trees (queue-wait →
@@ -490,7 +520,7 @@ def build_server(
         scheduler = ContinuousScheduler(
             pipe, num_slots=num_slots, page_size=page_size,
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
-            tracer=tracer, stall_timeout=stall_timeout,
+            tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
         )
     elif engine == "window":
         batcher = Batcher(
@@ -499,6 +529,25 @@ def build_server(
         )
     else:
         raise ValueError(f"unknown engine {engine!r} (window|continuous)")
+
+    def _ready() -> tuple[bool, str]:
+        """Readiness = the engine loop is genuinely able to make
+        progress: model built (we exist), engine thread alive, and —
+        when a watchdog is armed — no in-flight stall. A load balancer
+        probing this never has to spend a real completion."""
+        if scheduler is not None:
+            if not scheduler._thread.is_alive():
+                return False, "scheduler loop dead"
+            wd = scheduler.watchdog
+            if wd is not None and wd.stalled():
+                return False, (
+                    f"scheduler stalled (no decode beat in "
+                    f"{wd.deadline_s:g}s)"
+                )
+            return True, "ok"
+        if not batcher._thread.is_alive():
+            return False, "batcher loop dead"
+        return True, "ok"
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet access log
@@ -518,6 +567,12 @@ def build_server(
         def do_GET(self):
             if self.path == "/healthz":
                 self._json(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                ready, reason = _ready()
+                self._json(
+                    200 if ready else 503,
+                    {"ready": ready, "reason": reason},
+                )
             elif self.path == "/debug/requests":
                 # Flight recorder: newest-first summaries of the last N
                 # requests (in-flight included).
@@ -845,6 +900,7 @@ def build_server(
     srv.scheduler = scheduler
     srv.batcher = batcher
     srv.tracer = tracer
+    srv.anomaly = anomaly
     return srv
 
 
@@ -894,6 +950,23 @@ def main(argv: list[str] | None = None) -> None:
         "(span trees at GET /debug/trace?id=)",
     )
     ap.add_argument(
+        "--ttft-slo", type=float, default=None,
+        help="fire an oryx_anomaly_total{kind=\"ttft_slo\"} event when "
+        "a request's time-to-first-token exceeds this many seconds "
+        "(continuous engine only)",
+    )
+    ap.add_argument(
+        "--queue-depth-slo", type=int, default=None,
+        help="fire an oryx_anomaly_total{kind=\"queue_depth_slo\"} "
+        "event when the admission queue exceeds this depth "
+        "(continuous engine only)",
+    )
+    ap.add_argument(
+        "--events-path", default=None,
+        help="append structured anomaly events as JSONL here "
+        "(see docs/OBSERVABILITY.md for the schema)",
+    )
+    ap.add_argument(
         "--allow-local-files", action="store_true",
         help="let image_url reference server-local file paths (off by "
         "default: any network client could read arbitrary images)",
@@ -937,6 +1010,9 @@ def main(argv: list[str] | None = None) -> None:
         max_ctx=args.max_ctx,
         stall_timeout=args.stall_timeout or None,
         flight_recorder_size=args.flight_recorder_size,
+        ttft_slo=args.ttft_slo,
+        queue_depth_slo=args.queue_depth_slo,
+        events_path=args.events_path,
     )
     print(f"serving {args.model_name} on http://{args.host}:{args.port}")
     srv.serve_forever()

@@ -53,6 +53,7 @@ from oryx_tpu.models import oryx, qwen2
 from oryx_tpu.ops import paged_kv
 from oryx_tpu.serve import pipeline as pipeline_lib
 from oryx_tpu.utils import trace as trace_lib
+from oryx_tpu.utils.anomaly import AnomalyMonitor
 from oryx_tpu.utils.metrics import ServingMetrics, TTFT_BUCKETS
 
 # Every line carries the request id — grep one id end-to-end across
@@ -148,9 +149,13 @@ class ContinuousScheduler:
         autostart: bool = True,
         tracer: trace_lib.Tracer | None = None,
         stall_timeout: float | None = None,
+        anomaly: AnomalyMonitor | None = None,
     ):
         if max_ctx % page_size:
             raise ValueError(f"{max_ctx=} not a multiple of {page_size=}")
+        # Optional SLO watcher (utils/anomaly.py): TTFT and queue-depth
+        # breaches fire oryx_anomaly_total{kind=} + events.jsonl.
+        self.anomaly = anomaly
         self.pipe = pipe
         self.cfg = pipe.cfg
         self.num_slots = num_slots
@@ -236,8 +241,11 @@ class ContinuousScheduler:
         _LOG.info("request %s queued (max_new=%d)", tr.id, max_new)
         with self._cond:
             self._queue.append(req)
-            self.metrics.set_gauge("queue_depth", len(self._queue))
+            depth = len(self._queue)
+            self.metrics.set_gauge("queue_depth", depth)
             self._cond.notify()
+        if self.anomaly is not None:
+            self.anomaly.observe_queue_depth(depth)
         return h
 
     def close(self) -> None:
@@ -421,7 +429,14 @@ class ContinuousScheduler:
                 break
             with self._cond:
                 self._queue.popleft()
-                self.metrics.set_gauge("queue_depth", len(self._queue))
+                depth = len(self._queue)
+                self.metrics.set_gauge("queue_depth", depth)
+            if self.anomaly is not None:
+                # Drain-side observations re-arm the hysteresis: with
+                # submit-only feeding, the detector would only ever see
+                # depths >= 1 and a queue_depth_slo of 1 could never
+                # re-arm after its first firing.
+                self.anomaly.observe_queue_depth(depth)
             self._place(s, req)
 
     def _place(self, s: int, req: _Request) -> None:
@@ -479,10 +494,12 @@ class ContinuousScheduler:
         self.recent[s] = -2
         self.keys = self.keys.at[s].set(key[0])
         if req.admit_seq < 0:
+            ttft = time.monotonic() - req.submit_time
             self.metrics.observe(
-                "ttft_seconds", time.monotonic() - req.submit_time,
-                buckets=TTFT_BUCKETS,
+                "ttft_seconds", ttft, buckets=TTFT_BUCKETS,
             )
+            if self.anomaly is not None:
+                self.anomaly.observe_ttft(ttft, request_id=req.trace.id)
             req.handle.debug["admit_chunk"] = self.chunks_run
         req.admit_seq = self._admit_seq
         self._admit_seq += 1

@@ -48,6 +48,23 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--metrics-path", default=None)
     ap.add_argument("--tensorboard-dir", default=None,
                     help="also report metrics as TensorBoard scalars")
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve oryx_train_* Prometheus metrics + /healthz + "
+        "/readyz on this port (process 0 only; 0 = ephemeral port, "
+        "see docs/OBSERVABILITY.md)",
+    )
+    ap.add_argument(
+        "--events-path", default=None,
+        help="append structured anomaly events (NaN loss, loss spike, "
+        "grad explosion, throughput collapse) as JSONL here",
+    )
+    ap.add_argument(
+        "--on-anomaly", choices=["warn", "halt"], default="warn",
+        help="anomaly policy: 'warn' logs + counts and keeps training; "
+        "'halt' raises out of the step loop (the pod restarts from the "
+        "last checkpoint instead of burning chips on a poisoned run)",
+    )
     ap.add_argument("--num-steps", type=int, default=None)
     ap.add_argument("--video-frames", type=int, default=64)
     # Multi-host rendezvous (auto-detected on TPU pods; explicit for tests).
@@ -139,7 +156,14 @@ def main(argv: list[str] | None = None) -> None:
         sharding_mode=args.sharding,
         metrics_path=args.metrics_path,
         tensorboard_dir=args.tensorboard_dir,
+        metrics_port=args.metrics_port,
+        events_path=args.events_path,
+        on_anomaly=args.on_anomaly,
     )
+    if trainer.telemetry is not None and trainer.telemetry.port is not None:
+        rank0_print(
+            f"telemetry: http://127.0.0.1:{trainer.telemetry.port}/metrics"
+        )
     state = trainer.fit(batches)
 
     if args.output_dir:

@@ -9,6 +9,7 @@ replaces ZeRO partitioned checkpoints. Entry scripts call `Trainer.fit()`.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Iterator
 
 import jax
@@ -20,8 +21,10 @@ from oryx_tpu.models import oryx
 from oryx_tpu.parallel import mesh as mesh_lib
 from oryx_tpu.parallel import sharding
 from oryx_tpu.train import step as step_lib
-from oryx_tpu.train.optimizer import make_optimizer
+from oryx_tpu.train import telemetry as telemetry_lib
+from oryx_tpu.train.optimizer import make_optimizer, make_schedule
 from oryx_tpu.utils import trace as trace_lib
+from oryx_tpu.utils.anomaly import AnomalyThresholds
 from oryx_tpu.utils.checkpoint import CheckpointManager
 from oryx_tpu.utils.metrics import MetricLogger, rank0_print
 
@@ -54,6 +57,11 @@ class Trainer:
         tracer: trace_lib.Tracer | None = None,
         flight_recorder_size: int = 64,
         stall_timeout: float | None = None,
+        metrics_port: int | None = None,
+        events_path: str | None = None,
+        on_anomaly: str = "warn",
+        anomaly_thresholds: AnomalyThresholds | None = None,
+        telemetry: telemetry_lib.TrainTelemetry | None = None,
     ) -> None:
         self.cfg = cfg
         self.mesh = mesh_lib.build_mesh(cfg.mesh)
@@ -63,6 +71,30 @@ class Trainer:
             tensorboard_dir=tensorboard_dir,
         )
         self.ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+        # Fleet-level telemetry (train/telemetry.py): a /metrics +
+        # /healthz + /readyz HTTP exporter plus the anomaly monitor.
+        # Off by default (no thread, no sink) — any of metrics_port /
+        # events_path / an injected TrainTelemetry turns it on, and so
+        # does on_anomaly="halt": the halt policy lives in the monitor,
+        # so asking for it MUST construct one (registry-only, no HTTP,
+        # when no port was given) rather than silently not protecting
+        # the run. Only process 0 exports: one scrape target per job,
+        # and the per-step metrics are already global reductions.
+        self.telemetry = telemetry
+        if (
+            self.telemetry is None
+            and (
+                metrics_port is not None
+                or events_path
+                or on_anomaly == "halt"
+            )
+            and jax.process_index() == 0
+        ):
+            self.telemetry = telemetry_lib.TrainTelemetry(
+                port=metrics_port, events_path=events_path,
+                thresholds=anomaly_thresholds, on_anomaly=on_anomaly,
+            )
+        self._lr_fn = make_schedule(cfg.train, cfg.train.learning_rate)
         # Per-step flight recorder (same Trace/Span model as serving):
         # each step records data / h2d / step_dispatch / device_sync /
         # checkpoint_save spans, and the phase seconds also land in the
@@ -150,14 +182,22 @@ class Trainer:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
         self.logger.close()
 
     def resume_if_available(self) -> int:
         """Restore latest checkpoint if present; returns start step."""
         if self.ckpt.latest_step() is None:
             return 0
+        t0 = time.perf_counter()
         self.state = self.ckpt.restore(self.state)
         start = int(self.state.step)
+        if self.telemetry is not None:
+            # Restore time is goodput-relevant (MegaScale: restart
+            # overhead is a first-class loss term) — attribute it.
+            self.telemetry.record_restore(time.perf_counter() - t0)
         rank0_print(f"resumed from step {start}")
         return start
 
@@ -219,9 +259,12 @@ class Trainer:
         consecutive_skipped = 0
         if self.watchdog is not None and start < num_steps:
             self.watchdog.set_active(True)
+        if self.telemetry is not None:
+            self.telemetry.mark_ready(True, "ok")
         try:
             with sharding.mesh_scope(self.mesh):
                 for step_i in range(start, num_steps):
+                    t_step0 = time.perf_counter()
                     tr = self.tracer.start_trace(
                         "train_step", label=f"step {step_i + 1}"
                     )
@@ -274,18 +317,40 @@ class Trainer:
                             )
                     else:
                         consecutive_skipped = 0
+                    ckpt_s = 0.0
                     if (step_i + 1) % cfg.train.checkpoint_every == 0:
-                        with tr.span("checkpoint_save"):
+                        with tr.span("checkpoint_save") as sp_ckpt:
                             self.ckpt.save(step_i + 1, self.state)
+                        ckpt_s = sp_ckpt.dur_ns / 1e9
                     tr.finish(
                         step=step_i + 1,
                         skipped=int(host_metrics.get("skipped", 0)),
                     )
+                    if self.telemetry is not None:
+                        # May raise AnomalyHalt under --on-anomaly=halt
+                        # (the finally below still releases resources).
+                        self.telemetry.record_step(
+                            step_i + 1, host_metrics,
+                            step_seconds=time.perf_counter() - t_step0,
+                            data_s=sp_data.dur_ns / 1e9,
+                            dispatch_s=sp_disp.dur_ns / 1e9,
+                            sync_s=sp_sync.dur_ns / 1e9,
+                            checkpoint_s=ckpt_s,
+                            flops=telemetry_lib.batch_flops(
+                                cfg, host_batch
+                            ),
+                            lr=float(self._lr_fn(step_i + 1)),
+                        )
         finally:
             if self.watchdog is not None:
                 self.watchdog.set_active(False)
             if prefetcher is not None:
                 prefetcher.close()
+            # /readyz must stop saying ready once the step loop is
+            # gone — completed, crashed, or halted (record_step already
+            # set the more specific "halted: <kind>" reason; keep it).
+            if self.telemetry is not None and self.telemetry._ready:
+                self.telemetry.mark_ready(False, "step loop exited")
         final_step = int(jax.device_get(self.state.step))
         if final_step > 0 and self.ckpt.latest_step() != final_step:
             self.ckpt.save(final_step, self.state, force=True)

@@ -1,10 +1,19 @@
 """Metrics / logging / observability.
 
-Reference parity: HF Trainer `report_to` (wandb/tensorboard) with loss,
-LR, grad-norm, it/s, plus `rank0_print` (SURVEY.md §5 "Metrics"). Here:
-a structured CSV/JSONL writer plus stdout logging on process 0, tracking
-the north-star metric tokens/sec/chip; TensorBoard/wandb attach via the
-same record dict if present.
+Two layers:
+
+  * `MetricLogger` / `rank0_print` — HF Trainer `report_to` parity
+    (SURVEY.md §5 "Metrics"): a structured JSONL writer plus stdout
+    logging on process 0, tracking the north-star metric
+    tokens/sec/chip; TensorBoard attaches via the same record dict.
+  * A dependency-free **metrics registry** (`Registry`) in the
+    Prometheus data model: Counter / Gauge / Histogram families with
+    labels, one text-exposition renderer, pluggable collectors
+    (process / device-memory), and a small `TelemetryServer` that
+    serves `/metrics` + `/healthz` + `/readyz` over stdlib HTTP.
+    `ServingMetrics` (the serving `/metrics` surface) and the trainer
+    exporter (train/telemetry.py) are both clients of it, so train and
+    serve share one exposition path and one naming discipline.
 """
 
 from __future__ import annotations
@@ -115,39 +124,8 @@ class MetricLogger:
 
 
 # ---------------------------------------------------------------------------
-# Serving metrics (api_server GET /metrics)
+# Metrics registry (Prometheus data model, dependency-free)
 # ---------------------------------------------------------------------------
-
-
-class Histogram:
-    """Fixed-bucket histogram in the Prometheus cumulative-`le` shape.
-
-    Buckets are upper bounds; +Inf is implicit (the total count). Thread
-    safety comes from the owning ServingMetrics lock.
-    """
-
-    def __init__(self, buckets: tuple[float, ...]):
-        self.buckets = tuple(sorted(buckets))
-        self.counts = [0] * len(self.buckets)
-        self.total = 0
-        self.sum = 0.0
-
-    def observe(self, value: float) -> None:
-        self.total += 1
-        self.sum += float(value)
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                self.counts[i] += 1
-
-    def render(self, name: str, out: list[str]) -> None:
-        out.append(f"# TYPE {name} histogram")
-        for b, c in zip(self.buckets, self.counts):
-            # counts are already cumulative (observe touches every
-            # bucket whose bound covers the value)
-            out.append(f'{name}_bucket{{le="{b:g}"}} {c}')
-        out.append(f'{name}_bucket{{le="+Inf"}} {self.total}')
-        out.append(f"{name}_sum {self.sum:.17g}")
-        out.append(f"{name}_count {self.total}")
 
 
 def _escape_label(v: str) -> str:
@@ -155,6 +133,283 @@ def _escape_label(v: str) -> str:
     return (
         v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
     )
+
+
+def _label_str(labelnames: tuple[str, ...],
+               labelvalues: tuple[str, ...]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"'
+        for k, v in zip(labelnames, labelvalues)
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotone counter (one label combination of a family)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock):
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, n: float = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter increment must be >= 0, got {n}")
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Settable gauge (one label combination of a family)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock):
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Histogram:
+    """Fixed-bucket histogram in the Prometheus cumulative-`le` shape.
+
+    Buckets are upper bounds; +Inf is implicit (the total count)."""
+
+    __slots__ = ("buckets", "counts", "total", "sum", "_lock")
+
+    def __init__(self, buckets: tuple[float, ...], lock=None):
+        import threading
+
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * len(self.buckets)
+        self.total = 0
+        self.sum = 0.0
+        self._lock = lock or threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.total += 1
+            self.sum += float(value)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+
+    def render(self, name: str, out: list[str], labels: str = "") -> None:
+        # Bucket lines carry the family labels plus le; counts are
+        # already cumulative (observe touches every bucket whose bound
+        # covers the value).
+        with self._lock:
+            counts, total, s = list(self.counts), self.total, self.sum
+        pre = labels[:-1] + "," if labels else "{"
+        for b, c in zip(self.buckets, counts):
+            out.append(f'{name}_bucket{pre}le="{b:g}"}} {c}')
+        out.append(f'{name}_bucket{pre}le="+Inf"}} {total}')
+        out.append(f"{name}_sum{labels} {s:.17g}")
+        out.append(f"{name}_count{labels} {total}")
+
+
+class MetricFamily:
+    """One named metric family: a fixed type + label names, holding one
+    child (Counter/Gauge/Histogram) per label-values combination. A
+    family declared with no label names IS its single child — inc/set/
+    observe proxy to it, so unlabeled metrics need no `.labels()` hop."""
+
+    def __init__(self, name: str, mtype: str,
+                 labelnames: tuple[str, ...] = (),
+                 buckets: tuple[float, ...] | None = None,
+                 lock=None):
+        import threading
+
+        self.name = name
+        self.mtype = mtype
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        self._lock = lock or threading.Lock()
+        self._children: dict[tuple[str, ...], Any] = {}
+        if not self.labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        if self.mtype == "counter":
+            return Counter(self._lock)
+        if self.mtype == "gauge":
+            return Gauge(self._lock)
+        return Histogram(self.buckets or PER_TOKEN_BUCKETS, self._lock)
+
+    def labels(self, **kv: str):
+        """Child for one label-values combination (created on first
+        touch). Label names must match the family declaration exactly."""
+        if tuple(sorted(kv)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(kv)}, family declares "
+                f"{sorted(self.labelnames)}"
+            )
+        key = tuple(str(kv[k]) for k in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+        return child
+
+    # Unlabeled-family conveniences.
+    def inc(self, n: float = 1) -> None:
+        self._children[()].inc(n)
+
+    def set(self, value: float) -> None:
+        self._children[()].set(value)
+
+    def observe(self, value: float) -> None:
+        self._children[()].observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._children[()].value
+
+    def render(self, out: list[str]) -> None:
+        with self._lock:
+            children = sorted(self._children.items())
+        out.append(f"# TYPE {self.name} {self.mtype}")
+        for key, child in children:
+            labels = _label_str(self.labelnames, key)
+            if self.mtype == "histogram":
+                child.render(self.name, out, labels)
+            else:
+                # Full precision (%g rounds to 6 significant digits,
+                # which quantizes large counters and hides increments).
+                out.append(f"{self.name}{labels} {child.value:.17g}")
+
+
+class Registry:
+    """Named metric families + text exposition + collectors.
+
+    `prefix` is prepended (with `_`) to every family name unless the
+    family is created with `raw_name=True` — used for families shared
+    verbatim across registries (e.g. `oryx_anomaly_total`, the same
+    series name whether train or serve fired it). One family per name,
+    enforced: re-declaring with a different type/labels/buckets raises,
+    so one exposition can never carry duplicate families.
+
+    Collectors are zero-arg callables run at the top of `render()` —
+    they refresh gauges whose truth lives elsewhere (process RSS, HBM
+    in use) so scrapes always see current values without a background
+    sampler thread."""
+
+    def __init__(self, prefix: str = ""):
+        import threading
+
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._families: dict[str, MetricFamily] = {}
+        self._info_names: set[str] = set()
+        self._collectors: list[Any] = []
+
+    def full_name(self, name: str, raw_name: bool = False) -> str:
+        return name if (raw_name or not self.prefix) \
+            else f"{self.prefix}_{name}"
+
+    def _family(self, name: str, mtype: str,
+                labelnames: tuple[str, ...] = (),
+                buckets: tuple[float, ...] | None = None,
+                raw_name: bool = False) -> MetricFamily:
+        full = self.full_name(name, raw_name)
+        with self._lock:
+            fam = self._families.get(full)
+            if fam is None:
+                fam = self._families[full] = MetricFamily(
+                    full, mtype, labelnames, buckets
+                )
+                return fam
+        want = (mtype, tuple(labelnames),
+                tuple(sorted(buckets)) if buckets else fam.buckets)
+        have = (fam.mtype, fam.labelnames, fam.buckets)
+        if want != have:
+            raise ValueError(
+                f"metric family {full!r} re-declared as {want}, "
+                f"already registered as {have}"
+            )
+        return fam
+
+    def counter(self, name: str, labelnames: tuple[str, ...] = (),
+                *, raw_name: bool = False) -> MetricFamily:
+        return self._family(name, "counter", labelnames,
+                            raw_name=raw_name)
+
+    def gauge(self, name: str, labelnames: tuple[str, ...] = (),
+              *, raw_name: bool = False) -> MetricFamily:
+        return self._family(name, "gauge", labelnames, raw_name=raw_name)
+
+    def histogram(self, name: str,
+                  buckets: tuple[float, ...],
+                  labelnames: tuple[str, ...] = (),
+                  *, raw_name: bool = False) -> MetricFamily:
+        return self._family(name, "histogram", labelnames, buckets,
+                            raw_name=raw_name)
+
+    def info(self, name: str, labels: dict[str, str],
+             *, raw_name: bool = False) -> None:
+        """Info metric: a gauge pinned to 1 whose labels carry build /
+        deploy identity (git revision, engine, model). Re-setting an
+        INFO family replaces its labels (identity, not a series per
+        value); replacing a non-info family of the same name raises —
+        the no-duplicate-family invariant holds on this path too."""
+        full = self.full_name(name, raw_name)
+        with self._lock:
+            if full in self._families and full not in self._info_names:
+                raise ValueError(
+                    f"metric family {full!r} already registered as a "
+                    f"{self._families[full].mtype}; info() would "
+                    "silently replace it"
+                )
+            self._info_names.add(full)
+            self._families[full] = fam = MetricFamily(
+                full, "gauge",
+                tuple(sorted(str(k) for k in labels)),
+            )
+        fam.labels(**{str(k): str(v) for k, v in labels.items()}).set(1)
+
+    def register_collector(self, fn) -> None:
+        with self._lock:
+            self._collectors.append(fn)
+
+    def existing(self, name: str,
+                 *, raw_name: bool = False) -> MetricFamily | None:
+        with self._lock:
+            return self._families.get(self.full_name(name, raw_name))
+
+    def get(self, name: str, *, raw_name: bool = False) -> float:
+        """Current value of an unlabeled counter/gauge, 0 when never
+        registered — or when the name is labeled or a histogram, which
+        have no single scalar value (test/bench convenience)."""
+        with self._lock:
+            fam = self._families.get(self.full_name(name, raw_name))
+        if fam is None or fam.labelnames or fam.mtype == "histogram":
+            return 0.0
+        return fam.value
+
+    def render(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass  # a broken collector must never break the scrape
+        with self._lock:
+            families = sorted(self._families.items())
+        out: list[str] = []
+        for _, fam in families:
+            fam.render(out)
+        return "\n".join(out) + "\n"
 
 
 # Default latency bucket ladders (seconds): TTFT spans prefill compiles;
@@ -165,80 +420,213 @@ PER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5)
 
 
+# ---------------------------------------------------------------------------
+# Collectors (process / runtime / device memory)
+# ---------------------------------------------------------------------------
+
+
+def register_process_collector(reg: Registry) -> None:
+    """Process/runtime gauges in the standard Prometheus shapes (CPU
+    seconds, RSS, open fds, thread count), refreshed at scrape time.
+    Registered THROUGH the registry so they carry its prefix — two
+    exporters on one host must not collide on bare `process_*` names."""
+    import threading
+
+    start = time.time()
+    cpu = reg.gauge("process_cpu_seconds_total")
+    rss = reg.gauge("process_resident_memory_bytes")
+    fds = reg.gauge("process_open_fds")
+    thr = reg.gauge("process_threads")
+    reg.gauge("process_start_time_seconds").set(start)
+
+    def collect() -> None:
+        t = os.times()
+        cpu.set(t.user + t.system)
+        try:
+            with open("/proc/self/statm") as f:
+                rss.set(int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE"))
+        except (OSError, ValueError):
+            pass  # non-Linux: RSS stays at its last (or zero) value
+        try:
+            fds.set(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        thr.set(threading.active_count())
+
+    reg.register_collector(collect)
+
+
+def register_device_memory_collector(reg: Registry) -> None:
+    """Device (HBM) telemetry at scrape time, shared by train and serve:
+
+      hbm_live_bytes   — sum of nbytes over `jax.live_arrays()`: what
+                         the framework is actually holding (params,
+                         optimizer state, KV pages).
+      hbm_bytes_in_use / hbm_peak_bytes / hbm_limit_bytes — the
+                         allocator's view via `device.memory_stats()`
+                         (absent on backends that don't expose it, e.g.
+                         CPU and the axon remote transport — those
+                         gauges then hold 0 while live_bytes stays
+                         real)."""
+    live = reg.gauge("hbm_live_bytes")
+    in_use = reg.gauge("hbm_bytes_in_use")
+    peak = reg.gauge("hbm_peak_bytes")
+    limit = reg.gauge("hbm_limit_bytes")
+
+    def collect() -> None:
+        live.set(sum(
+            getattr(a, "nbytes", 0) for a in jax.live_arrays()
+        ))
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        in_use.set(stats.get("bytes_in_use", 0))
+        peak.set(stats.get("peak_bytes_in_use", 0))
+        limit.set(stats.get("bytes_limit", 0))
+
+    reg.register_collector(collect)
+
+
+# ---------------------------------------------------------------------------
+# Serving metrics (api_server GET /metrics)
+# ---------------------------------------------------------------------------
+
+
 class ServingMetrics:
-    """Thread-safe counters / gauges / histograms for the serving path,
-    rendered in the Prometheus text exposition format.
+    """Thread-safe counters / gauges / histograms for the serving path —
+    a name-on-first-touch client of `Registry`, so the scheduler and the
+    window batcher never pre-register, while `GET /metrics` renders the
+    shared Prometheus text exposition (device-memory gauges included)."""
 
-    The scheduler (serve/scheduler.py) and the window batcher both feed
-    one instance; `GET /metrics` renders it. Metric names are created on
-    first touch so callers never pre-register."""
-
-    def __init__(self, prefix: str = "oryx_serving"):
-        import threading
-
+    def __init__(self, prefix: str = "oryx_serving",
+                 registry: Registry | None = None):
         self.prefix = prefix
-        self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}
-        self._gauges: dict[str, float] = {}
-        # name -> label dict, rendered as a constant-1 gauge with the
-        # labels attached (the Prometheus "info metric" convention,
-        # e.g. oryx_serving_build_info{revision=...,engine=...} 1).
-        self._infos: dict[str, dict[str, str]] = {}
-        self._hists: dict[str, Histogram] = {
-            "ttft_seconds": Histogram(TTFT_BUCKETS),
-            "time_per_output_token_seconds": Histogram(PER_TOKEN_BUCKETS),
-        }
+        self.registry = registry or Registry(prefix=prefix)
+        # Pre-created so the latency ladders render (at zero) from the
+        # first scrape, before any request flowed.
+        self.registry.histogram("ttft_seconds", TTFT_BUCKETS)
+        self.registry.histogram(
+            "time_per_output_token_seconds", PER_TOKEN_BUCKETS
+        )
+        register_device_memory_collector(self.registry)
 
-    def inc(self, name: str, n: float = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+    def inc(self, name: str, n: float = 1,
+            labels: dict[str, str] | None = None) -> None:
+        if labels:
+            self.registry.counter(
+                name, tuple(sorted(labels))
+            ).labels(**labels).inc(n)
+        else:
+            self.registry.counter(name).inc(n)
 
     def set_gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self._gauges[name] = float(value)
+        self.registry.gauge(name).set(value)
 
     def set_info(self, name: str, labels: dict[str, str]) -> None:
         """Info metric: a gauge pinned to 1 whose labels carry build /
         deploy identity (git revision, engine, model)."""
-        with self._lock:
-            self._infos[name] = {str(k): str(v) for k, v in labels.items()}
+        self.registry.info(name, labels)
 
     def observe(self, name: str, value: float,
                 buckets: tuple[float, ...] = PER_TOKEN_BUCKETS) -> None:
-        with self._lock:
-            h = self._hists.get(name)
-            if h is None:
-                h = self._hists[name] = Histogram(buckets)
-            h.observe(value)
+        # `buckets` is creation-only (first touch wins): callers pass a
+        # ladder defensively without knowing whether the family exists.
+        fam = self.registry.existing(name)
+        if fam is None:
+            fam = self.registry.histogram(name, buckets)
+        fam.observe(value)
 
     def get(self, name: str) -> float:
         """Current counter (or gauge) value, 0 when never touched."""
-        with self._lock:
-            if name in self._counters:
-                return self._counters[name]
-            return self._gauges.get(name, 0.0)
+        return self.registry.get(name)
 
     def render(self) -> str:
-        out: list[str] = []
-        with self._lock:
-            # Full precision (%g rounds to 6 significant digits, which
-            # quantizes large counters and hides small increments).
-            for name in sorted(self._counters):
-                full = f"{self.prefix}_{name}"
-                out.append(f"# TYPE {full} counter")
-                out.append(f"{full} {self._counters[name]:.17g}")
-            for name in sorted(self._gauges):
-                full = f"{self.prefix}_{name}"
-                out.append(f"# TYPE {full} gauge")
-                out.append(f"{full} {self._gauges[name]:.17g}")
-            for name in sorted(self._infos):
-                full = f"{self.prefix}_{name}"
-                labels = ",".join(
-                    f'{k}="{_escape_label(v)}"'
-                    for k, v in sorted(self._infos[name].items())
-                )
-                out.append(f"# TYPE {full} gauge")
-                out.append(f"{full}{{{labels}}} 1")
-            for name in sorted(self._hists):
-                self._hists[name].render(f"{self.prefix}_{name}", out)
-        return "\n".join(out) + "\n"
+        return self.registry.render()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry HTTP server (/metrics + /healthz + /readyz)
+# ---------------------------------------------------------------------------
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+class TelemetryServer:
+    """Background stdlib HTTP endpoint around one Registry:
+
+      GET /metrics — the registry's Prometheus text exposition
+      GET /healthz — 200 while the process is up (liveness)
+      GET /readyz  — 200/503 from `ready_check`, a zero-arg callable
+                     returning (ready, reason); load balancers and CI
+                     gates probe this instead of driving real traffic.
+
+    Binds at construction (port 0 = ephemeral, see `.port`); `start()`
+    begins serving on a daemon thread; `close()` shuts down."""
+
+    def __init__(self, registry: Registry, *, host: str = "127.0.0.1",
+                 port: int = 0, ready_check=None):
+        import json as json_lib
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.registry = registry
+        self.ready_check = ready_check
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet access log
+                pass
+
+            def _send(self, code: int, data: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, outer.registry.render().encode(),
+                               PROMETHEUS_CONTENT_TYPE)
+                elif self.path == "/healthz":
+                    self._send(200, b'{"status": "ok"}\n',
+                               "application/json")
+                elif self.path == "/readyz":
+                    ready, reason = True, "ok"
+                    if outer.ready_check is not None:
+                        try:
+                            ready, reason = outer.ready_check()
+                        except Exception as e:
+                            ready, reason = False, f"{type(e).__name__}: {e}"
+                    body = json_lib.dumps({
+                        "ready": bool(ready), "reason": reason,
+                    }).encode() + b"\n"
+                    self._send(200 if ready else 503, body,
+                               "application/json")
+                else:
+                    self._send(404, b'{"error": "not found"}\n',
+                               "application/json")
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "TelemetryServer":
+        import threading
+
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="telemetry-server",
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

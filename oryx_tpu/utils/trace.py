@@ -421,6 +421,16 @@ class StallWatchdog:
                 self._armed = True
             self._active = active
 
+    def stalled(self) -> bool:
+        """True while in-flight work has gone `deadline_s` without a
+        beat — the /readyz signal (a stalled engine must stop taking
+        load-balancer traffic even though the process is alive)."""
+        with self._lock:
+            return (
+                self._active
+                and time.perf_counter() - self._last_beat > self.deadline_s
+            )
+
     def _run(self) -> None:
         interval = max(0.01, min(self.deadline_s / 4, 1.0))
         while not self._stop.wait(interval):

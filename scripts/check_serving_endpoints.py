@@ -3,11 +3,16 @@
 Boots a short-lived CPU server (tiny geometry, continuous engine),
 pushes one request through it, then checks:
 
+  * GET /healthz — 200 liveness;
+  * GET /readyz — 200 with ready:true while the scheduler loop is
+    alive (the load-balancer probe that replaces spending a real
+    completion);
   * GET /metrics — exact Prometheus content type
     (`text/plain; version=0.0.4`), every metric name carries the
     `oryx_serving_` prefix (an unprefixed name would collide in any
-    shared Prometheus), and the build_info gauge is present with
-    revision + engine labels;
+    shared Prometheus; the cross-source `oryx_anomaly_` family is the
+    one deliberate exception), the build_info gauge is present with
+    revision + engine labels, and the HBM gauges exist;
   * GET /debug/requests — valid JSON, the request we sent is recorded;
   * GET /debug/trace?id= — valid Chrome trace JSON with a non-empty
     traceEvents list covering prefill and decode.
@@ -64,6 +69,15 @@ def main() -> None:
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{srv.server_address[1]}"
     try:
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            if json.load(r) != {"status": "ok"}:
+                fail("/healthz body is not {status: ok}")
+        with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+            ready = json.load(r)
+            if r.status != 200 or ready.get("ready") is not True:
+                fail(f"/readyz with a live scheduler: want 200/true, "
+                     f"got {r.status} {ready}")
+
         req = urllib.request.Request(
             base + "/v1/chat/completions",
             data=json.dumps({
@@ -87,10 +101,13 @@ def main() -> None:
         bad = [
             line for line in metrics_text.splitlines()
             if line and not line.startswith("#")
-            and not line.startswith("oryx_serving_")
+            and not line.startswith(("oryx_serving_", "oryx_anomaly_"))
         ]
         if bad:
             fail(f"unprefixed metric names: {bad[:5]}")
+        if "oryx_serving_hbm_live_bytes" not in metrics_text:
+            fail("device-memory gauge oryx_serving_hbm_live_bytes "
+                 "missing from /metrics")
         if not re.search(
             r'^oryx_serving_build_info\{[^}]*engine="[^"]+"[^}]*\} 1$',
             metrics_text, re.M,
@@ -122,8 +139,9 @@ def main() -> None:
         if srv.scheduler is not None:
             srv.scheduler.close()
         srv.shutdown()
-    print("serving endpoints OK: /metrics (content-type, prefix, "
-          "build_info) + /debug/requests + /debug/trace")
+    print("serving endpoints OK: /healthz + /readyz + /metrics "
+          "(content-type, prefix, build_info, hbm gauges) + "
+          "/debug/requests + /debug/trace")
 
 
 if __name__ == "__main__":

@@ -10,18 +10,19 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 300 = the 274 recorded at PR 1 plus the observability suite added in
-# PR 2 (trace/watchdog, debug endpoints, xplane join, conftest guard;
-# 305 observed with a warm /tmp/jax_cache), with headroom for the 4
-# trainer-family tests that flip with cache state (see CHANGES.md).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-300}
+# 330 = the 300 recorded at PR 2 plus the telemetry suite added in
+# PR 3 (metrics registry, anomaly detectors, trainer exporter; 340
+# observed with a warm /tmp/jax_cache and the 6 donation-quirk tests
+# xfailed by conftest — see CHANGES.md), with headroom for
+# load-dependent flakes (bench-supervisor probes on one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-330}
 
 # --- ROADMAP.md "Tier-1 verify", verbatim -----------------------------------
-bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
+bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 rc=$?
 # ----------------------------------------------------------------------------
 
-dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+dots=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo "tier-1: $dots passed (baseline $BASELINE_DOTS, pytest rc=$rc)"
 if [ "$dots" -lt "$BASELINE_DOTS" ]; then
     echo "TIER-1 REGRESSION: $dots < baseline $BASELINE_DOTS" >&2
@@ -30,12 +31,25 @@ fi
 echo "tier-1 OK: no regression vs recorded baseline"
 
 # --- serving observability surface ------------------------------------------
-# Boot a short-lived CPU server and verify /metrics (content type,
-# oryx_serving_ name prefix, build_info gauge) and the /debug flight
-# recorder + trace endpoints are well-formed.
-echo "checking serving endpoints (/metrics, /debug/requests, /debug/trace)"
+# Boot a short-lived CPU server and verify /healthz + /readyz, /metrics
+# (content type, oryx_serving_ name prefix, build_info gauge, HBM
+# gauges) and the /debug flight recorder + trace endpoints are
+# well-formed.
+echo "checking serving endpoints (/healthz, /readyz, /metrics, /debug/*)"
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/check_serving_endpoints.py; then
     echo "SERVING ENDPOINT CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- trainer telemetry exporter ---------------------------------------------
+# Short CPU train with the /metrics exporter attached: /readyz must flip
+# 503 -> 200 while the step loop runs, and the exposition must be
+# well-formed (oryx_train_ prefix, no duplicate families, the
+# loss/tokens_per_sec/mfu/goodput_ratio/hbm_live_bytes series present).
+echo "checking trainer telemetry exporter (/metrics, /healthz, /readyz)"
+if ! timeout -k 10 400 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_train_telemetry.py; then
+    echo "TRAIN TELEMETRY CHECK FAILED" >&2
     exit 1
 fi

@@ -1,0 +1,228 @@
+"""Anomaly detectors (utils/anomaly.py): synthetic NaN / spike /
+collapse streams fire exactly-one structured events (JSONL sink +
+oryx_anomaly_total{kind=} counter), a steady stream fires nothing, and
+the SLO detectors re-arm with hysteresis."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from oryx_tpu.utils.anomaly import (
+    AnomalyHalt,
+    AnomalyMonitor,
+    AnomalyThresholds,
+)
+from oryx_tpu.utils.metrics import Registry
+
+
+def _events(path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_nan_loss_stream_exactly_one_event(tmp_path):
+    """Acceptance: a synthetic NaN-loss stream -> exactly one nan_loss
+    event in events.jsonl plus oryx_anomaly_total{kind="nan_loss"} == 1."""
+    path = tmp_path / "events.jsonl"
+    reg = Registry(prefix="oryx_train")
+    mon = AnomalyMonitor(source="train", events_path=str(path), registry=reg)
+    for step in range(1, 21):
+        loss = 2.0 if step < 5 else float("nan")
+        mon.observe_train_step(step, loss)
+    evs = _events(path)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["kind"] == "nan_loss"
+    assert ev["source"] == "train"
+    assert ev["value"] is None  # NaN serializes as RFC-strict null
+    assert ev["context"]["step"] == 5
+    assert "time_unix_s" in ev and "message" in ev
+    assert 'oryx_anomaly_total{kind="nan_loss"} 1' in reg.render()
+    mon.close()
+
+
+def test_nan_loss_rearms_after_recovery(tmp_path):
+    path = tmp_path / "events.jsonl"
+    mon = AnomalyMonitor(events_path=str(path))
+    stream = [1.0, float("nan"), float("nan"), 1.0, float("inf")]
+    for i, loss in enumerate(stream):
+        mon.observe_train_step(i, loss)
+    kinds = [e["kind"] for e in _events(path)]
+    assert kinds == ["nan_loss", "nan_loss"]  # one per episode, not per step
+
+
+def test_steady_stream_no_false_positives(tmp_path):
+    """A noisy-but-healthy run must stay silent: loss wandering within
+    2x, grad norms within 3x, throughput within 30%."""
+    path = tmp_path / "events.jsonl"
+    mon = AnomalyMonitor(events_path=str(path))
+    rng = np.random.default_rng(0)
+    for step in range(200):
+        fired = mon.observe_train_step(
+            step,
+            loss=2.0 + 0.3 * rng.standard_normal(),
+            grad_norm=1.0 + 0.2 * abs(rng.standard_normal()),
+            tokens_per_sec=1000.0 * (1 + 0.15 * rng.standard_normal()),
+        )
+        assert fired == []
+    assert not path.exists() or _events(path) == []
+    assert mon.total == 0
+
+
+def test_loss_spike_one_shot():
+    mon = AnomalyMonitor(thresholds=AnomalyThresholds(min_window=4))
+    for step in range(10):
+        assert mon.observe_train_step(step, 1.0) == []
+    fired = mon.observe_train_step(10, 50.0)
+    assert [e.kind for e in fired] == ["loss_spike"]
+    assert fired[0].value == 50.0
+    assert fired[0].threshold == pytest.approx(3.0)  # 3x median 1.0
+    # Still elevated: no re-fire until it drops back under the line.
+    assert mon.observe_train_step(11, 49.0) == []
+
+
+def test_cold_start_spike_silent():
+    """min_window unmet: a wild early loss must not alert (step-1
+    losses are routinely 10x the converged value)."""
+    mon = AnomalyMonitor(thresholds=AnomalyThresholds(min_window=8))
+    assert mon.observe_train_step(0, 1.0) == []
+    assert mon.observe_train_step(1, 100.0) == []
+
+
+def test_grad_norm_explosion():
+    mon = AnomalyMonitor(thresholds=AnomalyThresholds(min_window=4))
+    for step in range(8):
+        mon.observe_train_step(step, 1.0, grad_norm=0.5)
+    fired = mon.observe_train_step(8, 1.0, grad_norm=500.0)
+    assert [e.kind for e in fired] == ["grad_norm_explosion"]
+
+
+def test_throughput_collapse_does_not_rebaseline():
+    """Collapsed samples must NOT enter the rolling window — otherwise
+    the median drifts down onto the collapsed level and a permanently
+    degraded run stops looking anomalous."""
+    mon = AnomalyMonitor(thresholds=AnomalyThresholds(min_window=4))
+    for step in range(10):
+        mon.observe_train_step(step, 1.0, tokens_per_sec=1000.0)
+    fired = mon.observe_train_step(10, 1.0, tokens_per_sec=10.0)
+    assert [e.kind for e in fired] == ["throughput_collapse"]
+    for step in range(11, 40):
+        assert mon.observe_train_step(step, 1.0, tokens_per_sec=10.0) == []
+    # Window median still reflects the healthy regime.
+    assert mon._tput.median() == pytest.approx(1000.0)
+    # Recovery re-arms; a second collapse fires a second event.
+    mon.observe_train_step(40, 1.0, tokens_per_sec=900.0)
+    fired = mon.observe_train_step(41, 1.0, tokens_per_sec=5.0)
+    assert [e.kind for e in fired] == ["throughput_collapse"]
+    assert mon.counts["throughput_collapse"] == 2
+
+
+def test_ttft_slo_disabled_by_default_and_rearms():
+    mon = AnomalyMonitor(source="serve")
+    assert mon.observe_ttft(999.0) == []  # no SLO configured -> silent
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(ttft_slo_s=1.0),
+    )
+    assert [e.kind for e in mon.observe_ttft(2.0, request_id="r1")] == [
+        "ttft_slo"
+    ]
+    assert mon.observe_ttft(3.0) == []  # still breached: one per episode
+    assert mon.observe_ttft(0.5) == []  # compliant -> re-arm
+    assert [e.kind for e in mon.observe_ttft(2.0)] == ["ttft_slo"]
+
+
+def test_queue_depth_slo_one_rearms_on_drain():
+    """slo=1 regression: the drain-side observation (depth 0) must
+    re-arm the detector — with submit-only feeding it never could."""
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(queue_depth_slo=1),
+    )
+    assert [e.kind for e in mon.observe_queue_depth(2)] == [
+        "queue_depth_slo"
+    ]
+    assert mon.observe_queue_depth(0) == []  # scheduler drained
+    assert [e.kind for e in mon.observe_queue_depth(2)] == [
+        "queue_depth_slo"
+    ]
+
+
+def test_window_engine_rejects_slo_flags():
+    """The window batcher never feeds the SLO detectors; accepting the
+    flags there would look armed while every breach went unobserved."""
+    from oryx_tpu.serve import api_server
+
+    with pytest.raises(ValueError, match="continuous"):
+        api_server.build_server(None, engine="window", ttft_slo=1.0)
+    with pytest.raises(ValueError, match="continuous"):
+        api_server.build_server(None, engine="window", queue_depth_slo=4)
+
+
+def test_queue_depth_hysteresis():
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(queue_depth_slo=10),
+    )
+    assert [e.kind for e in mon.observe_queue_depth(11)] == [
+        "queue_depth_slo"
+    ]
+    assert mon.observe_queue_depth(12) == []
+    # Dropping just under the SLO does not re-arm (oscillation guard)...
+    assert mon.observe_queue_depth(9) == []
+    assert mon.observe_queue_depth(11) == []
+    # ...draining to half does.
+    assert mon.observe_queue_depth(5) == []
+    assert [e.kind for e in mon.observe_queue_depth(11)] == [
+        "queue_depth_slo"
+    ]
+
+
+def test_event_jsonl_is_rfc_strict(tmp_path):
+    """Every sink line must json.loads cleanly (jq/JSON.parse consumers)
+    even when the payload is the non-finite value itself."""
+    path = tmp_path / "events.jsonl"
+    mon = AnomalyMonitor(events_path=str(path))
+    mon.observe_train_step(1, float("inf"))
+    raw = path.read_text()
+    assert "Infinity" not in raw and "NaN" not in raw
+    assert _events(path)[0]["value"] is None
+
+
+def test_halt_policy_via_train_telemetry(tmp_path):
+    """--on-anomaly=halt: the first anomaly raises AnomalyHalt out of
+    record_step (and the exporter flips /readyz not-ready)."""
+    from oryx_tpu.train.telemetry import TrainTelemetry
+
+    tel = TrainTelemetry(
+        port=None, events_path=str(tmp_path / "ev.jsonl"),
+        on_anomaly="halt",
+    )
+    tel.mark_ready()
+    tel.record_step(1, {"loss": 2.0, "num_tokens": 10}, step_seconds=0.1)
+    with pytest.raises(AnomalyHalt) as ei:
+        tel.record_step(
+            2, {"loss": float("nan"), "num_tokens": 10}, step_seconds=0.1
+        )
+    assert ei.value.events[0].kind == "nan_loss"
+    assert tel._ready is False and "halted" in tel._ready_reason
+    assert len(_events(tmp_path / "ev.jsonl")) == 1
+    tel.close()
+
+    with pytest.raises(ValueError, match="on_anomaly"):
+        TrainTelemetry(port=None, on_anomaly="explode")
+
+
+def test_warn_policy_keeps_training(tmp_path):
+    from oryx_tpu.train.telemetry import TrainTelemetry
+
+    tel = TrainTelemetry(port=None, on_anomaly="warn")
+    evs = tel.record_step(
+        1, {"loss": float("nan"), "num_tokens": 10}, step_seconds=0.1
+    )
+    assert [e.kind for e in evs] == ["nan_loss"]
+    assert math.isnan(tel.registry.get("loss"))
+    assert 'oryx_anomaly_total{kind="nan_loss"} 1' in tel.registry.render()
+    tel.close()

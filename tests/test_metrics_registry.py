@@ -1,0 +1,266 @@
+"""Metrics registry (utils/metrics.py): exposition well-formedness,
+label escaping, histogram bucket math, concurrency, duplicate-family
+rejection, collectors, and the TelemetryServer HTTP surface — the
+backbone both ServingMetrics and the trainer exporter sit on."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oryx_tpu.utils.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    Registry,
+    ServingMetrics,
+    TelemetryServer,
+    register_device_memory_collector,
+    register_process_collector,
+)
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][\w:]*)(\{[^}]*\})? (-?[\d.e+-]+|[+-]?inf|nan)$"
+)
+
+
+def parse_exposition(text: str) -> dict[str, float]:
+    """Assert Prometheus text well-formedness; return sample map with
+    labels folded into the key."""
+    values = {}
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = re.match(r"^# TYPE (\S+) (counter|gauge|histogram)$", line)
+            assert m, line
+            assert m.group(1) not in types, f"duplicate family {line!r}"
+            types[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        values[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return values
+
+
+def test_counter_gauge_prefix_and_get():
+    r = Registry(prefix="oryx_test")
+    r.counter("reqs").inc()
+    r.counter("reqs").inc(2.5)
+    r.gauge("depth").set(7)
+    assert r.get("reqs") == 3.5
+    assert r.get("depth") == 7
+    assert r.get("never_touched") == 0.0
+    v = parse_exposition(r.render())
+    assert v["oryx_test_reqs"] == 3.5
+    assert v["oryx_test_depth"] == 7
+
+
+def test_raw_name_skips_prefix():
+    r = Registry(prefix="oryx_train")
+    r.counter("oryx_anomaly_total", ("kind",), raw_name=True).labels(
+        kind="nan_loss"
+    ).inc()
+    v = parse_exposition(r.render())
+    assert v['oryx_anomaly_total{kind="nan_loss"}'] == 1
+
+
+def test_negative_counter_increment_rejected():
+    r = Registry()
+    with pytest.raises(ValueError, match=">= 0"):
+        r.counter("c").inc(-1)
+
+
+def test_label_escaping():
+    r = Registry(prefix="p")
+    r.gauge("g", ("path",)).labels(path='a\\b"c\nd').set(1)
+    text = r.render()
+    assert 'path="a\\\\b\\"c\\nd"' in text
+    # Escaped value stays on ONE line (the newline must not split it).
+    assert len([l for l in text.splitlines() if l.startswith("p_g{")]) == 1
+
+
+def test_label_names_must_match_declaration():
+    r = Registry()
+    fam = r.counter("c", ("kind",))
+    with pytest.raises(ValueError, match="declares"):
+        fam.labels(other="x")
+
+
+def test_histogram_bucket_math():
+    r = Registry(prefix="h")
+    hist = r.histogram("lat", (0.1, 1.0, 10.0))
+    for x in (0.05, 0.5, 0.5, 5.0, 50.0):
+        hist.observe(x)
+    v = parse_exposition(r.render())
+    # Cumulative le-buckets; +Inf == total count; exact sum.
+    assert v['h_lat_bucket{le="0.1"}'] == 1
+    assert v['h_lat_bucket{le="1"}'] == 3
+    assert v['h_lat_bucket{le="10"}'] == 4
+    assert v['h_lat_bucket{le="+Inf"}'] == 5
+    assert v["h_lat_count"] == 5
+    assert v["h_lat_sum"] == pytest.approx(56.05)
+
+
+def test_histogram_with_labels_renders_per_child():
+    r = Registry()
+    fam = r.histogram("lat", (1.0,), ("engine",))
+    fam.labels(engine="a").observe(0.5)
+    fam.labels(engine="b").observe(2.0)
+    v = parse_exposition(r.render())
+    assert v['lat_bucket{engine="a",le="1"}'] == 1
+    assert v['lat_bucket{engine="b",le="1"}'] == 0
+    assert v['lat_count{engine="a"}'] == 1
+    assert v['lat_count{engine="b"}'] == 1
+
+
+def test_duplicate_family_rejected():
+    r = Registry()
+    r.counter("x")
+    with pytest.raises(ValueError, match="re-declared"):
+        r.gauge("x")
+    with pytest.raises(ValueError, match="re-declared"):
+        r.counter("x", ("kind",))
+    # Identical re-declaration returns the same family.
+    assert r.counter("x") is r.counter("x")
+
+
+def test_concurrent_increments_exact():
+    r = Registry()
+    c = r.counter("hits")
+    fam = r.counter("by_kind", ("kind",))
+    h = r.histogram("obs", (0.5,))
+    N, T = 500, 8
+
+    def work(i):
+        for _ in range(N):
+            c.inc()
+            fam.labels(kind=f"k{i % 2}").inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    v = parse_exposition(r.render())
+    assert v["hits"] == N * T
+    assert v['by_kind{kind="k0"}'] + v['by_kind{kind="k1"}'] == N * T
+    assert v["obs_count"] == N * T
+    assert v['obs_bucket{le="0.5"}'] == N * T
+
+
+def test_info_metric_replaces():
+    r = Registry(prefix="s")
+    r.info("build_info", {"revision": "abc", "engine": "window"})
+    r.info("build_info", {"revision": "def", "engine": "continuous"})
+    v = parse_exposition(r.render())
+    assert v == {
+        's_build_info{engine="continuous",revision="def"}': 1.0
+    }
+    # info() may replace only INFO families — clobbering a live
+    # counter would violate the no-duplicate-family invariant.
+    r.counter("reqs").inc()
+    with pytest.raises(ValueError, match="already registered"):
+        r.info("reqs", {"k": "v"})
+    assert r.get("reqs") == 1
+
+
+def test_get_on_histogram_and_labeled_is_zero():
+    r = Registry()
+    r.histogram("lat", (1.0,)).observe(0.5)
+    r.counter("by_kind", ("kind",)).labels(kind="a").inc()
+    assert r.get("lat") == 0.0  # no single scalar: convenience zero
+    assert r.get("by_kind") == 0.0
+    m = ServingMetrics()
+    assert m.get("ttft_seconds") == 0.0  # pre-created histogram
+
+
+def test_collectors_refresh_on_render_and_never_break_scrape():
+    r = Registry()
+    g = r.gauge("fresh")
+    state = {"n": 0}
+
+    def collect():
+        state["n"] += 1
+        g.set(state["n"])
+
+    def broken():
+        raise RuntimeError("boom")
+
+    r.register_collector(collect)
+    r.register_collector(broken)
+    parse_exposition(r.render())
+    v = parse_exposition(r.render())
+    assert v["fresh"] == 2  # refreshed per render; broken one swallowed
+
+
+def test_process_and_device_memory_collectors():
+    r = Registry(prefix="t")
+    register_process_collector(r)
+    register_device_memory_collector(r)
+    v = parse_exposition(r.render())
+    assert v["t_process_cpu_seconds_total"] > 0
+    assert v["t_process_resident_memory_bytes"] > 0
+    assert v["t_process_threads"] >= 1
+    assert "t_hbm_live_bytes" in v
+    # Forced-host CPU backend: live_arrays is real, allocator stats 0.
+    assert v["t_hbm_live_bytes"] >= 0
+
+
+def test_serving_metrics_compat_surface():
+    """ServingMetrics is now a Registry client; the old call surface
+    (inc/set_gauge/observe/get/render, creation-only buckets) must be
+    byte-compatible for the scheduler and the endpoint gates."""
+    m = ServingMetrics()
+    m.inc("admitted")
+    m.set_gauge("queue_depth", 2)
+    m.observe("ttft_seconds", 0.3)
+    m.observe("ttft_seconds", 0.3, buckets=(99.0,))  # ignored: exists
+    m.set_info("build_info", {"revision": "r", "engine": "e", "model": "m"})
+    assert m.get("admitted") == 1
+    assert m.get("queue_depth") == 2
+    text = m.render()
+    v = parse_exposition(text)
+    assert v["oryx_serving_admitted"] == 1
+    # Both observations recorded into the ORIGINAL ladder (the second
+    # call's bucket arg was ignored, not a new family).
+    assert v['oryx_serving_ttft_seconds_bucket{le="0.5"}'] == 2
+    assert v['oryx_serving_ttft_seconds_bucket{le="+Inf"}'] == 2
+    # Pre-created ladders render from first touch.
+    assert "oryx_serving_time_per_output_token_seconds_count" in v
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert line.startswith(("oryx_serving_", "oryx_anomaly_")), line
+
+
+def test_telemetry_server_endpoints():
+    r = Registry(prefix="oryx_train")
+    r.gauge("loss").set(1.25)
+    ready = {"ok": False}
+    srv = TelemetryServer(
+        r, port=0,
+        ready_check=lambda: (ready["ok"], "ok" if ready["ok"] else "warming"),
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+            v = parse_exposition(resp.read().decode())
+        assert v["oryx_train_loss"] == 1.25
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            assert json.load(resp) == {"status": "ok"}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        assert json.load(ei.value) == {"ready": False, "reason": "warming"}
+        ready["ok"] = True
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as resp:
+            assert json.load(resp)["ready"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.close()
