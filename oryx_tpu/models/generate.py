@@ -485,6 +485,86 @@ def paged_prefill(
     return kv_pages, tok0, pair[:, 0]
 
 
+@partial(jax.jit, static_argnames=("width",))
+def slice_embeds(embeds: jnp.ndarray, start, *, width: int) -> jnp.ndarray:
+    """[B, T, H] → the [B, width, H] window at traced offset `start`.
+
+    One compiled program per (T, width) pair — the chunked-prefill
+    slicer (a host-side `embeds[:, a:b]` would compile one slice per
+    distinct offset). dynamic_slice CLAMPS out-of-range starts, which
+    would silently misalign tokens: callers pad `embeds` so that every
+    chunk start satisfies start + width <= T (`pad_embeds_for_chunks`).
+    """
+    return jax.lax.dynamic_slice_in_dim(embeds, start, width, axis=1)
+
+
+def pad_embeds_for_chunks(embeds: jnp.ndarray, chunk: int) -> jnp.ndarray:
+    """Zero-pad [B, T, H] on the token axis so every `chunk`-wide window
+    starting at an offset < T stays in bounds (see `slice_embeds`). The
+    padded columns prefill garbage KV past each row's real length —
+    slots the decode loop overwrites before reading or masks out,
+    exactly like the right-padding of a bucketed single-shot prefill."""
+    return jnp.pad(embeds, ((0, 0), (0, chunk), (0, 0)))
+
+
+def paged_prefill_chunks(
+    params,
+    cfg: LLMConfig,
+    inputs_embeds: jnp.ndarray,  # [B, T, H] right-padded
+    lengths: jnp.ndarray,  # [B] real TOTAL lengths (incl. cached prefix)
+    block_tables: jnp.ndarray,  # [B, max_pages] int32
+    kv_pages: dict,  # donated through the per-chunk calls
+    start: int,  # shared first logical slot to write (cached prefix end)
+    keys: jax.Array,  # [B] per-row PRNG keys
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    *,
+    prefill_chunk: int,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+):
+    """`paged_prefill` in bounded windows: a host loop dispatching the
+    SAME compiled program over `prefill_chunk`-token embed slices, so a
+    long prompt never occupies the device in one monolithic dispatch
+    (the admission path interleaves these with decode chunks).
+
+    Bit-parity with the single-shot call: valid-slot KV and the sampled
+    first token are identical — chunk grouping only changes the masked
+    garbage past each row's length, and every chunk is seeded with the
+    ORIGINAL per-row key (only the final real chunk's sample and
+    advanced key are kept, which is exactly the single-shot RNG
+    contract: tok0 ~ split(key)[1], key' = split(key)[0]).
+
+    Returns (kv_pages, tok0 [B], advanced keys [B])."""
+    B, T, _ = inputs_embeds.shape
+    host_len = [int(x) for x in np.asarray(lengths)]
+    max_len = max(host_len)
+    embeds = pad_embeds_for_chunks(inputs_embeds, prefill_chunk)
+    tok0 = np.zeros((B,), np.int32)
+    out_keys = list(keys)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    off = start
+    while off < max_len:
+        end = off + prefill_chunk
+        sl = slice_embeds(
+            embeds, jnp.asarray(off - start, jnp.int32),
+            width=prefill_chunk,
+        )
+        kv_pages, tok, nkeys = paged_prefill(
+            params, cfg, sl, jnp.minimum(lengths, end), block_tables,
+            kv_pages, jnp.asarray([off], np.int32), keys,
+            temperature, top_p, top_k,
+            attn_impl=attn_impl, compute_dtype=compute_dtype,
+        )
+        for b, L in enumerate(host_len):
+            if off <= L - 1 < end:  # row b's final real chunk
+                tok0[b] = int(np.asarray(tok)[b])
+                out_keys[b] = nkeys[b]
+        off = end
+    return kv_pages, jnp.asarray(tok0), jnp.stack(out_keys)
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "chunk", "eos", "attn_impl", "compute_dtype"),
@@ -640,6 +720,7 @@ def generate_paged(
     state: PagedState | None = None,
     start: jnp.ndarray | None = None,
     return_state: bool = False,
+    prefill_chunk: int | None = None,
 ):
     """`generate`, but over a paged KV cache in `chunk`-step compiled
     dispatches — the reference driver for the continuous-batching path
@@ -659,7 +740,9 @@ def generate_paged(
     which is the whole point: a short row costs its own pages, not the
     batch max. state/start: prefix KV reuse as in `generate`
     (kv_cache/start); pass the state from the previous turn and prefill
-    only the suffix embeds."""
+    only the suffix embeds. prefill_chunk: prefill in bounded windows
+    via `paged_prefill_chunks` (bit-identical to single-shot; requires a
+    uniform `start` across rows)."""
     B, T, _ = inputs_embeds.shape
     if key is None:
         key = jax.random.key(0)
@@ -706,11 +789,24 @@ def generate_paged(
     top_k = jnp.full((B,), gen_cfg.top_k, jnp.int32)
     key, sk = jax.random.split(key)
     row_keys = jax.random.split(sk, B)
-    state.kv_pages, tok, row_keys = paged_prefill(
-        params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
-        start_vec, row_keys, temp, top_p, top_k,
-        attn_impl=attn_impl, compute_dtype=compute_dtype,
-    )
+    if prefill_chunk:
+        starts = set(int(x) for x in np.asarray(start_vec))
+        if len(starts) != 1:
+            raise ValueError(
+                f"prefill_chunk needs one shared start, got {sorted(starts)}"
+            )
+        state.kv_pages, tok, row_keys = paged_prefill_chunks(
+            params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
+            starts.pop(), row_keys, temp, top_p, top_k,
+            prefill_chunk=prefill_chunk, attn_impl=attn_impl,
+            compute_dtype=compute_dtype,
+        )
+    else:
+        state.kv_pages, tok, row_keys = paged_prefill(
+            params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
+            start_vec, row_keys, temp, top_p, top_k,
+            attn_impl=attn_impl, compute_dtype=compute_dtype,
+        )
     stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
     recent = jnp.full((B, stop_L), -2, jnp.int32)
     finished = jnp.zeros((B,), bool)

@@ -34,6 +34,9 @@ Three pieces live here:
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 
 from oryx_tpu.ops.attention import attention
@@ -45,12 +48,22 @@ class OutOfPagesError(RuntimeError):
 
 
 class PageAllocator:
-    """Host-side free-list allocator over `num_pages` fixed-size pages.
+    """Host-side free-list allocator over `num_pages` fixed-size pages,
+    with per-page REFERENCE COUNTS so pages can be shared.
 
     LIFO recycling: freshly freed pages are handed out first, which
     keeps the hot working set of pages small and stable (good for any
     cache layer under the pool). Allocation is all-or-nothing so a
     failed admission never leaks a partial block table.
+
+    Sharing (the prefix-cache contract, serve/prefix_cache.py): `alloc`
+    hands out pages at refcount 1; `share` adds a holder; `free` /
+    `release` drops one, and the page returns to the free list only at
+    refcount 0. A shared page is IMMUTABLE by convention — a writer
+    that owns only one of several references must copy-on-write first
+    (`copy_pages` below); `refcount(p) > 1` is the "must COW" test.
+    Freeing an unallocated page, or more references than a page holds,
+    raises immediately with the page id (leak/double-free guard).
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -59,6 +72,7 @@ class PageAllocator:
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self._refs: list[int] = [0] * num_pages
 
     @property
     def sentinel(self) -> int:
@@ -74,6 +88,12 @@ class PageAllocator:
         """Pages needed to hold `num_tokens` KV slots."""
         return max(0, -(-num_tokens // self.page_size))
 
+    def refcount(self, page: int) -> int:
+        """Current holder count of `page` (0 = free)."""
+        if not 0 <= page < self.num_pages:
+            raise ValueError(f"page {page} outside pool of {self.num_pages}")
+        return self._refs[page]
+
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
             raise OutOfPagesError(
@@ -83,15 +103,105 @@ class PageAllocator:
             return []
         out = self._free[-n:][::-1]
         del self._free[-n:]
+        for p in out:
+            self._refs[p] = 1
         return out
 
-    def free(self, pages: list[int]) -> None:
+    def share(self, pages: list[int]) -> None:
+        """Add one reference per page. All-or-nothing: sharing a FREE
+        page is a bug (its contents are up for grabs) and raises with
+        the page id before anything is mutated."""
         for p in pages:
             if not 0 <= p < self.num_pages:
                 raise ValueError(f"page {p} outside pool of {self.num_pages}")
-            if p in self._free:
+            if self._refs[p] <= 0:
+                raise ValueError(f"share of unallocated page {p}")
+        for p in pages:
+            self._refs[p] += 1
+
+    def free(self, pages: list[int]) -> None:
+        """Drop one reference per page; pages reaching refcount 0 return
+        to the free list (in `pages` order, LIFO-recycled). Raises with
+        the offending page id — before mutating anything — on a double
+        free (refcount already 0) or when one call drops more references
+        to a page than it holds."""
+        from collections import Counter
+
+        drops = Counter(pages)
+        for p, n in drops.items():
+            if not 0 <= p < self.num_pages:
+                raise ValueError(f"page {p} outside pool of {self.num_pages}")
+            if self._refs[p] <= 0:
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(reversed(pages))
+            if n > self._refs[p]:
+                raise ValueError(
+                    f"freeing {n} references to page {p}, which holds "
+                    f"only {self._refs[p]}"
+                )
+        released = []
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                released.append(p)
+        self._free.extend(reversed(released))
+
+    # `release` is `free` under its sharing-aware name: both drop one
+    # reference; the page only leaves the pool's live set at refcount 0.
+    release = free
+
+    def check_invariant(self, holders=None) -> None:
+        """Pool accounting invariant; raises RuntimeError on violation.
+
+        Always checked: free list and refcounts partition the pool
+        (num_free + pages-with-refcount > 0 == num_pages, no page in
+        both sets, no negative refcount). With `holders` — an iterable
+        of page lists, one per live holder (slots' block tables, the
+        prefix cache's entries) — additionally checks that every page's
+        refcount equals its holder count, i.e. nothing leaked and
+        nothing is double-held. Callable from tests; the scheduler
+        asserts it at `_reset_pool`."""
+        from collections import Counter
+
+        allocated = {p for p, r in enumerate(self._refs) if r > 0}
+        if any(r < 0 for r in self._refs):
+            raise RuntimeError(f"negative refcount: {self._refs}")
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise RuntimeError(f"duplicate pages in free list: {self._free}")
+        if free_set & allocated:
+            raise RuntimeError(
+                f"pages both free and allocated: {sorted(free_set & allocated)}"
+            )
+        if len(self._free) + len(allocated) != self.num_pages:
+            raise RuntimeError(
+                f"pool accounting broken: {len(self._free)} free + "
+                f"{len(allocated)} allocated != {self.num_pages} pages"
+            )
+        if holders is None:
+            return
+        held = Counter()
+        for pages in holders:
+            held.update(int(p) for p in pages)
+        for p in range(self.num_pages):
+            if held.get(p, 0) != self._refs[p]:
+                raise RuntimeError(
+                    f"page {p}: refcount {self._refs[p]} but "
+                    f"{held.get(p, 0)} holders"
+                )
+
+
+@partial(jax.jit, donate_argnums=0)
+def copy_pages(kv_pages, src: jnp.ndarray, dst: jnp.ndarray):
+    """Copy page `src` onto page `dst` across every layer of a paged KV
+    pytree ([L, P, page_size, Hk, D] leaves) — the device half of
+    copy-on-write: a writer holding one of several references to a page
+    allocates a fresh page, copies the shared contents here, and swaps
+    the fresh page into its block table before writing. Donates the
+    pool, so the copy is in place; src/dst are traced scalars (one
+    compiled program per pool shape)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, dst].set(a[:, src]), kv_pages
+    )
 
 
 def write_pages(

@@ -457,6 +457,8 @@ def build_server(
     page_size: int = 64,
     decode_chunk: int = 8,
     max_ctx: int = 2048,
+    prefill_chunk: int | None = None,
+    prefix_cache: bool = True,
     stall_timeout: float | None = None,
     flight_recorder_size: int = 256,
     ttft_slo: float | None = None,
@@ -521,6 +523,7 @@ def build_server(
             pipe, num_slots=num_slots, page_size=page_size,
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
         )
     elif engine == "window":
         batcher = Batcher(
@@ -939,6 +942,19 @@ def main(argv: list[str] | None = None) -> None:
         "(prompt + max_tokens; sizes the per-slot block table)",
     )
     ap.add_argument(
+        "--prefill-chunk", type=int, default=512,
+        help="continuous engine: admission prefills at most this many "
+        "prompt tokens per engine step, interleaved with resident "
+        "decode chunks (bounds decode latency under long-prompt "
+        "admission; 0 = prefill each prompt in one dispatch)",
+    )
+    ap.add_argument(
+        "--no-prefix-cache", action="store_true",
+        help="continuous engine: disable the shared-prefix KV cache "
+        "(copy-on-write paged pool reuse of repeated system/media "
+        "prefixes across requests)",
+    )
+    ap.add_argument(
         "--stall-timeout", type=float, default=120.0,
         help="continuous engine: dump all thread stacks + the request "
         "flight recorder to stderr when no decode chunk completes for "
@@ -1008,6 +1024,8 @@ def main(argv: list[str] | None = None) -> None:
         engine=args.engine, num_slots=args.num_slots,
         page_size=args.page_size, decode_chunk=args.decode_chunk,
         max_ctx=args.max_ctx,
+        prefill_chunk=args.prefill_chunk or None,
+        prefix_cache=not args.no_prefix_cache,
         stall_timeout=args.stall_timeout or None,
         flight_recorder_size=args.flight_recorder_size,
         ttft_slo=args.ttft_slo,

@@ -72,7 +72,12 @@ def main(argv: list[str] | None = None) -> None:
         is_video = False
 
     if args.interactive:
-        session = ChatSession(pipe, images=images, is_video=is_video)
+        # shared=True: a `:reset` (or a future session over the same
+        # media) re-seeds from the pipe-level prefix index instead of
+        # cold-prefilling the media + system prompt again.
+        session = ChatSession(
+            pipe, images=images, is_video=is_video, shared=True
+        )
 
         def answer(q: str) -> None:
             print("assistant: ", end="", flush=True)

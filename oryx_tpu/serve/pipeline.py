@@ -194,6 +194,7 @@ class OryxInference:
     ) -> None:
         self.tokenizer = tokenizer
         self._frame_sep_cache = None
+        self._session_cache = None
         # Ring attention is a TRAINING/prefill configuration (sequence
         # parallelism, no KV cache); decode needs the cached path. Models
         # trained under a ring config serve with the equivalent dense
@@ -230,6 +231,20 @@ class OryxInference:
         from oryx_tpu.parallel.sharding import mesh_scope
 
         return mesh_scope(self.mesh)
+
+    def session_prefix_cache(self, capacity: int = 4):
+        """Pipe-level cross-SESSION prefix cache (lazily created): pass
+        it as `ChatSession(pipe, shared=pipe.session_prefix_cache())` —
+        or just `shared=True` — and fresh sessions over the same media +
+        system prompt seed their KV from a finished session's state
+        instead of cold-prefilling it. Same index discipline as the
+        continuous engine's page cache (serve/prefix_cache.py): block-
+        aligned token-id matching, media-fingerprint rooted, LRU."""
+        if self._session_cache is None:
+            from oryx_tpu.serve.prefix_cache import SessionPrefixCache
+
+            self._session_cache = SessionPrefixCache(capacity=capacity)
+        return self._session_cache
 
     # ---- host-side prompt/media prep ------------------------------------
 
@@ -501,6 +516,7 @@ class OryxInference:
         stop: Sequence[str] | None = None,
         cache_state: "PrefixCacheState | None" = None,
         usage_out: dict | None = None,
+        shared: "Any | None" = None,
     ):
         """Streaming `chat` (HF TextIteratorStreamer parity): yields text
         DELTAS as tokens decode; ''.join(deltas) equals chat()'s reply
@@ -523,6 +539,10 @@ class OryxInference:
         chat_batch's return_token_counts. The finishing token is counted
         (EOS, or the token that completes a stop string), matching the
         batch path; tokens decoded past a host-side stop cut are not.
+
+        shared: cross-session SessionPrefixCache, as in `chat_cached` —
+        a COLD cache_state seeds from the index's longest stored prefix
+        and the post-turn state is donated back.
         """
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
@@ -533,6 +553,15 @@ class OryxInference:
             "question": question, "images": list(images or []),
             "is_video": is_video, "history": list(history or []),
         })
+        if (
+            shared is not None and cache_state is not None
+            and cache_state.cache is None and not images
+        ):
+            cand = shared.lookup(
+                np.asarray(ids, np.int64), _media_fingerprint(images)
+            )
+            if cand is not None:
+                cache_state = cand
 
         # Decode always runs whole chunks (a shrunken final chunk would
         # compile a second decode program); overshoot tokens are dropped
@@ -599,11 +628,14 @@ class OryxInference:
                     usage_out["completion_tokens"] = len(emitted)
             if cache_state is None:
                 return reason
-            return reason, PrefixCacheState(
+            new_state = PrefixCacheState(
                 ids=flat, cache=final_cache, cache_len=cache_len,
                 prompt_ids=np.asarray(ids, np.int64), prompt_flat=flat,
                 media_key=media_key,
             )
+            if shared is not None and final_cache is not None:
+                shared.insert(new_state)
+            return reason, new_state
 
         def traced_blocks(gen):
             """Time each device chunk (the window between successive
@@ -778,6 +810,7 @@ class OryxInference:
         temperature: float | None = None,
         top_p: float | None = None,
         stop: Sequence[str] | None = None,
+        shared: "Any | None" = None,
     ) -> tuple[str, "PrefixCacheState"]:
         """`chat` for one conversation with cross-turn KV prefix reuse:
         the longest token-id prefix shared with `state.ids` is NOT
@@ -786,7 +819,14 @@ class OryxInference:
         is on ids (vLLM-style), so a tokenizer boundary merge or a
         template quirk just shortens the reuse, never changes the reply;
         a visual token inside the unshared suffix falls back to a full
-        multimodal prefill. Returns (reply, new state)."""
+        multimodal prefill. Returns (reply, new state).
+
+        shared: a SessionPrefixCache (serve/prefix_cache.py). A COLD
+        `state` first seeds itself from the cache's longest stored
+        prefix of this prompt (cross-session reuse of e.g. a shared
+        system prompt), and the new state is donated back after the
+        turn. Text-only lookup (pre-splice ids == the flat stream);
+        multimodal turns still donate and reuse within a session."""
         cfg = self._sampling_cfg(temperature, top_p)
         stop_seqs = self._stop_for(stop)
         max_new = max_new_tokens or cfg.generation.max_new_tokens
@@ -795,6 +835,12 @@ class OryxInference:
             "question": question, "images": list(images or []),
             "is_video": is_video, "history": list(history or []),
         })
+        if shared is not None and state.cache is None and not imgs:
+            cand = shared.lookup(
+                np.asarray(ids, np.int64), _media_fingerprint(imgs)
+            )
+            if cand is not None:
+                state = cand
         with self._mesh_scope():
             flat, L, common, embeds, cache, cache_len, media_key = (
                 self._prefix_plan(
@@ -818,11 +864,14 @@ class OryxInference:
         new_ids = np.concatenate(
             [flat, toks[0][: int(num[0])].astype(np.int64)]
         )
-        return reply, PrefixCacheState(
+        new_state = PrefixCacheState(
             ids=new_ids, cache=cache, cache_len=cache_len,
             prompt_ids=np.asarray(ids, np.int64), prompt_flat=flat,
             media_key=media_key,
         )
+        if shared is not None:
+            shared.insert(new_state)
+        return reply, new_state
 
     def _prompt_embeds(self, cfg, ids, imgs, factors, caps):
         """One prompt row → (decoder input embeds [1, T_bucket, H], real
@@ -994,7 +1043,15 @@ class ChatSession:
     expensive video/image prefill happens once per session instead of
     every turn; a media-content fingerprint guards against positional
     false matches). Replies and streamed deltas are identical either
-    way."""
+    way.
+
+    shared routes the session through the pipe-level CROSS-session
+    prefix index (serve/prefix_cache.py — the same index discipline the
+    continuous engine's page cache uses): True uses
+    `pipe.session_prefix_cache()`, or pass a SessionPrefixCache
+    directly. A fresh session then inherits the KV of the longest
+    stored prefix (shared system prompt, repeated opener) instead of
+    cold-prefilling it, and donates its state back after each turn."""
 
     def __init__(
         self,
@@ -1003,18 +1060,23 @@ class ChatSession:
         images: Sequence[np.ndarray] | None = None,
         is_video: bool = False,
         cache: bool = True,
+        shared=None,
     ) -> None:
         self.pipe = pipe
         self.images = list(images or [])
         self.is_video = is_video and bool(self.images)
         self.history: list[tuple[str, str]] = []
         self._cache_state = PrefixCacheState() if cache else None
+        if shared is True:
+            shared = pipe.session_prefix_cache()
+        self.shared = shared if cache else None
 
     def ask(self, question: str, **kw) -> str:
         if self._cache_state is not None:
             reply, self._cache_state = self.pipe.chat_cached(
                 self._cache_state, question, images=self.images,
-                is_video=self.is_video, history=self.history, **kw,
+                is_video=self.is_video, history=self.history,
+                shared=self.shared, **kw,
             )
         else:
             reply = self.pipe.chat(
@@ -1032,7 +1094,8 @@ class ChatSession:
         parts: list[str] = []
         gen = self.pipe.chat_stream(
             question, images=self.images, is_video=self.is_video,
-            history=self.history, cache_state=self._cache_state, **kw,
+            history=self.history, cache_state=self._cache_state,
+            shared=self.shared, **kw,
         )
         while True:
             try:

@@ -1,0 +1,367 @@
+"""Shared-prefix KV reuse: one block-aligned token-ID radix index, two
+storage planes.
+
+Real Oryx traffic is dominated by a shared per-conversation prefix (the
+system prompt, the media context, earlier turns), and the TPU kernel
+side is indifferent to which request owns a KV page (ragged paged
+attention, PAPERS.md arXiv 2604.15464) — so "have I already computed
+this prefix?" should be answered ONCE, by one index, for every serving
+engine. `TokenTrie` below is that index: a radix trie over fixed-size
+blocks of token ids (block size == the KV page size, so a cached prefix
+is always page-aligned), with LRU stamps for eviction. Two clients give
+its nodes meaning:
+
+  * `PagedPrefixCache` — the continuous scheduler's plane. Each node
+    owns ONE page of the paged pool (the cache's own reference, via
+    `PageAllocator.share`); admission splices matched pages into the
+    new slot's block table (sharing full pages, copy-on-writing a
+    partially-consumed one) and prefills only the suffix. Under pool
+    pressure, refcount-1 entries (pages nobody but the cache holds) are
+    LRU-evicted back to the free list — cached pages go before live
+    requests ever do.
+  * `SessionPrefixCache` — the dense-cache plane for the pipeline /
+    window-engine path. Nodes hold whole `PrefixCacheState` snapshots,
+    so a fresh `ChatSession` over the same media + system prompt seeds
+    itself from a finished session's KV instead of cold-prefilling.
+    Capacity-bounded (dense caches are HBM-expensive), LRU.
+
+Matching is on token IDS (vLLM-style): a tokenizer boundary merge just
+shortens the reuse, never changes a reply. Multimodal streams key their
+visual slots positionally, so both planes root their tries at a media
+fingerprint — a cache built over different media can never be matched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+
+class TrieNode:
+    __slots__ = ("children", "payload", "stamp", "parent", "key")
+
+    def __init__(self, parent: "TrieNode | None", key: bytes):
+        self.children: dict[bytes, TrieNode] = {}
+        self.payload: Any = None
+        self.stamp = 0
+        self.parent = parent
+        self.key = key
+
+
+class TokenTrie:
+    """Radix trie over fixed-size BLOCKS of token ids.
+
+    Only whole blocks index (a partial tail block never creates a
+    node), so every match length is a multiple of `block` — the
+    page-alignment invariant both cache planes rely on. `root_key`
+    partitions the trie (media fingerprints); `stamp` is a global LRU
+    clock bumped on every walk/extend touch.
+    """
+
+    def __init__(self, block: int):
+        if block < 1:
+            raise ValueError(f"block size must be >= 1, got {block}")
+        self.block = block
+        self.roots: dict[tuple, TrieNode] = {}
+        self._clock = 0
+
+    @staticmethod
+    def _block_key(tokens: np.ndarray) -> bytes:
+        return np.ascontiguousarray(tokens, np.int64).tobytes()
+
+    def _touch(self, node: TrieNode) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def walk(self, tokens, root_key: tuple = ()) -> list[TrieNode]:
+        """Longest-prefix match: the node path for the leading full
+        blocks of `tokens` present in the trie (LRU-touched), possibly
+        empty. Matched length is `len(result) * block` tokens."""
+        tokens = np.asarray(tokens)
+        node = self.roots.get(root_key)
+        path: list[TrieNode] = []
+        if node is None:
+            return path
+        for i in range(len(tokens) // self.block):
+            key = self._block_key(
+                tokens[i * self.block: (i + 1) * self.block]
+            )
+            child = node.children.get(key)
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        for n in path:
+            self._touch(n)
+        return path
+
+    def extend(self, tokens, root_key: tuple = ()) -> list[TrieNode]:
+        """Walk + create: the node path for ALL leading full blocks of
+        `tokens`, creating missing nodes (payload None) along the way."""
+        tokens = np.asarray(tokens)
+        node = self.roots.get(root_key)
+        if node is None:
+            node = self.roots[root_key] = TrieNode(None, b"")
+        path: list[TrieNode] = []
+        for i in range(len(tokens) // self.block):
+            key = self._block_key(
+                tokens[i * self.block: (i + 1) * self.block]
+            )
+            child = node.children.get(key)
+            if child is None:
+                child = node.children[key] = TrieNode(node, key)
+            path.append(child)
+            node = child
+        for n in path:
+            self._touch(n)
+        return path
+
+    def remove(self, node: TrieNode) -> None:
+        """Detach a LEAF node (asserted) from its parent; empty roots
+        are pruned."""
+        if node.children:
+            raise ValueError("only leaf nodes can be removed")
+        parent = node.parent
+        if parent is not None:
+            del parent.children[node.key]
+            if parent.parent is None and not parent.children:
+                for rk, root in list(self.roots.items()):
+                    if root is parent:
+                        del self.roots[rk]
+        node.parent = None
+
+    def nodes(self) -> Iterable[TrieNode]:
+        """Every block node (roots are structural, not yielded)."""
+        stack = list(self.roots.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.parent is not None:
+                yield n
+
+    def leaves(self) -> list[TrieNode]:
+        return [n for n in self.nodes() if not n.children]
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.nodes())
+
+
+class PagedPrefixCache:
+    """The continuous scheduler's shared-prefix page cache.
+
+    Each trie node owns one page of the paged pool: `insert` takes the
+    cache's OWN reference on newly indexed pages (`allocator.share`), so
+    a donated page outlives the request that computed it; `lookup`
+    returns the matched page list for the caller to splice (the CALLER
+    shares the pages it keeps — lookup itself takes no references).
+    `evict` walks leaves least-recently-used first and frees pages only
+    the cache still holds (refcount 1); entries shared with a live slot
+    are pinned until that slot releases them.
+    """
+
+    def __init__(self, allocator, *, metrics=None):
+        self.allocator = allocator
+        self.page_size = allocator.page_size
+        self.trie = TokenTrie(allocator.page_size)
+        self.metrics = metrics
+        self._pages = 0
+        # Publish zeros now: a cache rebuilt after a pool reset must not
+        # leave the gauges reporting the dead pool's values.
+        self._gauges()
+
+    # ---- accounting ------------------------------------------------------
+
+    @property
+    def pages(self) -> int:
+        """Pages the cache holds a reference to (== trie nodes)."""
+        return self._pages
+
+    @property
+    def entries(self) -> int:
+        """Distinct cached prefixes (trie leaves)."""
+        return len(self.trie.leaves())
+
+    def held_pages(self) -> list[int]:
+        """Every page the cache holds one reference to (for the pool
+        invariant check)."""
+        return [n.payload for n in self.trie.nodes()]
+
+    def evictable_pages(self, exclude=()) -> int:
+        """Upper bound on what `evict` could free right now: pages only
+        the cache holds (refcount 1), minus `exclude` (pages the caller
+        is about to pin). An inner refcount-1 node blocked by a shared
+        descendant is counted but unreachable — callers use this as a
+        feasibility screen, not a promise."""
+        exclude = set(exclude)
+        return sum(
+            1 for n in self.trie.nodes()
+            if n.payload not in exclude
+            and self.allocator.refcount(n.payload) == 1
+        )
+
+    def _gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("prefix_cache_pages", self._pages)
+            self.metrics.set_gauge("prefix_cache_entries", self.entries)
+
+    # ---- the cache surface -----------------------------------------------
+
+    def lookup(self, tokens, root_key: tuple = ()) -> tuple[int, list[int]]:
+        """Longest page-aligned cached prefix of `tokens` →
+        (matched_tokens, pages). pages[i] holds tokens
+        [i*page_size, (i+1)*page_size). Takes no page references."""
+        path = self.trie.walk(tokens, root_key)
+        return len(path) * self.page_size, [n.payload for n in path]
+
+    def insert(self, tokens, pages: list[int], root_key: tuple = ()) -> int:
+        """Index the full-page prefix of `tokens`, whose KV lives in
+        `pages` (one per block, in order). Newly indexed pages get one
+        cache-owned reference (`share`); blocks already present keep
+        their existing page — the duplicate stays the caller's to
+        release — and just have their LRU refreshed. Returns the number
+        of pages newly indexed."""
+        n_full = min(len(tokens) // self.page_size, len(pages))
+        if n_full <= 0:
+            return 0
+        path = self.trie.extend(
+            np.asarray(tokens)[: n_full * self.page_size], root_key
+        )
+        new = 0
+        for node, page in zip(path, pages):
+            if node.payload is None:
+                self.allocator.share([int(page)])
+                node.payload = int(page)
+                new += 1
+        self._pages += new
+        self._gauges()
+        return new
+
+    def evict(self, need_pages: int) -> int:
+        """Free at least `need_pages` pages the cache alone holds
+        (refcount 1), least-recently-used leaves first — cached pages
+        are reclaimed before any live request is ever evicted. Returns
+        the number actually freed (may be fewer: entries shared with
+        live slots are pinned)."""
+        freed = 0
+        while freed < need_pages:
+            # One gather per ROUND, oldest first (removing a leaf never
+            # un-leafs another gathered leaf); parents exposed as new
+            # leaves are picked up by the next round only if still
+            # short — O(rounds x trie), not O(pages x trie).
+            candidates = sorted(
+                (
+                    n for n in self.trie.leaves()
+                    if self.allocator.refcount(n.payload) == 1
+                ),
+                key=lambda n: n.stamp,
+            )
+            if not candidates:
+                break
+            for victim in candidates:
+                if freed >= need_pages:
+                    break
+                self.allocator.release([victim.payload])
+                self.trie.remove(victim)
+                self._pages -= 1
+                freed += 1
+        if freed and self.metrics is not None:
+            self.metrics.inc("prefix_cache_evicted_pages_total", freed)
+        self._gauges()
+        return freed
+
+    def clear(self) -> None:
+        """Drop every entry, releasing the cache's references (used when
+        the scheduler rebuilds a consumed pool)."""
+        for node in list(self.trie.nodes()):
+            if node.payload is not None:
+                self.allocator.release([node.payload])
+        self.trie = TokenTrie(self.page_size)
+        self._pages = 0
+        self._gauges()
+
+
+class SessionPrefixCache:
+    """Dense-cache plane: longest-prefix lookup over `PrefixCacheState`
+    snapshots (serve/pipeline.py), so a fresh ChatSession over the same
+    media + system prompt inherits a finished session's KV instead of
+    cold-prefilling it.
+
+    A state is reachable from EVERY node along its id stream's path —
+    a new prompt diverges from a stored stream at its own question, so
+    the useful hit is the deepest COMMON node, not the stored stream's
+    end. `lookup` returns the state at that node; the pipeline's
+    `_prefix_plan` then computes the exact longest common token prefix
+    against it and re-prefills only the rest (so an over-long candidate
+    only ever shortens the reuse, never corrupts it). Dense caches are
+    HBM-expensive: capacity bounds the number of live states, LRU.
+    """
+
+    def __init__(self, block_size: int = 16, capacity: int = 4):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.trie = TokenTrie(block_size)
+        self.capacity = capacity
+        self._states: dict[int, Any] = {}  # id(state) -> state, LRU order
+
+    @property
+    def entries(self) -> int:
+        return len(self._states)
+
+    def lookup(self, flat_ids, media_key: tuple = ()):
+        """The state stored at the deepest node along `flat_ids`' block
+        path (LRU-refreshed), or None."""
+        path = self.trie.walk(flat_ids, root_key=tuple(media_key))
+        for node in reversed(path):
+            if node.payload is not None:
+                state = node.payload
+                self._states.pop(id(state), None)
+                self._states[id(state)] = state
+                return state
+        return None
+
+    def insert(self, state) -> None:
+        """Store `state` along its full block path (streams shorter than
+        one block are not worth caching), evicting the least-recently-
+        used stored state beyond capacity. States the overwrite leaves
+        with no reachable node (the normal multi-turn case: each turn's
+        stream extends the last, shadowing its whole path) are dropped
+        immediately — an unreachable state would otherwise pin a dense
+        HBM cache against capacity for zero hit value."""
+        path = self.trie.extend(
+            np.asarray(state.ids), root_key=tuple(state.media_key)
+        )
+        if not path:
+            return
+        displaced = {
+            id(n.payload): n.payload for n in path
+            if n.payload is not None and n.payload is not state
+        }
+        for node in path:
+            node.payload = state
+        self._states.pop(id(state), None)
+        self._states[id(state)] = state
+        if displaced:
+            reachable = {
+                id(n.payload) for n in self.trie.nodes()
+                if n.payload is not None
+            }
+            for sid in displaced.keys() - reachable:
+                self._states.pop(sid, None)
+        while len(self._states) > self.capacity:
+            _, victim = next(iter(self._states.items()))
+            self._drop(victim)
+
+    def _drop(self, state) -> None:
+        self._states.pop(id(state), None)
+        for node in list(self.trie.nodes()):
+            if node.payload is state:
+                node.payload = None
+        # Prune now-useless branches (childless, payload-less).
+        changed = True
+        while changed:
+            changed = False
+            for leaf in self.trie.leaves():
+                if leaf.payload is None:
+                    self.trie.remove(leaf)
+                    changed = True

@@ -29,9 +29,25 @@ stop STRINGS and per-row max_tokens are enforced host-side at harvest,
 with the same trim/stable-prefix text rules as `chat_stream` — a
 request's reply through this engine is byte-identical to `pipe.chat`.
 
+Prefix cache + chunked prefill (serve/prefix_cache.py): admission looks
+up the longest page-aligned cached prefix of the prompt's token ids and
+SPLICES those pages into the new slot's block table — full pages shared
+(refcounted), a partially-consumed page copy-on-written — so only the
+unseen suffix is prefilled. The suffix prefills in bounded
+`prefill_chunk`-token dispatches interleaved with everyone else's
+decode chunks, so one long prompt never stalls resident streams for its
+whole prefill. A request donates its full-page prompt prefix to the
+cache the moment its prefill completes (concurrent look-alikes hit
+immediately) and its prompt+reply prefix when it finishes; under pool
+pressure, cache-only pages are LRU-evicted BEFORE any live request is.
+Replies stay bit-identical to the cold path: valid-slot KV does not
+depend on chunk grouping, and splicing reuses KV a cold prefill would
+have recomputed bit-equal.
+
 Metrics (utils/metrics.ServingMetrics): queue depth, slot occupancy,
 admitted/evicted/completed counts, TTFT and per-token latency
-histograms, wasted vs useful decode steps.
+histograms, wasted vs useful decode steps, prefix-cache hit/miss
+tokens + entries/pages/evictions, prefill tokens and chunk sizes.
 """
 
 from __future__ import annotations
@@ -51,10 +67,16 @@ import numpy as np
 from oryx_tpu.models import generate as generate_lib
 from oryx_tpu.models import oryx, qwen2
 from oryx_tpu.ops import paged_kv
+from oryx_tpu.ops.packing import round_up_bucket
 from oryx_tpu.serve import pipeline as pipeline_lib
+from oryx_tpu.serve.prefix_cache import PagedPrefixCache
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyMonitor
-from oryx_tpu.utils.metrics import ServingMetrics, TTFT_BUCKETS
+from oryx_tpu.utils.metrics import (
+    PREFILL_CHUNK_BUCKETS,
+    ServingMetrics,
+    TTFT_BUCKETS,
+)
 
 # Every line carries the request id — grep one id end-to-end across
 # queue/admission/eviction/finish (same id as X-Request-Id and
@@ -113,6 +135,18 @@ class _Request:
     embeds: Any = None
     length: int = 0
     key0: Any = None
+    # Prefix-cache key: the prompt's token ids for text-only requests
+    # (token ids == logical KV stream). None = uncacheable (multimodal
+    # prompts key visual slots positionally; they bypass the cache).
+    cache_tokens: Any = None
+    # Admission prefill state: logical KV tokens already in place for
+    # this placement (spliced cached prefix + prefilled chunks), the
+    # spliced count, and whether the slot has started decoding.
+    prefill_pos: int = 0
+    spliced: int = 0
+    activated: bool = False
+    ttft_done: bool = False
+    embeds_p: Any = None  # chunk-padded embeds (see pad_embeds_for_chunks)
     # Host text state (survives eviction: replay re-derives the same
     # tokens and `replay` skips re-processing them).
     emitted: list[int] = dataclasses.field(default_factory=list)
@@ -150,7 +184,33 @@ class ContinuousScheduler:
         tracer: trace_lib.Tracer | None = None,
         stall_timeout: float | None = None,
         anomaly: AnomalyMonitor | None = None,
+        prefill_chunk: int | None = None,
+        prefix_cache: bool = True,
     ):
+        # Pool-geometry validation up front: a bad flag should be one
+        # actionable ValueError at construction, never a mid-decode
+        # OutOfPagesError or a silent reshape surprise.
+        for name, v in (
+            ("num_slots", num_slots), ("page_size", page_size),
+            ("chunk", chunk), ("max_ctx", max_ctx),
+        ):
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"{name} must be a positive integer, got {v!r}"
+                )
+        if num_pages is not None and (
+            not isinstance(num_pages, int) or num_pages < 1
+        ):
+            raise ValueError(
+                f"num_pages must be a positive integer, got {num_pages!r}"
+            )
+        if prefill_chunk is not None and (
+            not isinstance(prefill_chunk, int) or prefill_chunk < 1
+        ):
+            raise ValueError(
+                "prefill_chunk must be a positive integer or None, "
+                f"got {prefill_chunk!r}"
+            )
         if max_ctx % page_size:
             raise ValueError(f"{max_ctx=} not a multiple of {page_size=}")
         # Optional SLO watcher (utils/anomaly.py): TTFT and queue-depth
@@ -164,8 +224,32 @@ class ContinuousScheduler:
         self.max_ctx = max_ctx
         self.max_pages = max_ctx // page_size
         self.num_pages = num_pages or num_slots * self.max_pages
+        if self.num_pages * page_size < max_ctx:
+            _LOG.warning(
+                "page pool (%d pages x %d tokens = %d) cannot hold one "
+                "max_ctx=%d request; prompts near the context ceiling "
+                "will be rejected at admission (raise --num-pages or "
+                "lower --max-ctx)",
+                self.num_pages, page_size, self.num_pages * page_size,
+                max_ctx,
+            )
+        self.prefill_chunk = prefill_chunk
         self.metrics = metrics or ServingMetrics()
+        # Pre-register the prefix-cache + prefill families so the full
+        # ladder renders (at zero) from the first scrape.
+        reg = self.metrics.registry
+        reg.counter("prefix_cache_hit_tokens_total")
+        reg.counter("prefix_cache_miss_tokens_total")
+        reg.counter("prefix_cache_evicted_pages_total")
+        reg.gauge("prefix_cache_entries")
+        reg.gauge("prefix_cache_pages")
+        reg.counter("prefill_tokens_total")
+        reg.histogram("prefill_chunk_tokens", PREFILL_CHUNK_BUCKETS)
         self.allocator = paged_kv.PageAllocator(self.num_pages, page_size)
+        self.prefix_cache = (
+            PagedPrefixCache(self.allocator, metrics=self.metrics)
+            if prefix_cache else None
+        )
         dtype = oryx.compute_dtype(self.cfg)
         self.kv_pages = qwen2.init_paged_kv_cache(
             self.cfg.llm, self.num_pages, page_size, dtype=dtype
@@ -260,12 +344,18 @@ class ContinuousScheduler:
     # ---- slot bookkeeping ------------------------------------------------
 
     def _reset_pool(self) -> None:
-        """Fresh page pool + allocator + empty slot state (used after a
-        device-step failure invalidated the donated pool). Callers have
-        already errored-out every in-flight request."""
+        """Fresh page pool + allocator + prefix cache + empty slot state
+        (used after a device-step failure invalidated the donated pool).
+        Callers have already errored-out every in-flight request."""
         self.allocator = paged_kv.PageAllocator(
             self.num_pages, self.page_size
         )
+        if self.prefix_cache is not None:
+            # The old cache indexed pages of the CONSUMED pool; rebuild
+            # it over the fresh allocator.
+            self.prefix_cache = PagedPrefixCache(
+                self.allocator, metrics=self.metrics
+            )
         self.kv_pages = qwen2.init_paged_kv_cache(
             self.cfg.llm, self.num_pages, self.page_size,
             dtype=oryx.compute_dtype(self.cfg),
@@ -276,6 +366,20 @@ class ContinuousScheduler:
         self.lengths[:] = 0
         self.tok[:] = 0
         self.recent[:] = -2
+        self._check_pool_invariant()
+
+    def _check_pool_invariant(self) -> None:
+        """Every page is either free or exactly accounted to its holders
+        (slot block tables + the prefix cache); raises RuntimeError with
+        the offending page on leak/double-hold. Cheap enough to call
+        from tests after any workload."""
+        holders = [
+            [int(p) for p in self.bt[s] if p != self._sentinel]
+            for s in range(self.num_slots)
+        ]
+        if self.prefix_cache is not None:
+            holders.append(self.prefix_cache.held_pages())
+        self.allocator.check_invariant(holders)
 
     def _held(self, s: int) -> int:
         return int((self.bt[s] != self._sentinel).sum())
@@ -307,6 +411,16 @@ class ContinuousScheduler:
         need = self.allocator.pages_for(tokens) - self._held(s)
         if need <= 0:
             return True
+        if need > self.allocator.num_free and self.prefix_cache is not None:
+            # Cached pages go before live requests: reclaim cache-only
+            # (refcount-1) entries, LRU first, before reporting
+            # pressure to the eviction machinery — but only when
+            # eviction can actually cover the shortfall. Draining the
+            # cache for a grow that fails anyway would cost look-alike
+            # requests their splices for nothing.
+            shortfall = need - self.allocator.num_free
+            if self.prefix_cache.evictable_pages() >= shortfall:
+                self.prefix_cache.evict(shortfall)
         if need > self.allocator.num_free:
             return False
         held = self._held(s)
@@ -329,7 +443,16 @@ class ContinuousScheduler:
                 self.watchdog.set_active(True)
             try:
                 self._admit()
-                if any(r is not None for r in self.slots):
+                # Chunked admission interleaves with decode: each engine
+                # step advances the in-flight admission by at most one
+                # prefill chunk, then runs one decode chunk for the
+                # resident streams — a long prompt never stalls decode
+                # for more than one prefill dispatch. (Unchunked
+                # prefills completed inside _admit; this is a no-op.)
+                self._prefill_step()
+                if any(
+                    r is not None and r.activated for r in self.slots
+                ):
                     self._ensure_capacity()
                     self._step_chunk()
             except Exception as e:  # surface to every in-flight client
@@ -354,6 +477,12 @@ class ContinuousScheduler:
     def _admit(self) -> None:
         gen = self.cfg.generation
         while True:
+            if any(r is not None and not r.activated for r in self.slots):
+                # A chunked prefill is in flight: the engine-step budget
+                # for prompt work is ONE prefill chunk, so no further
+                # admission until it activates (its donation then lands
+                # before the next look-alike's lookup).
+                break
             free = [s for s, r in enumerate(self.slots) if r is None]
             if not free:
                 break
@@ -385,6 +514,13 @@ class ContinuousScheduler:
                                     self.cfg, ids, imgs, factors, caps
                                 )
                             )
+                        # Text-only prompts key the prefix cache by
+                        # token ids (ids == the logical KV stream);
+                        # multimodal streams key visual slots
+                        # positionally and bypass it.
+                        req.cache_tokens = (
+                            None if imgs else np.asarray(ids, np.int64)
+                        )
                     s_ = req.sampling
                     req.temp = float(
                         s_.get("temperature", gen.temperature) or 0.0
@@ -397,11 +533,15 @@ class ContinuousScheduler:
                             f"prompt ({req.length}) + max_tokens "
                             f"({req.max_new}) exceeds max_ctx {self.max_ctx}"
                         )
-                    if self.allocator.pages_for(
+                    need = self.allocator.pages_for(
                         req.length + self.chunk
-                    ) > self.num_pages:
+                    )
+                    if need > self.num_pages:
                         raise ValueError(
-                            "request needs more pages than the whole pool"
+                            f"prompt needs {need} KV pages but the whole "
+                            f"pool holds {self.num_pages} (raise "
+                            "--num-pages, or lower the prompt length / "
+                            "--max-ctx)"
                         )
                 except Exception as e:
                     with self._cond:
@@ -422,10 +562,11 @@ class ContinuousScheduler:
                     )
                     continue
             s = free[0]
-            # Pages for the prompt plus the first chunk's writes. FIFO
-            # head-of-line: if the head doesn't fit, nobody jumps it
-            # (that is the no-starvation guarantee).
-            if not self._grow_slot(s, req.length + self.chunk):
+            # Splice the cached prefix and take pages for the prompt
+            # plus the first chunk's writes. FIFO head-of-line: if the
+            # head doesn't fit, nobody jumps it (that is the
+            # no-starvation guarantee).
+            if not self._splice_and_grow(s, req):
                 break
             with self._cond:
                 self._queue.popleft()
@@ -438,13 +579,84 @@ class ContinuousScheduler:
                 # re-arm after its first firing.
                 self.anomaly.observe_queue_depth(depth)
             self._place(s, req)
+            if self.prefill_chunk is None:
+                # Unchunked: complete the (single-dispatch) prefill now,
+                # so the slot activates — and donates its prompt pages —
+                # before the next queue head is examined. A burst of
+                # look-alike requests therefore admits cold exactly
+                # once; the rest splice.
+                self._advance_prefill(s, req)
+
+    def _splice_and_grow(self, s: int, req: _Request) -> bool:
+        """Splice the longest cached prefix of `req`'s prompt into slot
+        s's block table — full pages SHARED (refcounted, immutable), a
+        partially-consumed last page COPY-ON-WRITTEN — then grow the
+        table to cover prompt + one decode chunk. Returns False, with
+        nothing held, when the pool cannot satisfy it (the FIFO head
+        then waits). At least one suffix token always remains to
+        prefill: the admission needs the next-token logit."""
+        ps = self.page_size
+        spliced = 0
+        matched, pages = 0, []
+        if self.prefix_cache is not None and req.cache_tokens is not None:
+            matched, pages = self.prefix_cache.lookup(req.cache_tokens)
+        use = min(matched, max(req.length - 1, 0))
+        full = use // ps
+        # Feasibility screen BEFORE any share or COW device copy: the
+        # fresh pages needed beyond the spliced prefix must be coverable
+        # by the free list plus genuinely evictable cache pages —
+        # otherwise a head that cannot fit would pay a futile full-page
+        # device copy every engine step while it waits.
+        total_need = self.allocator.pages_for(
+            min(req.length + self.chunk, self.max_ctx)
+        )
+        avail = self.allocator.num_free
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.evictable_pages(
+                exclude=[int(p) for p in pages[:full]]
+            )
+        if total_need - full > avail:
+            return False
+        if self.prefix_cache is not None and req.cache_tokens is not None:
+            if full:
+                share = [int(p) for p in pages[:full]]
+                self.allocator.share(share)
+                self.bt[s, :full] = share
+            if use - full * ps > 0:
+                # The suffix prefill starts MID-page: the cache (and
+                # possibly other slots) still read this page, so the
+                # writer gets its own copy (COW) — or, when no page is
+                # free for the copy, simply recomputes the partial page.
+                try:
+                    cow = self.allocator.alloc(1)[0]
+                except paged_kv.OutOfPagesError:
+                    use = full * ps
+                else:
+                    self.kv_pages = paged_kv.copy_pages(
+                        self.kv_pages,
+                        jnp.asarray(int(pages[full]), jnp.int32),
+                        jnp.asarray(cow, jnp.int32),
+                    )
+                    self.bt[s, full] = cow
+            spliced = use
+        req.spliced = spliced
+        req.prefill_pos = spliced
+        if not self._grow_slot(s, req.length + self.chunk):
+            self._free_slot_pages(s)
+            req.spliced = 0
+            req.prefill_pos = 0
+            return False
+        self.metrics.inc("prefix_cache_hit_tokens_total", spliced)
+        self.metrics.inc(
+            "prefix_cache_miss_tokens_total", req.length - spliced
+        )
+        return True
 
     def _place(self, s: int, req: _Request) -> None:
-        """Prefill `req` into slot s and mark it live. The slot's key is
-        (re)seeded from the REQUEST's key0 — a slot must never inherit a
-        previous occupant's RNG state (that would make sampled streams
-        depend on scheduling history, and break eviction replay)."""
-        B1 = np.newaxis
+        """Claim slot s for `req` (pages already spliced+grown) and
+        start its prefill. The slot stays `finished` on device — decode
+        chunks skip it — until `_activate` flips it live; the prefill
+        itself advances chunk-by-chunk in `_prefill_step`."""
         # Close whichever wait span is open: first admission closes the
         # "admission" span opened at the queue head; a re-admission
         # after eviction closes the reopened "queue_wait".
@@ -454,17 +666,68 @@ class ContinuousScheduler:
         if req.qw_span >= 0:
             req.trace.end(req.qw_span)
             req.qw_span = -1
+        self.slots[s] = req
+        req.activated = False
+        self.finished[s] = True
+        self.lengths[s] = 0
+        self.tok[s] = 0
+        # Eviction ordering needs an age the moment pages are held.
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        _LOG.info(
+            "request %s %s slot=%d prompt=%d cached=%d", req.trace.id,
+            "re-admitted" if req.replay else "admitted", s, req.length,
+            req.spliced,
+        )
+
+    def _prefill_step(self) -> None:
+        """Advance every admitting slot by at most one prefill chunk
+        (prefill_chunk=None: the whole remaining suffix in one
+        dispatch); slots whose prefill completes activate and join the
+        next decode chunk."""
+        for s, req in enumerate(self.slots):
+            if req is None or req.activated:
+                continue
+            self._advance_prefill(s, req)
+
+    def _advance_prefill(self, s: int, req: _Request) -> None:
+        B1 = np.newaxis
+        off = req.prefill_pos
+        L = req.length
+        if self.prefill_chunk is None and off == 0:
+            # Cold single-shot: the original full-embeds program.
+            emb, end = req.embeds, L
+        elif self.prefill_chunk is None:
+            # Cached suffix in one dispatch, bucketed so it shares the
+            # cold path's compiled prefill shapes.
+            width = round_up_bucket(L - off)
+            emb = generate_lib.slice_embeds(
+                generate_lib.pad_embeds_for_chunks(req.embeds, width),
+                jnp.asarray(off, jnp.int32), width=width,
+            )
+            end = L
+        else:
+            width = self.prefill_chunk
+            if req.embeds_p is None:
+                req.embeds_p = generate_lib.pad_embeds_for_chunks(
+                    req.embeds, width
+                )
+            emb = generate_lib.slice_embeds(
+                req.embeds_p, jnp.asarray(off, jnp.int32), width=width,
+            )
+            end = min(off + width, L)
         pf = req.trace.begin(
-            "prefill", slot=s, tokens=req.length, replay=req.replay > 0
+            "prefill", slot=s, start=off, tokens=end - off,
+            cached=req.spliced > 0, replay=req.replay > 0,
         )
         with self.pipe._mesh_scope():
             kv, tok0, key = generate_lib.paged_prefill(
                 self.pipe.params["llm"], self.cfg.llm,
-                req.embeds,
-                jnp.asarray([req.length], np.int32),
+                emb,
+                jnp.asarray([end], np.int32),
                 jnp.asarray(self.bt[s][B1]),
                 self.kv_pages,
-                jnp.zeros((1,), np.int32),
+                jnp.asarray([off], np.int32),
                 req.key0[B1],
                 jnp.asarray([req.temp], np.float32),
                 jnp.asarray([req.topp], np.float32),
@@ -473,18 +736,31 @@ class ContinuousScheduler:
                 compute_dtype=oryx.compute_dtype(self.cfg),
             )
         req.trace.end(pf)
-        if self.watchdog is not None:
-            # A completed prefill is progress too — without this, a
-            # burst of admissions (each a full prompt prefill, possibly
-            # a compile) could out-wait the deadline with the engine
-            # perfectly healthy.
-            self.watchdog.beat()
-        _LOG.info(
-            "request %s %s slot=%d prompt=%d", req.trace.id,
-            "re-admitted" if req.replay else "admitted", s, req.length,
-        )
         self.kv_pages = kv
-        self.slots[s] = req
+        req.prefill_pos = end
+        self.metrics.inc("prefill_tokens_total", end - off)
+        self.metrics.observe(
+            "prefill_chunk_tokens", end - off,
+            buckets=PREFILL_CHUNK_BUCKETS,
+        )
+        if self.watchdog is not None:
+            # A completed prefill chunk is progress too — without this,
+            # a burst of admissions (each possibly a compile) could
+            # out-wait the deadline with the engine perfectly healthy.
+            self.watchdog.beat()
+        if end >= L:
+            # Intermediate chunks' sampled token/key are discarded; the
+            # final chunk's are the single-shot values (every chunk was
+            # seeded with the request's own key0).
+            self._activate(s, req, tok0, key)
+
+    def _activate(self, s: int, req: _Request, tok0, key) -> None:
+        """Prefill complete: mark slot s live for the next decode chunk.
+        The slot's key is (re)seeded from the REQUEST's advanced key — a
+        slot must never inherit a previous occupant's RNG state (that
+        would make sampled streams depend on scheduling history, and
+        break eviction replay)."""
+        req.activated = True
         self.tok[s] = int(np.asarray(tok0)[0])
         self.lengths[s] = req.length
         self.finished[s] = False
@@ -493,17 +769,18 @@ class ContinuousScheduler:
         self.top_k[s] = req.topk
         self.recent[s] = -2
         self.keys = self.keys.at[s].set(key[0])
-        if req.admit_seq < 0:
+        if not req.ttft_done:
+            req.ttft_done = True
             ttft = time.monotonic() - req.submit_time
             self.metrics.observe(
                 "ttft_seconds", ttft, buckets=TTFT_BUCKETS,
             )
+            req.handle.debug["ttft_s"] = ttft
             if self.anomaly is not None:
                 self.anomaly.observe_ttft(ttft, request_id=req.trace.id)
             req.handle.debug["admit_chunk"] = self.chunks_run
-        req.admit_seq = self._admit_seq
-        self._admit_seq += 1
         self.metrics.inc("admitted")
+        self._donate_prefix(s, req, req.length)
         self._occupancy_gauge()
         # tok0 is this slot's first generated token — process it now so
         # a max_tokens=1 request never occupies a chunk. The chunk
@@ -513,6 +790,28 @@ class ContinuousScheduler:
         self._advance(s, [int(self.tok[s])])
         if self.slots[s] is not None:
             req.replay += 1
+
+    def _donate_prefix(self, s: int, req: _Request, tokens: int) -> None:
+        """Index the full-page prefix of slot s's first `tokens` logical
+        slots into the prefix cache (the cache takes its own page
+        references, so the entry outlives the slot). Called at
+        activation with the prompt — concurrent look-alikes hit
+        immediately — and at finish with prompt + reply."""
+        if self.prefix_cache is None or req.cache_tokens is None:
+            return
+        stream = req.cache_tokens
+        if tokens > req.length:
+            stream = np.concatenate([
+                stream, np.asarray(req.emitted, np.int64),
+            ])
+        full = min(
+            min(tokens, len(stream)) // self.page_size, self._held(s)
+        )
+        if full:
+            self.prefix_cache.insert(
+                stream[: full * self.page_size],
+                [int(p) for p in self.bt[s, :full]],
+            )
 
     def _ensure_capacity(self) -> None:
         """Every live slot must own pages for lengths + chunk before the
@@ -555,6 +854,9 @@ class ContinuousScheduler:
         and `processed` tokens are skipped on re-admission."""
         req = self.slots[s]
         req.replay = req.processed
+        req.activated = False
+        req.spliced = 0
+        req.prefill_pos = 0
         self._clear_slot(s)
         req.trace.event("evicted", slot=s, replay_tokens=req.processed)
         req.qw_span = req.trace.begin("queue_wait", requeued=True)
@@ -608,8 +910,8 @@ class ContinuousScheduler:
             self.watchdog.beat()
         useful = 0
         for s, req in enumerate(self.slots):
-            if req is None:
-                continue
+            if req is None or not req.activated:
+                continue  # empty, or still prefilling (device-finished)
             # The same device window lands on every live request: decode
             # chunks are shared dispatches, and per-request attribution
             # is exactly what makes occupancy problems visible in a
@@ -715,6 +1017,18 @@ class ContinuousScheduler:
 
     def _finish(self, s: int, reason: str, completion: int) -> None:
         req = self.slots[s]
+        # Donate the full-page prefix of prompt + reply before the
+        # slot's references go: the cache's own share keeps the pages
+        # alive, so the NEXT turn of this conversation (whose prompt
+        # embeds this reply) splices instead of recomputing. Capped at
+        # the DEVICE-confirmed KV length: a token the host emitted but
+        # the device never fed back (tok0 of a max_tokens=1 request
+        # finishing at activation) has no KV at its slot, and donating
+        # it would poison the cache with prefill pad garbage.
+        self._donate_prefix(
+            s, req,
+            min(req.length + len(req.emitted), int(self.lengths[s])),
+        )
         self._clear_slot(s)
         req.handle.reply = req.text_done
         req.handle.finish_reason = reason

@@ -418,6 +418,11 @@ TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                 30.0, 60.0)
 PER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5)
+# Prefill chunk sizes (tokens per admission dispatch): powers of two up
+# to the longest plausible single dispatch — the shape of this histogram
+# shows whether chunked prefill is actually bounding admission work.
+PREFILL_CHUNK_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                         256.0, 512.0, 1024.0, 2048.0, 4096.0)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""CPU microbench: shared-prefix KV cache on vs off, repeated-system-
+prompt workload through the continuous scheduler.
+
+Measures what the prefix cache is FOR — prefill tokens actually
+computed (`oryx_serving_prefill_tokens_total`) and mean time-to-first-
+token — on a workload where every request carries the same long system
+prompt and a short unique question (the dominant real traffic shape).
+The acceptance bar for the change is a >= 2x reduction in prefill
+tokens computed with the cache on, with mean TTFT no worse; the token
+ratio is exact and deterministic, the TTFT comparison is wall-clock
+(noisy on loaded CI, reported always, gated only in full mode).
+
+    JAX_PLATFORMS=cpu python scripts/bench_prefix_cache.py \
+        [--requests 16 --sys-chars 400 --cap 6] \
+        [--num-slots 4 --chunk 4 --page-size 16 --prefill-chunk 64] \
+        [--smoke] [--json out.json]
+
+--smoke shrinks the workload for the CI gate (scripts/check_tier1.sh)
+and exits nonzero if the token ratio is under 2x.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class _CharTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+SYS = (
+    "You are a meticulous multimodal assistant for the Oryx serving "
+    "stack. Study the provided context carefully before answering; "
+    "keep replies short, factual and grounded in what you can see. "
+)
+
+
+def _workload(n: int, sys_chars: int) -> list[str]:
+    prefix = (SYS * (sys_chars // len(SYS) + 1))[:sys_chars]
+    return [f"{prefix} question number {i}: what now?" for i in range(n)]
+
+
+def _run_engine(pipe, questions, cap, args, *, prefix_cache: bool) -> dict:
+    from oryx_tpu.serve.scheduler import ContinuousScheduler
+    from oryx_tpu.utils.metrics import ServingMetrics
+
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=args.num_slots, page_size=args.page_size,
+        chunk=args.chunk, max_ctx=args.max_ctx,
+        num_pages=args.num_pages, metrics=metrics, autostart=False,
+        prefill_chunk=args.prefill_chunk or None,
+        prefix_cache=prefix_cache,
+    )
+    handles = [sched.submit({"question": q}, cap) for q in questions]
+    t0 = time.monotonic()
+    sched.start()
+    replies = [h.result(timeout=600)[0] for h in handles]
+    wall = time.monotonic() - t0
+    sched._check_pool_invariant()
+    sched.close()
+    ttfts = [h.debug["ttft_s"] for h in handles]
+    return {
+        "replies": replies,
+        "prefill_tokens": metrics.get("prefill_tokens_total"),
+        "hit_tokens": metrics.get("prefix_cache_hit_tokens_total"),
+        "miss_tokens": metrics.get("prefix_cache_miss_tokens_total"),
+        "cache_entries": metrics.get("prefix_cache_entries"),
+        "cache_pages": metrics.get("prefix_cache_pages"),
+        "evicted_pages": metrics.get("prefix_cache_evicted_pages_total"),
+        "mean_ttft_s": sum(ttfts) / len(ttfts),
+        "max_ttft_s": max(ttfts),
+        "wall_s": wall,
+    }
+
+
+def run(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--sys-chars", type=int, default=400)
+    ap.add_argument("--cap", type=int, default=6)
+    ap.add_argument("--num-slots", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--max-ctx", type=int, default=1024)
+    ap.add_argument("--num-pages", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small workload + hard >=2x token-ratio gate")
+    ap.add_argument("--json", default=None, help="also write results here")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.requests = min(args.requests, 6)
+        args.sys_chars = min(args.sys_chars, 240)
+
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_CharTokenizer(), params, cfg)
+    questions = _workload(args.requests, args.sys_chars)
+    if args.num_pages is None:
+        # Generous pool: the bench measures recompute avoidance, not
+        # eviction dynamics.
+        per = -(-(len(questions[0]) + 80 + args.cap) // args.page_size)
+        args.num_pages = per * (args.num_slots + 2)
+
+    cold = _run_engine(
+        pipe, questions, args.cap, args, prefix_cache=False
+    )
+    warm = _run_engine(
+        pipe, questions, args.cap, args, prefix_cache=True
+    )
+    assert warm.pop("replies") == cold.pop("replies"), (
+        "prefix cache changed a reply — bit-parity broken"
+    )
+
+    ratio = cold["prefill_tokens"] / max(warm["prefill_tokens"], 1)
+    out = {
+        "workload": {
+            "requests": args.requests, "sys_chars": args.sys_chars,
+            "cap": args.cap, "prefill_chunk": args.prefill_chunk,
+            "page_size": args.page_size, "num_slots": args.num_slots,
+        },
+        "no_prefix_cache": cold,
+        "prefix_cache": warm,
+        "prefill_tokens_ratio": ratio,
+        "ttft_improvement": cold["mean_ttft_s"] / max(
+            warm["mean_ttft_s"], 1e-9
+        ),
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    if ratio < 2.0:
+        print(json.dumps(out, indent=2))
+        print(
+            f"FAIL: prefill-token reduction {ratio:.2f}x < 2x",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    if not args.smoke and warm["mean_ttft_s"] > cold["mean_ttft_s"]:
+        print(json.dumps(out, indent=2))
+        print(
+            "FAIL: mean TTFT did not improve "
+            f"({warm['mean_ttft_s']:.4f}s vs {cold['mean_ttft_s']:.4f}s)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run(sys.argv[1:]), indent=2))

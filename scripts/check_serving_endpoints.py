@@ -15,7 +15,13 @@ pushes one request through it, then checks:
     revision + engine labels, and the HBM gauges exist;
   * GET /debug/requests — valid JSON, the request we sent is recorded;
   * GET /debug/trace?id= — valid Chrome trace JSON with a non-empty
-    traceEvents list covering prefill and decode.
+    traceEvents list covering prefill and decode;
+  * prefix cache under a shared-prefix burst — after several requests
+    carrying one long system prompt, the
+    `oryx_serving_prefix_cache_{hit,miss}_tokens_total` counters,
+    entries/pages gauges, eviction counter and the
+    `oryx_serving_prefill_chunk_tokens` histogram are present and
+    well-formed, and hit_tokens actually moved (the burst shared).
 
 Exit 0 = all good; nonzero prints what broke. Wired into
 scripts/check_tier1.sh after the pytest gate.
@@ -64,7 +70,7 @@ def main() -> None:
     pipe = OryxInference(_Tokenizer(), params, cfg)
     srv = api_server.build_server(
         pipe, port=0, engine="continuous", num_slots=2, page_size=16,
-        decode_chunk=4, max_ctx=512,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{srv.server_address[1]}"
@@ -135,13 +141,62 @@ def main() -> None:
             if want not in names:
                 fail(f"/debug/trace missing span {want!r} (got "
                      f"{sorted(names)})")
+
+        # Shared-prefix burst: several requests with one long system
+        # prompt must light up the prefix-cache metric family.
+        sysmsg = ("You are a careful assistant. Study the context and "
+                  "answer briefly. " * 2)
+        for i in range(3):
+            burst = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [
+                        {"role": "system", "content": sysmsg},
+                        {"role": "user", "content": f"question {i}?"},
+                    ],
+                    "max_tokens": 3,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(burst, timeout=300) as r:
+                json.load(r)
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            metrics_text = r.read().decode()
+        for fam in (
+            "oryx_serving_prefix_cache_hit_tokens_total",
+            "oryx_serving_prefix_cache_miss_tokens_total",
+            "oryx_serving_prefix_cache_evicted_pages_total",
+            "oryx_serving_prefix_cache_entries",
+            "oryx_serving_prefix_cache_pages",
+            "oryx_serving_prefill_tokens_total",
+        ):
+            m = re.search(
+                rf"^{fam} ([0-9.e+-]+)$", metrics_text, re.M
+            )
+            if not m:
+                fail(f"prefix-cache metric {fam} missing or malformed "
+                     "after the shared-prefix burst")
+        if not re.search(
+            r'^oryx_serving_prefill_chunk_tokens_bucket\{le="\+Inf"\} '
+            r"[1-9]", metrics_text, re.M,
+        ):
+            fail("prefill chunk-size histogram did not record any "
+                 "dispatch")
+        hit = float(re.search(
+            r"^oryx_serving_prefix_cache_hit_tokens_total ([0-9.e+-]+)$",
+            metrics_text, re.M,
+        ).group(1))
+        if hit <= 0:
+            fail("shared-prefix burst produced zero "
+                 "prefix_cache_hit_tokens_total — the cache never hit")
     finally:
         if srv.scheduler is not None:
             srv.scheduler.close()
         srv.shutdown()
     print("serving endpoints OK: /healthz + /readyz + /metrics "
           "(content-type, prefix, build_info, hbm gauges) + "
-          "/debug/requests + /debug/trace")
+          "/debug/requests + /debug/trace + prefix-cache family "
+          "under a shared-prefix burst")
 
 
 if __name__ == "__main__":

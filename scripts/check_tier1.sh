@@ -10,12 +10,12 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 330 = the 300 recorded at PR 2 plus the telemetry suite added in
-# PR 3 (metrics registry, anomaly detectors, trainer exporter; 340
-# observed with a warm /tmp/jax_cache and the 6 donation-quirk tests
-# xfailed by conftest — see CHANGES.md), with headroom for
-# load-dependent flakes (bench-supervisor probes on one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-330}
+# 350 = the 330 recorded at PR 3 plus the prefix-cache suite added in
+# PR 4 (allocator refcount/COW guards, radix index, cached-vs-cold
+# parity, chunked prefill; 365 observed with a warm /tmp/jax_cache),
+# with headroom for load-dependent flakes (bench-supervisor probes on
+# one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-350}
 
 # --- ROADMAP.md "Tier-1 verify", verbatim -----------------------------------
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
@@ -39,6 +39,18 @@ echo "checking serving endpoints (/healthz, /readyz, /metrics, /debug/*)"
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/check_serving_endpoints.py; then
     echo "SERVING ENDPOINT CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- prefix-cache perf gate --------------------------------------------------
+# Repeated-system-prompt workload through the continuous scheduler,
+# cache off vs on: replies must stay bit-identical and prefill tokens
+# computed must drop >= 2x (the PR-4 acceptance bar; TTFT is reported
+# but not gated in smoke mode — wall clock on shared CI is noisy).
+echo "checking prefix-cache perf (bench_prefix_cache.py --smoke)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/bench_prefix_cache.py --smoke > /dev/null; then
+    echo "PREFIX CACHE PERF CHECK FAILED" >&2
     exit 1
 fi
 

@@ -1,0 +1,489 @@
+"""Shared-prefix KV cache + chunked prefill: allocator refcount/COW
+lifecycle and guards, pool-geometry validation, radix-index unit tests,
+generate-level chunked-prefill bit parity, and scheduler-level
+cached-vs-cold bit parity (greedy + seeded sampling, COW splice,
+eviction-then-readmit replay over shared pages), plus the cross-session
+dense-cache plane."""
+
+import logging
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import generate as gen_lib
+from oryx_tpu.models import oryx, qwen2
+from oryx_tpu.ops import paged_kv
+from oryx_tpu.serve.pipeline import ChatSession, OryxInference
+from oryx_tpu.serve.prefix_cache import (
+    PagedPrefixCache,
+    SessionPrefixCache,
+    TokenTrie,
+)
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils.metrics import ServingMetrics
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Allocator: refcounts, share/release, guards, invariant
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcount_lifecycle():
+    a = paged_kv.PageAllocator(4, 8)
+    p = a.alloc(2)
+    assert [a.refcount(x) for x in p] == [1, 1]
+    a.share(p)
+    assert [a.refcount(x) for x in p] == [2, 2]
+    a.free(p)  # one holder gone; pages stay allocated
+    assert a.num_free == 2 and [a.refcount(x) for x in p] == [1, 1]
+    a.release(p)  # last holder gone; pages return
+    assert a.num_free == 4 and [a.refcount(x) for x in p] == [0, 0]
+
+
+def test_allocator_double_free_and_share_guards_name_the_page():
+    a = paged_kv.PageAllocator(4, 8)
+    p = a.alloc(1)
+    a.free(p)
+    with pytest.raises(ValueError, match=f"double free of page {p[0]}"):
+        a.free(p)
+    with pytest.raises(ValueError, match=f"unallocated page {p[0]}"):
+        a.share(p)
+    q = a.alloc(1)[0]
+    # One call dropping more references than the page holds fails BEFORE
+    # mutating anything.
+    with pytest.raises(ValueError, match=f"page {q}"):
+        a.free([q, q])
+    assert a.refcount(q) == 1
+    with pytest.raises(ValueError, match="outside pool"):
+        a.free([99])
+
+
+def test_allocator_invariant_checker():
+    a = paged_kv.PageAllocator(4, 8)
+    p = a.alloc(2)
+    a.share([p[0]])
+    # Holders: one block table holding both pages, one cache holding p0.
+    a.check_invariant([p, [p[0]]])
+    with pytest.raises(RuntimeError, match="page"):
+        a.check_invariant([p])  # p0's second reference unaccounted
+    with pytest.raises(RuntimeError, match="page"):
+        a.check_invariant([p, p])  # p1 double-held
+    a.check_invariant()  # internal partition always checkable
+
+
+# ---------------------------------------------------------------------------
+# Pool geometry validation at engine construction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kw", [
+    {"page_size": 0}, {"num_slots": -1}, {"chunk": 0}, {"max_ctx": 0},
+    {"num_pages": 0}, {"prefill_chunk": 0}, {"page_size": 2.5},
+])
+def test_engine_rejects_bad_geometry(pipe, kw):
+    args = dict(num_slots=2, page_size=16, chunk=4, max_ctx=512,
+                autostart=False)
+    args.update(kw)
+    with pytest.raises(ValueError):
+        ContinuousScheduler(pipe, **args)
+
+
+def test_engine_warns_when_pool_cannot_hold_max_ctx(pipe, caplog):
+    with caplog.at_level(logging.WARNING, "oryx.serve.scheduler"):
+        sched = ContinuousScheduler(
+            pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+            num_pages=8, autostart=False,
+        )
+    sched.close()
+    assert any("cannot hold one max_ctx" in r.message for r in caplog.records)
+
+
+def test_oversized_prompt_rejected_with_actionable_message(pipe):
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        num_pages=4, autostart=False,
+    )
+    h = sched.submit({"question": "x" * 200}, 8)
+    sched.start()
+    with pytest.raises(RuntimeError, match="--num-pages"):
+        h.result(timeout=600)
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Radix index
+# ---------------------------------------------------------------------------
+
+
+def test_trie_longest_prefix_is_page_aligned():
+    t = TokenTrie(4)
+    toks = np.arange(11)
+    path = t.extend(toks)
+    assert len(path) == 2  # 11 tokens -> 2 full blocks, tail dropped
+    assert len(t.walk(np.arange(11))) == 2
+    assert len(t.walk(np.arange(7))) == 1  # only the first block matches
+    assert len(t.walk(np.arange(3))) == 0  # shorter than one block
+    div = np.concatenate([np.arange(4), [99, 98, 97, 96], np.arange(4)])
+    assert len(t.walk(div)) == 1  # diverges at block 2
+
+
+def test_paged_cache_insert_lookup_refcounts():
+    alloc = paged_kv.PageAllocator(8, 4)
+    cache = PagedPrefixCache(alloc)
+    pages = alloc.alloc(3)
+    toks = np.arange(13)  # 3 full blocks + 1 tail token
+    assert cache.insert(toks, pages) == 3
+    assert [alloc.refcount(p) for p in pages] == [2, 2, 2]
+    alloc.free(pages)  # the "slot" releases; cache keeps them alive
+    assert alloc.num_free == 5
+    matched, got = cache.lookup(np.arange(20))
+    assert matched == 12 and got == pages
+    # Re-inserting an existing prefix is a no-op on references.
+    dup = alloc.alloc(2)
+    assert cache.insert(toks[:8], dup) == 0
+    alloc.free(dup)
+    alloc.check_invariant([cache.held_pages()])
+
+
+def test_paged_cache_lru_eviction_skips_shared_pages():
+    alloc = paged_kv.PageAllocator(8, 4)
+    cache = PagedPrefixCache(alloc)
+    a = alloc.alloc(2)
+    cache.insert(np.arange(8), a)          # entry A (older)
+    b = alloc.alloc(2)
+    cache.insert(np.arange(100, 108), b)   # entry B (newer)
+    alloc.free(a)
+    # B's pages stay slot-shared (refcount 2): only A is reclaimable.
+    assert cache.evict(4) == 2
+    assert alloc.num_free == 4 + 2  # pool minus B's 2 pages
+    matched, _ = cache.lookup(np.arange(8))
+    assert matched == 0  # A is gone
+    matched, _ = cache.lookup(np.arange(100, 108))
+    assert matched == 8  # B survived
+    # Touch order drives LRU: re-insert A, touch it, add C, evict one.
+    a2 = alloc.alloc(2)
+    cache.insert(np.arange(8), a2)
+    alloc.free(a2)
+    alloc.free(b)  # B now cache-only too
+    cache.lookup(np.arange(8))  # A is most recent
+    assert cache.evict(1) >= 1
+    assert cache.lookup(np.arange(8))[0] == 8  # A survived (LRU was B)
+    cache.clear()
+    assert alloc.num_free == 8
+    alloc.check_invariant([])
+
+
+# ---------------------------------------------------------------------------
+# Generate-level chunked prefill bit parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_llm():
+    cfg = cfg_lib.tiny_llm(vocab_size=128)
+    params = qwen2.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _embed(params, ids):
+    return params["embed"]["weight"][jnp.asarray(ids)]
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.9])
+def test_generate_paged_prefill_chunked_bit_parity(tiny_llm, temperature):
+    """generate_paged with prefill_chunk must emit BIT-identical tokens
+    to the single-shot prefill — greedy and seeded sampling, mixed
+    lengths, chunk boundaries that split rows unevenly."""
+    cfg, params = tiny_llm
+    gcfg = cfg_lib.GenerationConfig(
+        temperature=temperature, top_p=0.9 if temperature else 1.0,
+        eos_token_id=7,
+    )
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 128, size=(3, 32)).astype(np.int32)
+    lengths = np.array([9, 21, 32], np.int32)
+    common = dict(
+        inputs_embeds=_embed(params, ids), lengths=lengths,
+        max_new_tokens=8, page_size=8, chunk=4, kv_capacity=64,
+        key=jax.random.key(11),
+    )
+    t0, n0, f0 = gen_lib.generate_paged(params, cfg, gcfg, **common)
+    for pc in (5, 8, 16):
+        t1, n1, f1 = gen_lib.generate_paged(
+            params, cfg, gcfg, prefill_chunk=pc, **common
+        )
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+        np.testing.assert_array_equal(np.asarray(n0), np.asarray(n1))
+        np.testing.assert_array_equal(np.asarray(f0), np.asarray(f1))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-level cached-vs-cold parity
+# ---------------------------------------------------------------------------
+
+SYS = (
+    "You are a meticulous multimodal assistant. Always answer with "
+    "care, cite what you see, and keep replies short. "
+)
+
+
+def _run_all(sched, reqs):
+    handles = [
+        sched.submit({"question": q}, cap, sampling)
+        for q, cap, sampling in reqs
+    ]
+    sched.start()
+    results = [h.result(timeout=600) for h in handles]
+    sched.close()
+    return handles, results
+
+
+def test_cached_prefix_decode_matches_cold_greedy(pipe):
+    """The acceptance bar: a request admitted over a cached prefix
+    (pages spliced, only the suffix prefilled) produces the exact reply
+    of the cold path — and of the dense solo pipeline."""
+    q1, q2 = SYS + "what is shown?", SYS + "what happens next?"
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    handles, results = _run_all(
+        sched, [(q1, 6, None), (q2, 6, None), (q1, 6, None)]
+    )
+    for q, (reply, _, _) in zip((q1, q2, q1), results):
+        assert reply == pipe.chat(q, max_new_tokens=6), q
+    # The shared SYS prefix really was served from the cache.
+    assert metrics.get("prefix_cache_hit_tokens_total") >= 2 * (
+        len(SYS) // 16 * 16 - 16
+    )
+    assert metrics.get("prefix_cache_entries") >= 1
+    sched._check_pool_invariant()
+
+
+def test_cached_prefix_seeded_sampling_matches_cold(pipe):
+    """Sampling draws depend only on the request's own key and the
+    (bit-identical) logits, so a seeded sampled request must reproduce
+    across cold and cached admissions."""
+    q = SYS + "tell me a story"
+    sampling = {"temperature": 0.9, "top_p": 0.9, "seed": 5}
+    replies = []
+    for prefix_cache in (False, True):
+        sched = ContinuousScheduler(
+            pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+            autostart=False, prefix_cache=prefix_cache,
+        )
+        # Two in a row: with the cache on, the second admission splices
+        # the first's donated prompt pages.
+        _, results = _run_all(
+            sched, [(q, 6, dict(sampling)), (q, 6, dict(sampling))]
+        )
+        replies.append([r[0] for r in results])
+    assert replies[0][0] == replies[0][1]  # deterministic replay, cold
+    assert replies[0] == replies[1]  # cached == cold, both requests
+
+
+def test_cow_splice_on_page_aligned_prompt(pipe):
+    """When the cache covers the ENTIRE prompt, admission must keep one
+    token to prefill — the write lands mid-page in a shared page, which
+    triggers the copy-on-write splice. Craft a page-aligned prompt and
+    demand bit-equal replies plus a mid-page hit count."""
+    ps = 16
+    base = SYS + "describe it"
+    L = len(pipe._prepare_request({"question": base})[0])
+    q = base + "x" * ((-L) % ps)  # pad until the prompt is page-aligned
+    L = len(pipe._prepare_request({"question": q})[0])
+    assert L % ps == 0
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    handles, results = _run_all(sched, [(q, 6, None), (q, 6, None)])
+    assert results[0][0] == results[1][0] == pipe.chat(q, max_new_tokens=6)
+    # Second admission matched the whole prompt, clamped to L-1 — a
+    # mid-page splice (hit count not a page multiple) proves COW ran.
+    assert metrics.get("prefix_cache_hit_tokens_total") == L - 1
+    sched._check_pool_invariant()
+
+
+def test_chunked_prefill_interleaves_and_matches(pipe):
+    """Admission prefill bounded at prefill_chunk tokens per engine
+    step: replies still match the solo pipeline bit-for-bit and the
+    chunk-size histogram shows multiple bounded dispatches."""
+    long_q = SYS * 3 + "summarize everything above"
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=1024,
+        metrics=metrics, autostart=False, prefill_chunk=64,
+    )
+    reqs = [("hello there", 8, None), (long_q, 6, None)]
+    handles, results = _run_all(sched, reqs)
+    for (q, cap, _), (reply, _, _) in zip(reqs, results):
+        assert reply == pipe.chat(q, max_new_tokens=cap), q
+    L = len(pipe._prepare_request({"question": long_q})[0])
+    assert L > 64  # the long prompt genuinely needed several chunks
+    fam = metrics.registry.existing("prefill_chunk_tokens")
+    hist = fam._children[()]
+    assert hist.total >= math.ceil(L / 64) + 1
+    assert metrics.get("prefill_tokens_total") >= L
+    sched._check_pool_invariant()
+
+
+def test_eviction_readmit_replay_with_shared_pages(pipe):
+    """Page pressure with the cache holding shared pages: the younger
+    slot evicts, re-admits over the (still cached) prefix, replays
+    deterministically, and the pool invariant balances afterwards."""
+    q1, q2 = SYS + "first question here", SYS + "second question here"
+    chunk, ps = 4, 16
+    row1 = np.asarray(pipe._prepare_request({"question": q1})[0])
+    row2 = np.asarray(pipe._prepare_request({"question": q2})[0])
+    ids1, ids2 = len(row1), len(row2)
+    m = min(ids1, ids2)
+    neq = row1[:m] != row2[:m]
+    shared_full = (int(np.argmax(neq)) if neq.any() else m) // ps
+    admit1 = math.ceil((ids1 + chunk) / ps)
+    admit2 = math.ceil((ids2 + chunk) / ps)
+    cap = (admit1 * ps - ids1) + ps  # force one growth page per row
+    metrics = ServingMetrics()
+    # Pool sized WITH sharing in mind: the second admission splices
+    # `shared_full` pages instead of allocating them, so pressure needs
+    # that many fewer pages to materialize.
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
+        num_pages=admit1 + admit2 - shared_full + 1, metrics=metrics,
+        autostart=False,
+    )
+    handles, results = _run_all(sched, [(q1, cap, None), (q2, cap, None)])
+    assert metrics.get("evicted") >= 1
+    for q, (reply, reason, usage) in zip((q1, q2), results):
+        assert reply == pipe.chat(q, max_new_tokens=cap), q
+        assert usage[1] == cap
+    sched._check_pool_invariant()
+
+
+def test_max_tokens_1_finish_donates_only_written_kv(pipe):
+    """Regression: a max_tokens=1 request finishes at ACTIVATION — its
+    tok0 is emitted but never fed back, so its KV slot holds prefill pad
+    garbage. Finish-time donation must cap at the device-confirmed KV
+    length, or a page-boundary at prompt+1 poisons the cache."""
+    ps = 16
+    base = SYS + "one token please"
+    L = len(pipe._prepare_request({"question": base})[0])
+    q = base + "y" * ((-(L + 1)) % ps)  # (L+1) page-aligned
+    L = len(pipe._prepare_request({"question": q})[0])
+    assert (L + 1) % ps == 0
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=4, max_ctx=512,
+        autostart=False,
+    )
+    handles, results = _run_all(sched, [(q, 1, None)])
+    assert results[0][2][1] == 1  # completion_tokens
+    # Only the PROMPT's full pages may be cached — the (L+1)-token
+    # boundary would include the never-written tok0 slot.
+    assert sched.prefix_cache.pages == L // ps
+    sched._check_pool_invariant()
+
+
+def test_session_cache_drops_unreachable_displaced_states(pipe):
+    """Regression: every turn's stream extends the last, shadowing its
+    whole trie path — the superseded state must be dropped immediately,
+    not pinned (a dense HBM cache) until LRU rotation."""
+    shared = SessionPrefixCache(block_size=16, capacity=4)
+    s = ChatSession(pipe, shared=shared)
+    s.ask(SYS + "turn one", max_new_tokens=4)
+    assert shared.entries == 1
+    s.ask("turn two", max_new_tokens=4)
+    # Turn 2's path covers turn 1's entirely: exactly one state remains.
+    assert shared.entries == 1
+
+
+def test_prefix_cache_metrics_families_render(pipe):
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    text = metrics.render()
+    for fam in (
+        "oryx_serving_prefix_cache_hit_tokens_total",
+        "oryx_serving_prefix_cache_miss_tokens_total",
+        "oryx_serving_prefix_cache_evicted_pages_total",
+        "oryx_serving_prefix_cache_entries",
+        "oryx_serving_prefix_cache_pages",
+        "oryx_serving_prefill_tokens_total",
+        "oryx_serving_prefill_chunk_tokens_bucket",
+    ):
+        assert fam in text, fam
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-session dense-cache plane
+# ---------------------------------------------------------------------------
+
+
+def test_session_prefix_cache_cross_session_reuse(pipe):
+    """Two fresh ChatSessions sharing the pipe-level index: the second
+    session's first ask reuses the first's donated state and still
+    answers exactly like an uncached session."""
+    shared = SessionPrefixCache(block_size=16, capacity=2)
+    q = SYS + "what do you see?"
+    s1 = ChatSession(pipe, shared=shared)
+    r1 = s1.ask(q, max_new_tokens=6)
+    assert shared.entries == 1
+    s2 = ChatSession(pipe, shared=shared)
+    probe = shared.lookup(
+        np.asarray(pipe._prepare_request({"question": q})[0], np.int64)
+    )
+    assert probe is not None  # the donated state is reachable
+    r2 = s2.ask(SYS + "anything else?", max_new_tokens=6)
+    plain = ChatSession(pipe, cache=False)
+    assert r1 == plain.ask(q, max_new_tokens=6)
+    plain2 = ChatSession(pipe, cache=False)
+    assert r2 == plain2.ask(SYS + "anything else?", max_new_tokens=6)
+    # A STREAMED session seeds from the shared index too and yields the
+    # identical reply.
+    s_stream = ChatSession(pipe, shared=shared)
+    streamed = "".join(s_stream.ask_stream(q, max_new_tokens=6))
+    assert streamed == r1
+    # Capacity bound: a third distinct conversation evicts the LRU.
+    s3 = ChatSession(pipe, shared=shared)
+    s3.ask("totally different " * 3, max_new_tokens=4)
+    assert shared.entries <= 2
+
+
+def test_session_cache_media_fingerprint_guard(pipe):
+    """Text states must never seed an image session and vice versa: the
+    media fingerprint roots the trie."""
+    shared = SessionPrefixCache(block_size=16, capacity=4)
+    s1 = ChatSession(pipe, shared=shared)
+    s1.ask(SYS + "hello", max_new_tokens=4)
+    img = (np.random.default_rng(0).integers(
+        0, 255, size=(64, 64, 3)
+    ).astype(np.uint8))
+    ids = pipe._prepare_request({"question": SYS + "hello"})[0]
+    assert shared.lookup(
+        np.asarray(ids, np.int64),
+        media_key=(((64, 64, 3), 123),),
+    ) is None

@@ -127,9 +127,14 @@ def test_eviction_requeues_and_replays(pipe):
     admit2 = math.ceil((ids2 + chunk) / ps)
     cap = (admit1 * ps - ids1) + ps  # forces one extra page per row
     metrics = ServingMetrics()
+    # prefix_cache off: the template prefix both prompts share would
+    # otherwise be SPLICED (shared pages), dissolving the engineered
+    # pressure — this test targets the eviction machinery itself
+    # (tests/test_prefix_cache.py covers eviction WITH sharing).
     sched = ContinuousScheduler(
         pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
         num_pages=admit1 + admit2 + 1, metrics=metrics, autostart=False,
+        prefix_cache=False,
     )
     handles, results = _run_all(
         sched, [(q1, cap, None), (q2, cap, None)]
@@ -196,10 +201,13 @@ def test_request_traces_cover_lifecycle_and_eviction(pipe):
     cap = (admit1 * ps - ids1) + ps
     metrics = ServingMetrics()
     tracer = trace_lib.Tracer()
+    # prefix_cache off for the same reason as
+    # test_eviction_requeues_and_replays: shared template pages would
+    # dissolve the page pressure this test relies on.
     sched = ContinuousScheduler(
         pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
         num_pages=admit1 + admit2 + 1, metrics=metrics, autostart=False,
-        tracer=tracer,
+        tracer=tracer, prefix_cache=False,
     )
     handles, results = _run_all(
         sched, [(q1, cap, None), (q2, cap, None)]
