@@ -1,0 +1,47 @@
+"""oryxlint: JAX-aware static analysis + runtime sanitizers.
+
+Static side (dependency-free, AST-only — see `core.py`):
+
+  rule id           what it catches
+  ----------------  ---------------------------------------------------
+  lock-discipline   `# guarded-by:` fields touched outside their lock
+  use-after-donate  buffers read after a donating jit call consumed them
+  host-sync         implicit device→host syncs inside `# hot-path` code
+  recompile-hazard  tracer branches / unhashable static operands
+  metric-name       family naming + one-kind-per-name, repo-wide
+
+Run it: `python scripts/run_oryxlint.py [--strict] [--changed-only]`.
+Suppress a finding: `# oryxlint: disable=<rule>` on its line (regions:
+`# oryxlint: off=<rule>` … `# oryxlint: on=<rule>`).
+
+Runtime side (`sanitizers.py`, imports jax lazily):
+`recompile_watchdog()` (compile-storm budget + `oryx_recompiles_total`)
+and `donation_guard()` (donation actually happened / use-after-donate
+tripwire).
+"""
+
+from oryx_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    LintResult,
+    ParsedModule,
+    RepoContext,
+    render_json,
+    render_text,
+    run_lint,
+)
+from oryx_tpu.analysis.runner import (  # noqa: F401
+    ALL_CHECKERS,
+    default_files,
+    main,
+    make_checkers,
+)
+from oryx_tpu.analysis.sanitizers import (  # noqa: F401
+    DonationGuard,
+    RecompileStats,
+    RecompileStormError,
+    UseAfterDonateError,
+    backend_donates,
+    donation_guard,
+    recompile_watchdog,
+)

@@ -1,0 +1,285 @@
+"""oryxlint core: dependency-free AST lint framework.
+
+The stack's three concurrency- and compilation-sensitive hot paths —
+the threaded continuous-batching scheduler over a shared refcounted
+page pool, jitted prefill/decode with donated buffers, and the trainer
+step loop — share a family of bug classes pytest can't see on CPU in
+seconds: lock-discipline violations, use-after-donate, silent host
+syncs in decode loops, recompile storms, metric-name drift. Each
+checker here is a small AST visitor over one of those invariants; the
+runner applies them to the whole repo and `scripts/check_tier1.sh`
+gates on a clean self-lint.
+
+Design rules:
+  * stdlib only (`ast`, `re`) — the linter must run before jax
+    imports, in CI images without the accelerator stack, and in <2 s
+    over the whole tree.
+  * never import the code under analysis — everything is source-level.
+  * two passes: every checker first `scan()`s every module into a
+    shared `RepoContext` (cross-module facts: which functions donate
+    which params, which metric families exist where), then `check()`s
+    each module against that context.
+  * suppression is per-line and explicit:
+        x = f(y)  # oryxlint: disable=use-after-donate
+    or a region (for a deliberate block, e.g. the scheduler's harvest
+    syncs):
+        # oryxlint: off=host-sync
+        ...
+        # oryxlint: on=host-sync
+    or whole-file:
+        # oryxlint: disable-file=metric-name
+    `disable=all` / `off=all` suppress every rule. Suppressions are
+    counted and reported, so `--strict` output still shows where the
+    escapes live.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from typing import Any, Callable, Iterable, Iterator
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding, anchored to a source line."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_DISABLE_RE = re.compile(r"#\s*oryxlint:\s*disable=([a-z0-9_,\- ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*oryxlint:\s*disable-file=([a-z0-9_,\- ]+)")
+_OFF_RE = re.compile(r"#\s*oryxlint:\s*off=([a-z0-9_,\- ]+)")
+_ON_RE = re.compile(r"#\s*oryxlint:\s*on=([a-z0-9_,\- ]+)")
+
+
+def _split_rules(spec: str) -> set[str]:
+    return {r.strip() for r in spec.split(",") if r.strip()}
+
+
+class ParsedModule:
+    """One source file: text, AST, and its suppression map."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.file_disables: set[str] = set()
+        # line (1-based) -> rules suppressed on that line.
+        self.line_disables: dict[int, set[str]] = {}
+        self._parse_suppressions()
+
+    def _comments_by_line(self) -> dict[int, str]:
+        """line (1-based) -> comment text. Tokenized, not regexed over
+        raw lines, so a docstring or string literal QUOTING the
+        directive syntax (this module's own docstring does) can never
+        disable rules."""
+        out: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass  # ast.parse succeeded; truncated tail tokens only
+        return out
+
+    def comment_text(self, line: int) -> str:
+        """The comment on `line` ('' when none) — checkers read markers
+        (`# guarded-by:`, `# hot-path`) through this, never through raw
+        line text, for the same quoting-safety reason."""
+        return self._comments.get(line, "")
+
+    def _parse_suppressions(self) -> None:
+        comments = self._comments = self._comments_by_line()
+        region: set[str] = set()  # rules currently `off`
+        for i in range(1, len(self.lines) + 1):
+            text = comments.get(i, "")
+            if "oryxlint" not in text:
+                if region:
+                    self.line_disables.setdefault(i, set()).update(region)
+                continue
+            m = _DISABLE_FILE_RE.search(text)
+            if m:
+                self.file_disables |= _split_rules(m.group(1))
+            m = _OFF_RE.search(text)
+            if m:
+                region |= _split_rules(m.group(1))
+            m = _ON_RE.search(text)
+            if m:
+                region -= _split_rules(m.group(1))
+                if "all" in _split_rules(m.group(1)):
+                    region.clear()
+            per_line = set(region)
+            m = _DISABLE_RE.search(text)
+            if m:
+                per_line |= _split_rules(m.group(1))
+            if per_line:
+                self.line_disables.setdefault(i, set()).update(per_line)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if "all" in self.file_disables or rule in self.file_disables:
+            return True
+        rules = self.line_disables.get(line)
+        return bool(rules) and ("all" in rules or rule in rules)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class RepoContext:
+    """Cross-module facts accumulated by checkers' scan() pass."""
+
+    def __init__(self) -> None:
+        # use-after-donate: simple fn name -> {"names": set[str],
+        # "positions": set[int]} of donated parameters.
+        self.donators: dict[str, dict[str, set]] = {}
+        # fn name -> ordered param names (for positional resolution of
+        # donated/static operands at call sites).
+        self.fn_params: dict[str, list[str]] = {}
+        # recompile-hazard: jitted fn name -> set of static param names;
+        # aliases map `name = jax.jit(fn, ...)` bindings to the wrapped
+        # fn whose def provides positional parameter order.
+        self.jitted_static: dict[str, set[str]] = {}
+        self.jit_aliases: dict[str, str] = {}
+        # metric-name: family name -> kind -> [(path, line)].
+        self.metric_sites: dict[str, dict[str, list[tuple[str, int]]]] = {}
+
+
+class Checker:
+    """Base checker: `scan` every module first, then `check` each one.
+
+    Subclasses set `name` (the rule id used in findings and
+    suppressions) and implement `check`; `scan` is optional."""
+
+    name = "base"
+
+    def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
+        return None
+
+    def check(self, mod: ParsedModule, ctx: RepoContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    # Shared helper: build a Finding unless that line suppresses it.
+    def finding(
+        self, mod: ParsedModule, node: ast.AST, message: str
+    ) -> Finding | None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if mod.suppressed(line, self.name):
+            return None
+        return Finding(mod.path, line, col, self.name, message)
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a`, `a.b.c`, `self.kv_pages` → dotted string; anything with a
+    non-Name base (calls, subscripts) → None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    errors: list[tuple[str, str]]  # (path, parse error)
+    files: int
+    suppressed: int
+
+
+def run_lint(
+    paths_and_sources: Iterable[tuple[str, str]],
+    checkers: Iterable[Checker],
+    check_only: set[str] | None = None,
+) -> LintResult:
+    """Parse every file, run every checker's scan pass over ALL of
+    them, then the check pass. Returns findings sorted by location.
+    Files that fail to parse are reported as errors, not findings —
+    a syntax error is the interpreter's job to explain.
+
+    check_only: restrict the CHECK pass to these paths while the scan
+    pass still sees everything — the `--changed-only` contract. The
+    cross-module facts (donation registry, metric kind map) come from
+    the whole tree, so editing one caller of a donating function
+    defined elsewhere still lints correctly."""
+    checkers = list(checkers)
+    ctx = RepoContext()
+    mods: list[ParsedModule] = []
+    errors: list[tuple[str, str]] = []
+    for path, source in paths_and_sources:
+        try:
+            mods.append(ParsedModule(path, source))
+        except SyntaxError as e:
+            errors.append((path, f"{type(e).__name__}: {e}"))
+    for checker in checkers:
+        for mod in mods:
+            checker.scan(mod, ctx)
+    findings: list[Finding] = []
+    suppressed = 0
+    checked = [
+        m for m in mods
+        if check_only is None or m.path in check_only
+    ]
+    for checker in checkers:
+        for mod in checked:
+            for f in checker.check(mod, ctx):
+                if f is None:
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    findings.sort()
+    return LintResult(findings, errors, len(checked), suppressed)
+
+
+def render_text(result: LintResult) -> str:
+    out = [f.format() for f in result.findings]
+    for path, err in result.errors:
+        out.append(f"{path}:1:0: [parse-error] {err}")
+    by_rule: dict[str, int] = {}
+    for f in result.findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    out.append(
+        f"oryxlint: {len(result.findings)} finding(s)"
+        + (f" ({summary})" if summary else "")
+        + f", {result.suppressed} suppressed, {result.files} file(s)"
+        + (f", {len(result.errors)} parse error(s)" if result.errors else "")
+    )
+    return "\n".join(out)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in result.findings],
+            "errors": [
+                {"path": p, "error": e} for p, e in result.errors
+            ],
+            "files": result.files,
+            "suppressed": result.suppressed,
+        },
+        indent=2,
+    )

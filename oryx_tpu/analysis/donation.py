@@ -1,0 +1,339 @@
+"""use-after-donate: a buffer passed in a donated position must not be
+read again until rebound.
+
+`jax.jit(..., donate_argnames=...)` consumes its operand: after the
+call the old array is deleted and any later read raises (TPU) or —
+worse, with a warm persistent cache on some jax versions — silently
+reads stale memory (see tests/conftest.py's donation-cache quirk).
+The repo's donating callees are its hottest programs (`paged_prefill`,
+`paged_decode_chunk`, `copy_pages`, `_stream_chunk`, the trainer's
+`_step`), and the idiom that keeps them safe is rebinding in the same
+statement:
+
+    self.kv_pages = paged_kv.copy_pages(self.kv_pages, src, dst)
+
+This checker scans the whole repo for jit-with-donation definitions
+(decorator `@partial(jax.jit, donate_argnames=...)`, bare
+`@jax.jit(...)` calls, and `name = jax.jit(fn, donate_argnames=...)`
+assignments), resolves donated parameter names to positions via the
+callee's def when it can see one, then walks every function body in
+statement order: a Name or dotted attribute passed in a donated
+position becomes DEAD at that statement; any later read of the same
+dotted name before an assignment rebinds it is a finding. Branches
+merge pessimistically (dead in either arm = possibly dead after) and
+loop bodies run twice so a donation at the bottom of a loop is seen by
+the read at the top of the next iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+)
+
+
+def _tail(name: str | None) -> str | None:
+    """`generate_lib.paged_prefill` -> `paged_prefill`; `self._step`
+    -> `_step` (cross-module calls match by simple-name tail)."""
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+def _const_strs(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            out |= _const_strs(elt)
+    return out
+
+
+def _const_ints(node: ast.AST) -> set[int]:
+    out: set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            out |= _const_ints(elt)
+    return out
+
+
+def _jit_donations(call: ast.Call) -> tuple[set[str], set[int]] | None:
+    """If `call` is jax.jit(...) or partial(jax.jit, ...), return its
+    (donate_argnames, donate_argnums); None when it isn't a jit."""
+    f = dotted_name(call.func)
+    is_jit = _tail(f) == "jit" and (f or "").split(".")[0] in (
+        "jax", "jit"
+    )
+    is_partial_jit = _tail(f) == "partial" and any(
+        _tail(dotted_name(a)) == "jit" for a in call.args[:1]
+    )
+    if not (is_jit or is_partial_jit):
+        return None
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnames":
+            names |= _const_strs(kw.value)
+        elif kw.arg == "donate_argnums":
+            nums |= _const_ints(kw.value)
+    return names, nums
+
+
+class UseAfterDonateChecker(Checker):
+    name = "use-after-donate"
+
+    # ---- pass 1: build the donation registry -----------------------------
+
+    def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = [a.arg for a in node.args.args]
+                ctx.fn_params.setdefault(node.name, params)
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        don = _jit_donations(dec)
+                        if don and (don[0] or don[1]):
+                            self._register(ctx, node.name, params, *don)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                don = _jit_donations(node.value)
+                if not don or not (don[0] or don[1]):
+                    continue
+                # `self._step = jax.jit(step_lib.train_step_fn, ...)`:
+                # register under the bound name; the callee's def (if
+                # scanned) provides positional resolution later.
+                callee = None
+                if node.value.args:
+                    callee = _tail(dotted_name(node.value.args[0]))
+                for target in node.targets:
+                    t = _tail(dotted_name(target))
+                    if t:
+                        self._register(
+                            ctx, t, None, *don, callee_name=callee
+                        )
+
+    def _register(
+        self,
+        ctx: RepoContext,
+        name: str,
+        params: list[str] | None,
+        donate_names: set[str],
+        donate_nums: set[int],
+        callee_name: str | None = None,
+    ) -> None:
+        entry = ctx.donators.setdefault(
+            name, {"names": set(), "positions": set(), "callee": set()}
+        )
+        entry["names"] |= donate_names
+        entry["positions"] |= donate_nums
+        if callee_name:
+            entry["callee"].add(callee_name)
+        if params is not None:
+            ctx.fn_params[name] = params
+            for i in donate_nums:
+                if i < len(params):
+                    entry["names"].add(params[i])
+            for n in donate_names:
+                if n in params:
+                    entry["positions"].add(params.index(n))
+
+    def _resolve_positions(self, ctx: RepoContext, name: str) -> set[int]:
+        entry = ctx.donators[name]
+        positions = set(entry["positions"])
+        # Names registered without a visible def (assignment form)
+        # resolve positions through the wrapped callee's params.
+        for source in (name, *entry["callee"]):
+            params = ctx.fn_params.get(source)
+            if params:
+                positions |= {
+                    params.index(n)
+                    for n in entry["names"]
+                    if n in params
+                }
+        return positions
+
+    # ---- pass 2: dead-name walk ------------------------------------------
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(mod, node, ctx)
+
+    def _check_fn(
+        self, mod: ParsedModule, fn: ast.FunctionDef, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        findings: list[Finding | None] = []
+        emitted: set[tuple[int, int, str]] = set()
+        # dead: dotted name -> (callee, donation line)
+        dead: dict[str, tuple[str, int]] = {}
+
+        def kill(name: str, callee: str, line: int) -> None:
+            dead[name] = (callee, line)
+
+        def revive(target: ast.AST) -> None:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    revive(elt)
+                return
+            if isinstance(target, ast.Starred):
+                revive(target.value)
+                return
+            d = dotted_name(target)
+            if d is None:
+                # Assignment through a subscript (`state["kv"] = ...`)
+                # revives the container conservatively.
+                if isinstance(target, ast.Subscript):
+                    revive(target.value)
+                return
+            for k in list(dead):
+                if k == d or k.startswith(d + "."):
+                    del dead[k]
+
+        def read(node: ast.AST) -> None:
+            d = dotted_name(node)
+            if d is None:
+                return
+            # `kv.shape` (or `self.kv["k"]`'s inner attribute) is a
+            # read of dead `kv`: match the dead name or any dotted
+            # extension of it.
+            hit = next(
+                (
+                    k for k in dead
+                    if d == k or d.startswith(k + ".")
+                ),
+                None,
+            )
+            if hit is None:
+                return
+            d = hit
+            callee, line = dead[d]
+            key = (node.lineno, node.col_offset, d)
+            if key in emitted:
+                return
+            emitted.add(key)
+            findings.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"'{d}' was donated to '{callee}' on line {line} "
+                    f"and is read again before being rebound",
+                )
+            )
+
+        def eval_expr(node: ast.AST) -> None:
+            """Post-order: donations of a call's operands happen after
+            the operands (and any inner calls) are evaluated."""
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                d = dotted_name(node)
+                if d is not None:
+                    read(node)
+                    return  # don't double-count the chain's parts
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return  # nested defs execute later; out of scope
+            for child in ast.iter_child_nodes(node):
+                eval_expr(child)
+            if isinstance(node, ast.Call):
+                callee = _tail(dotted_name(node.func))
+                if callee in ctx.donators:
+                    entry = ctx.donators[callee]
+                    positions = self._resolve_positions(ctx, callee)
+                    for i, arg in enumerate(node.args):
+                        if i in positions:
+                            d = dotted_name(arg)
+                            if d:
+                                kill(d, callee, node.lineno)
+                    for kw in node.keywords:
+                        if kw.arg in entry["names"]:
+                            d = dotted_name(kw.value)
+                            if d:
+                                kill(d, callee, node.lineno)
+
+        def exec_stmts(stmts: list[ast.stmt]) -> None:
+            for stmt in stmts:
+                exec_stmt(stmt)
+
+        def branch(bodies: list[list[ast.stmt]]) -> None:
+            nonlocal dead
+            entry_state = dict(dead)
+            merged: dict[str, tuple[str, int]] = {}
+            for body in bodies:
+                dead = dict(entry_state)
+                exec_stmts(body)
+                merged.update(dead)
+            dead = merged
+
+        def exec_stmt(stmt: ast.stmt) -> None:
+            nonlocal dead
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(stmt, ast.Assign):
+                eval_expr(stmt.value)
+                for t in stmt.targets:
+                    revive(t)
+            elif isinstance(stmt, ast.AnnAssign):
+                if stmt.value is not None:
+                    eval_expr(stmt.value)
+                    revive(stmt.target)
+            elif isinstance(stmt, ast.AugAssign):
+                eval_expr(stmt.value)
+                eval_expr(stmt.target)
+                revive(stmt.target)
+            elif isinstance(stmt, ast.If):
+                eval_expr(stmt.test)
+                branch([stmt.body, stmt.orelse])
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                eval_expr(stmt.iter)
+                revive(stmt.target)
+                # Twice: a donation at the bottom of the body must be
+                # seen by the read at the top of the next iteration.
+                entry_state = dict(dead)
+                exec_stmts(stmt.body)
+                exec_stmts(stmt.body)
+                exec_stmts(stmt.orelse)
+                dead = {**entry_state, **dead}
+            elif isinstance(stmt, ast.While):
+                eval_expr(stmt.test)
+                entry_state = dict(dead)
+                exec_stmts(stmt.body)
+                eval_expr(stmt.test)
+                exec_stmts(stmt.body)
+                exec_stmts(stmt.orelse)
+                dead = {**entry_state, **dead}
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    eval_expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        revive(item.optional_vars)
+                exec_stmts(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                exec_stmts(stmt.body)
+                for handler in stmt.handlers:
+                    exec_stmts(handler.body)
+                exec_stmts(stmt.orelse)
+                exec_stmts(stmt.finalbody)
+            elif isinstance(stmt, (ast.Return, ast.Expr)):
+                if stmt.value is not None:
+                    eval_expr(stmt.value)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    revive(t)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    eval_expr(child)
+
+        exec_stmts(fn.body)
+        yield from findings

@@ -1,0 +1,102 @@
+"""host-sync: no implicit device→host syncs inside `# hot-path` code.
+
+A `.item()`, `float(arr)`, `np.asarray(arr)` or `jax.device_get(...)`
+inside the decode loop blocks the host on the device queue and
+serializes dispatch — the classic "TPU at 40% because the scheduler
+reads one scalar per token" regression. Tests never see it (CPU,
+tiny shapes); production sees it as a throughput cliff.
+
+Functions that ARE the hot path — the scheduler's decode-chunk loop,
+the paged/streaming generate loops, the trainer step loop — carry a
+`# hot-path` marker on (or immediately above) their `def` line. Inside
+them, every flagged call must either go away or carry a per-line
+`# oryxlint: disable=host-sync` (or an off/on region for a deliberate
+harvest block) with a justification — which is exactly the review
+conversation the rule exists to force.
+
+Flagged forms:
+  * `<expr>.item()`
+  * `float(x)` where x is a name/attribute/subscript (a cast of an
+    array-like; `float("1e-3")` and `float(fn())` are not flagged)
+  * `np.asarray(...)` / `numpy.asarray(...)`
+  * `jax.device_get(...)`
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+)
+
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+
+
+def is_hot(mod: ParsedModule, fn: ast.FunctionDef) -> bool:
+    """True when `# hot-path` appears on the def line, above the
+    decorator stack, or anywhere in between — a marker placed between
+    the decorators and `def` (the natural spot when a decorator is
+    added later) must keep the rule applying."""
+    first = min(
+        [fn.lineno] + [d.lineno for d in fn.decorator_list]
+    )
+    return any(
+        "hot-path" in mod.comment_text(line)
+        for line in range(first - 1, fn.lineno + 1)
+    )
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        for node in ast.walk(mod.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and is_hot(mod, node):
+                yield from self._check_fn(mod, node)
+
+    def _check_fn(
+        self, mod: ParsedModule, fn: ast.FunctionDef
+    ) -> Iterator[Finding | None]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._sync_reason(node)
+            if msg:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"{msg} inside hot-path '{fn.name}' blocks the "
+                    "host on the device queue; hoist it out of the "
+                    "loop or justify with a suppression",
+                )
+
+    @staticmethod
+    def _sync_reason(call: ast.Call) -> str | None:
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "item"
+            and not call.args
+        ):
+            return "'.item()' host sync"
+        d = dotted_name(call.func)
+        if d in _SYNC_CALLS:
+            return f"'{d}(...)' host transfer"
+        if (
+            d == "float"
+            and len(call.args) == 1
+            and isinstance(
+                call.args[0], (ast.Name, ast.Attribute, ast.Subscript)
+            )
+        ):
+            return "'float(...)' cast of an array-like"
+        return None

@@ -1,0 +1,126 @@
+"""lock-discipline: `# guarded-by:` annotated fields must be touched
+under their lock.
+
+The continuous scheduler shares exactly two pieces of state between
+the HTTP threads and the engine thread (`_queue`, `_shutdown`), both
+guarded by `self._cond`; the tracer's flight recorder and every Trace
+share their span lists under `_lock`. A forgotten `with self._cond:`
+is invisible to tests (CPython's GIL makes the race a once-a-week
+production artifact) — so the discipline is declared in the source and
+enforced statically:
+
+    self._queue: deque[_Request] = deque()  # guarded-by: _cond
+
+Every `self._queue` read or write in that class (outside `__init__`,
+which runs before publication) must then sit lexically inside
+`with self._<lock>:` (any `with` whose context expression is
+`self.<lock>`, possibly among other items). Accesses that are safe for
+a structural reason the checker can't see carry a per-line
+`# oryxlint: disable=lock-discipline` with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+)
+
+# The declaration line must assign the field AND carry the marker in a
+# real comment (ParsedModule.comment_text — string literals quoting the
+# syntax don't count).
+_DECL_LINE_RE = re.compile(r"self\.(\w+)\s*(?::[^=#]+)?=")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node)
+
+    def _guarded_fields(
+        self, mod: ParsedModule, cls: ast.ClassDef
+    ) -> dict[str, str]:
+        """field -> lock, from `# guarded-by:` comments on assignment
+        lines inside the class body."""
+        end = max(
+            (getattr(n, "end_lineno", cls.lineno) for n in ast.walk(cls)),
+            default=cls.lineno,
+        )
+        fields: dict[str, str] = {}
+        for line in range(cls.lineno, end + 1):
+            m = _GUARDED_RE.search(mod.comment_text(line))
+            if not m:
+                continue
+            decl = _DECL_LINE_RE.search(mod.line_text(line))
+            if decl:
+                fields[decl.group(1)] = m.group(1)
+        return fields
+
+    def _check_class(
+        self, mod: ParsedModule, cls: ast.ClassDef
+    ) -> Iterator[Finding | None]:
+        fields = self._guarded_fields(mod, cls)
+        if not fields:
+            return
+        for item in cls.body:
+            if not isinstance(
+                item, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if item.name == "__init__":
+                # Construction happens-before publication: the fields
+                # (and often the lock itself) don't exist yet.
+                continue
+            yield from self._check_method(mod, item, fields)
+
+    def _check_method(
+        self,
+        mod: ParsedModule,
+        fn: ast.FunctionDef,
+        fields: dict[str, str],
+    ) -> Iterator[Finding | None]:
+        def visit(node: ast.AST, held: frozenset[str]):
+            if isinstance(node, ast.With):
+                got = set(held)
+                for item in node.items:
+                    d = dotted_name(item.context_expr)
+                    if d and d.startswith("self."):
+                        got.add(d[len("self."):])
+                for expr in node.items:
+                    yield from visit(expr, held)
+                inner = frozenset(got)
+                for stmt in node.body:
+                    yield from visit(stmt, inner)
+                return
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in fields
+                and fields[node.attr] not in held
+            ):
+                lock = fields[node.attr]
+                yield self.finding(
+                    mod,
+                    node,
+                    f"'self.{node.attr}' is declared guarded-by "
+                    f"'{lock}' but is accessed outside "
+                    f"'with self.{lock}:'",
+                )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        yield from visit(fn, frozenset())

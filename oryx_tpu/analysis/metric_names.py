@@ -1,0 +1,164 @@
+"""metric-name: one naming discipline for every metric family.
+
+PR 3 unified train and serve onto one Prometheus-model `Registry`, and
+the contract that keeps dashboards and the runbook greppable is
+lexical: every family renders as `oryx_<...>` in lowercase snake_case,
+and a name means ONE thing — the registry enforces no-duplicate-family
+at runtime, this rule enforces it at review time, across modules, for
+both registries at once.
+
+Checked call shapes (any receiver; the first argument must name the
+family):
+
+  declarations  reg.counter("x") / .gauge / .histogram / .info(...)
+  usages        metrics.inc("x") / .set_gauge / .observe / .set_info
+
+Rules:
+  * literal names match `^[a-z][a-z0-9_]*$` (the registry prefix
+    supplies the `oryx_` vendor prefix); with `raw_name=True` the
+    literal IS the full family name and must match
+    `^oryx_[a-z0-9_]+$`.
+  * a family name must resolve to exactly one metric kind repo-wide:
+    `inc("queue_depth")` in one file and `set_gauge("queue_depth")`
+    in another is the split-brain this catches (the runtime error
+    only fires when both code paths run in one process).
+  * declaration names must be string literals — a computed name can't
+    be checked, greped for, or pre-registered; tabulate the names and
+    suppress the loop with a justification if you must.
+
+`.info(...)` is only treated as a metric declaration when the receiver
+looks like a registry (`...registry.info` / `reg.info`) so ordinary
+`logger.info("...")` lines never match.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+)
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_RAW_NAME_RE = re.compile(r"^oryx_[a-z0-9_]+$")
+
+# method -> metric kind it declares/uses.
+_DECLARING = {"counter": "counter", "gauge": "gauge",
+              "histogram": "histogram", "info": "info"}
+_USING = {"inc": "counter", "set_gauge": "gauge",
+          "observe": "histogram", "set_info": "info"}
+
+
+def _metric_call(call: ast.Call) -> tuple[str, str, bool] | None:
+    """(kind, method, is_declaration) for metric-family call shapes."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    method = call.func.attr
+    if method in _DECLARING:
+        if method == "info":
+            recv = dotted_name(call.func.value) or ""
+            tail = recv.rsplit(".", 1)[-1]
+            if not (tail in ("reg", "r") or "registr" in tail):
+                return None
+        return _DECLARING[method], method, True
+    if method in _USING:
+        return _USING[method], method, False
+    return None
+
+
+class MetricNameChecker(Checker):
+    name = "metric-name"
+
+    # ---- pass 1: gather every (name, kind) site --------------------------
+
+    def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            mk = _metric_call(call)
+            if mk is None or not call.args:
+                continue
+            if mod.suppressed(call.lineno, self.name):
+                # A suppressed site (a deliberate kind-clash test, the
+                # registry plumbing) must not poison the cross-module
+                # kind map and flag CORRECT usages elsewhere.
+                continue
+            arg0 = call.args[0]
+            if not (
+                isinstance(arg0, ast.Constant)
+                and isinstance(arg0.value, str)
+            ):
+                continue
+            kind, _, _ = mk
+            ctx.metric_sites.setdefault(arg0.value, {}).setdefault(
+                kind, []
+            ).append((mod.path, call.lineno))
+
+    # ---- pass 2 ----------------------------------------------------------
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            mk = _metric_call(call)
+            if mk is None or not call.args:
+                continue
+            kind, method, declares = mk
+            arg0 = call.args[0]
+            if not (
+                isinstance(arg0, ast.Constant)
+                and isinstance(arg0.value, str)
+            ):
+                if declares:
+                    yield self.finding(
+                        mod,
+                        call,
+                        f"metric family declared via .{method}() with "
+                        "a computed name — declare family names as "
+                        "string literals so they can be checked and "
+                        "grepped",
+                    )
+                continue
+            name = arg0.value
+            raw = any(
+                kw.arg == "raw_name"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in call.keywords
+            )
+            pattern = _RAW_NAME_RE if raw else _NAME_RE
+            if not pattern.match(name):
+                want = (
+                    "oryx_<snake_case> (raw_name=True names are full "
+                    "family names)" if raw else "lowercase snake_case "
+                    "(the registry prefix supplies oryx_)"
+                )
+                yield self.finding(
+                    mod,
+                    call,
+                    f"metric family name {name!r} does not match the "
+                    f"naming discipline: expected {want}",
+                )
+                continue
+            kinds = ctx.metric_sites.get(name, {})
+            if len(kinds) > 1:
+                others = sorted(k for k in kinds if k != kind)
+                where = "; ".join(
+                    f"{k} at {kinds[k][0][0]}:{kinds[k][0][1]}"
+                    for k in others
+                )
+                yield self.finding(
+                    mod,
+                    call,
+                    f"metric family {name!r} used as a {kind} here "
+                    f"but declared/used elsewhere as: {where} — one "
+                    "family, one kind",
+                )

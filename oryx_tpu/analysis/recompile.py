@@ -1,0 +1,236 @@
+"""recompile-hazard: patterns that silently multiply compiled programs.
+
+Two hazard families, both of which burn TPU hours without failing a
+single CPU test:
+
+  * A Python `if` on a NON-static parameter inside a jitted function.
+    At best it raises TracerBoolConversionError on the first real run;
+    at worst (boolean-ish numpy input on some call paths) it traces
+    one program per observed value. Identity tests (`x is None` /
+    `is not None`) are fine — they branch on the Python structure, not
+    the traced value — and attribute reads like `x.shape[0]` are
+    static by construction; only direct value-dependent tests on the
+    parameter name are flagged.
+
+  * An unhashable or per-call-unique operand (dict/list/set literal,
+    f-string, lambda, comprehension) passed in a STATIC position of a
+    known jitted callee. Every call is a cache miss: the jit cache
+    keys static operands by hash/equality, and a fresh literal never
+    compares equal to the last one. (`api_server._parse_sampling`
+    quantizes temperature for the same reason.)
+
+The scan pass collects every jit-wrapped definition in the repo
+(decorator `@partial(jax.jit, static_argnames=...)`, `@jax.jit(...)`,
+or `name = jax.jit(fn, static_argnums=...)`) with its static parameter
+names; call sites anywhere then resolve by simple-name tail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+)
+from oryx_tpu.analysis.donation import (
+    _const_ints,
+    _const_strs,
+    _jit_donations,
+    _tail,
+)
+
+_UNHASHABLE = (
+    ast.Dict, ast.List, ast.Set, ast.JoinedStr, ast.Lambda,
+    ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+)
+
+
+def _is_bare_jit(dec: ast.AST) -> bool:
+    """`@jax.jit` with no argument list."""
+    d = dotted_name(dec)
+    return _tail(d) == "jit" and (d or "").split(".")[0] in ("jax", "jit")
+
+
+def _jit_statics(call: ast.Call) -> tuple[set[str], set[int]] | None:
+    """(static_argnames, static_argnums) when `call` is a jax.jit /
+    partial(jax.jit, ...) wrapper; None otherwise."""
+    if _jit_donations(call) is None:  # shares the jit-shape detection
+        return None
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            nums |= _const_ints(kw.value)
+    return names, nums
+
+
+class RecompileHazardChecker(Checker):
+    name = "recompile-hazard"
+
+    # ---- pass 1: collect jitted defs -------------------------------------
+
+    def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = [a.arg for a in node.args.args] + [
+                    a.arg for a in node.args.kwonlyargs
+                ]
+                ctx.fn_params.setdefault(node.name, params)
+                for dec in node.decorator_list:
+                    if _is_bare_jit(dec):
+                        ctx.jitted_static.setdefault(node.name, set())
+                        continue
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    statics = _jit_statics(dec)
+                    if statics is None:
+                        continue
+                    names, nums = statics
+                    pos = [a.arg for a in node.args.args]
+                    names |= {
+                        pos[i] for i in nums if i < len(pos)
+                    }
+                    ctx.jitted_static.setdefault(node.name, set()).update(
+                        names
+                    )
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                statics = _jit_statics(node.value)
+                if statics is None or not (statics[0] | statics[1]):
+                    continue
+                names, nums = statics
+                callee = None
+                if node.value.args:
+                    callee = _tail(dotted_name(node.value.args[0]))
+                for target in node.targets:
+                    t = _tail(dotted_name(target))
+                    if t:
+                        ctx.jitted_static.setdefault(t, set()).update(
+                            names
+                        )
+                        # Param order + argnum resolution happen at
+                        # check time through the alias (the wrapped
+                        # fn's def may live in a module scanned later).
+                        if callee:
+                            ctx.jit_aliases[t] = callee
+                        for i in nums:
+                            ctx.jitted_static[t].add(f"#argnum:{i}")
+
+    # ---- pass 2 ----------------------------------------------------------
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name in ctx.jitted_static and self._is_jitted(
+                    node
+                ):
+                    yield from self._check_tracer_branches(
+                        mod, node, ctx.jitted_static[node.name]
+                    )
+            elif isinstance(node, ast.Call):
+                yield from self._check_static_operands(mod, node, ctx)
+
+    @staticmethod
+    def _is_jitted(fn: ast.FunctionDef) -> bool:
+        return any(
+            _is_bare_jit(d)
+            or (isinstance(d, ast.Call) and _jit_statics(d) is not None)
+            for d in fn.decorator_list
+        )
+
+    def _check_tracer_branches(
+        self, mod: ParsedModule, fn: ast.FunctionDef, statics: set[str]
+    ) -> Iterator[Finding | None]:
+        traced = {
+            a.arg
+            for a in list(fn.args.args) + list(fn.args.kwonlyargs)
+            if a.arg not in statics and a.arg != "self"
+        }
+
+        def value_dependent_names(test: ast.expr) -> list[ast.Name]:
+            """Direct value tests on a traced parameter name."""
+            if isinstance(test, ast.Name):
+                return [test] if test.id in traced else []
+            if isinstance(test, ast.UnaryOp) and isinstance(
+                test.op, ast.Not
+            ):
+                return value_dependent_names(test.operand)
+            if isinstance(test, ast.BoolOp):
+                out = []
+                for v in test.values:
+                    out.extend(value_dependent_names(v))
+                return out
+            if isinstance(test, ast.Compare):
+                if all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops
+                ):
+                    return []
+                out = []
+                for side in [test.left, *test.comparators]:
+                    if (
+                        isinstance(side, ast.Name)
+                        and side.id in traced
+                    ):
+                        out.append(side)
+                return out
+            return []
+
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                continue
+            for name in value_dependent_names(node.test):
+                yield self.finding(
+                    mod,
+                    name,
+                    f"Python branch on traced argument '{name.id}' "
+                    f"inside jitted '{fn.name}' — use jnp.where/"
+                    "lax.cond, or mark it static",
+                )
+
+    def _check_static_operands(
+        self, mod: ParsedModule, call: ast.Call, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        callee = _tail(dotted_name(call.func))
+        if callee not in ctx.jitted_static:
+            return
+        statics = set(ctx.jitted_static[callee])
+        params = ctx.fn_params.get(callee) or ctx.fn_params.get(
+            ctx.jit_aliases.get(callee, ""), []
+        )
+        for s in list(statics):
+            if s.startswith("#argnum:"):
+                statics.discard(s)
+                i = int(s.split(":", 1)[1])
+                if i < len(params):
+                    statics.add(params[i])
+        operands: list[tuple[str, ast.expr]] = []
+        for i, arg in enumerate(call.args):
+            if i < len(params) and params[i] in statics:
+                operands.append((params[i], arg))
+        for kw in call.keywords:
+            if kw.arg in statics:
+                operands.append((kw.arg, kw.value))
+        for pname, arg in operands:
+            if isinstance(arg, _UNHASHABLE):
+                kind = (
+                    "f-string" if isinstance(arg, ast.JoinedStr)
+                    else type(arg).__name__.lower() + " literal"
+                )
+                yield self.finding(
+                    mod,
+                    arg,
+                    f"{kind} passed as static argument '{pname}' of "
+                    f"jitted '{callee}' — a fresh object every call "
+                    "never hits the jit cache (recompiles per call)",
+                )

@@ -1,0 +1,192 @@
+"""oryxlint runner: file discovery + CLI (the body of
+`scripts/run_oryxlint.py`).
+
+Kept inside the package so tests drive `main()` in-process; kept free
+of jax (and of the rest of oryx_tpu) so the script can stub the parent
+package and lint the tree in well under a second.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import Iterable
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    render_json,
+    render_text,
+    run_lint,
+)
+from oryx_tpu.analysis.donation import UseAfterDonateChecker
+from oryx_tpu.analysis.hostsync import HostSyncChecker
+from oryx_tpu.analysis.locks import LockDisciplineChecker
+from oryx_tpu.analysis.metric_names import MetricNameChecker
+from oryx_tpu.analysis.recompile import RecompileHazardChecker
+
+ALL_CHECKERS: tuple[type[Checker], ...] = (
+    LockDisciplineChecker,
+    UseAfterDonateChecker,
+    HostSyncChecker,
+    RecompileHazardChecker,
+    MetricNameChecker,
+)
+
+# Directories that are not our python (vendored assets, fixtures that
+# are DELIBERATELY dirty, caches).
+_EXCLUDE_DIRS = {
+    ".git", "__pycache__", ".claude", "native", "assets",
+    "lint_fixtures",
+}
+
+
+def default_files(root: str) -> list[str]:
+    out: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in _EXCLUDE_DIRS
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def changed_files(root: str) -> list[str]:
+    """Working-tree python files touched vs HEAD (plus untracked) —
+    the `--changed-only` fast path for local pre-commit runs."""
+    files: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True,
+                timeout=30, check=True,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return default_files(root)  # no git: fall back to full
+        files.update(
+            line.strip() for line in res.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    allowed = set(default_files(root))
+    return sorted(
+        p
+        for f in files
+        if (p := os.path.join(root, f)) in allowed and os.path.exists(p)
+    )
+
+
+def _sources(paths: Iterable[str]):
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                yield path, f.read()
+        except OSError as e:
+            print(f"oryxlint: cannot read {path}: {e}", file=sys.stderr)
+
+
+def make_checkers(rules: str | None = None) -> list[Checker]:
+    selected = (
+        {r.strip() for r in rules.split(",") if r.strip()}
+        if rules
+        else None
+    )
+    out = []
+    for cls in ALL_CHECKERS:
+        if selected is None or cls.name in selected:
+            out.append(cls())
+    if selected:
+        known = {c.name for c in out}
+        unknown = selected - known
+        if unknown:
+            raise SystemExit(
+                f"oryxlint: unknown rule(s) {sorted(unknown)}; "
+                f"known: {sorted(c.name for c in ALL_CHECKERS)}"
+            )
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="run_oryxlint.py",
+        description=(
+            "oryxlint: JAX-aware static analysis (lock-discipline, "
+            "use-after-donate, host-sync, recompile-hazard, "
+            "metric-name). Exits 1 on any finding; --strict (the CI "
+            "gate) additionally fails on files that don't parse."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: the whole repo)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: two levels above this package)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="CI gate mode: also exit 1 when a file fails to parse "
+        "(findings exit 1 in every mode)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable JSON report on stdout",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="lint only files changed vs HEAD (+ untracked) — the "
+        "fast local loop",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule subset (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print rule ids and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_CHECKERS:
+            doc = (sys.modules[cls.__module__].__doc__ or "").strip()
+            first = doc.splitlines()[0] if doc else ""
+            print(f"{cls.name}: {first}")
+        return 0
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    check_only = None
+    if args.paths:
+        files = []
+        for p in args.paths:
+            if os.path.isdir(p):
+                files.extend(default_files(p))
+            else:
+                files.append(p)
+    elif args.changed_only:
+        # Findings only for changed files, but the scan pass must see
+        # the WHOLE tree: the donation registry and metric kind map are
+        # cross-module, and a changed caller of an unchanged donating
+        # callee must still lint correctly.
+        files = default_files(root)
+        check_only = set(changed_files(root))
+    else:
+        files = default_files(root)
+
+    result = run_lint(
+        _sources(files), make_checkers(args.rules), check_only=check_only
+    )
+    print(render_json(result) if args.as_json else render_text(result))
+    if result.findings:
+        return 1
+    if args.strict and result.errors:
+        return 1
+    return 0

@@ -1,0 +1,248 @@
+"""Runtime sanitizers: the dynamic half of oryxlint.
+
+Static checks catch the patterns; these catch the behaviors — in unit
+tests and canary runs, on CPU, before a TPU fleet burns hours on them:
+
+  * `recompile_watchdog()` — counts jax compilation-cache misses per
+    traced function for the duration of a `with` block (via jax's own
+    compilation logging, no private APIs), exports them as
+    `oryx_recompiles_total{fn=...}` through the existing metrics
+    registry, and raises `RecompileStormError` when any one function
+    compiles more than `budget` times. A decode loop that recompiles
+    per step because someone passed a fresh tuple as a static arg
+    fails the test in seconds instead of showing up as a 10x TTFT
+    regression.
+  * `donation_guard()` — tracks the live jax arrays of one or more
+    pytrees across a donating call: `assert_consumed()` proves the
+    donation actually happened (an aliasing contract silently
+    degrading to copies is an HBM regression), and `check(tree)`
+    raises `UseAfterDonateError` naming the first deleted leaf — the
+    runtime twin of the `use-after-donate` static rule.
+
+jax imports are deferred into the functions so `oryx_tpu.analysis`
+stays importable (and the static linter runnable) without the
+accelerator stack.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Any, Iterator
+
+
+class RecompileStormError(RuntimeError):
+    """A traced function exceeded its compile budget inside a
+    `recompile_watchdog` block."""
+
+
+class UseAfterDonateError(RuntimeError):
+    """A donated (deleted) buffer was about to be read."""
+
+
+class RecompileStats:
+    """Per-traced-function compile counts observed by the watchdog."""
+
+    def __init__(self, budget: int):
+        self.budget = budget
+        self.counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def record(self, fn_name: str) -> int:
+        with self._lock:
+            self.counts[fn_name] = self.counts.get(fn_name, 0) + 1
+            return self.counts[fn_name]
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def over_budget(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                k: v for k, v in self.counts.items() if v > self.budget
+            }
+
+
+class _CompileLogHandler(logging.Handler):
+    """Captures jax's "Compiling <fn> ..." records (emitted on every
+    tracing-cache miss when `jax_log_compiles` is on)."""
+
+    def __init__(self, callback):
+        super().__init__(level=logging.DEBUG)
+        self._callback = callback
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.msg if isinstance(record.msg, str) else ""
+            if not msg.startswith("Compiling"):
+                return
+            fn = "<unknown>"
+            if record.args:
+                fn = str(
+                    record.args[0]
+                    if isinstance(record.args, tuple)
+                    else record.args
+                )
+            self._callback(fn)
+        except Exception:  # a broken sanitizer must never break the run
+            pass
+
+
+@contextlib.contextmanager
+def recompile_watchdog(
+    budget: int = 1,
+    *,
+    registry=None,
+    action: str = "raise",
+    logger_name: str = "jax",
+) -> Iterator[RecompileStats]:
+    """Count per-function jax compiles inside the block; over-budget
+    raises (action="raise") at exit or just records (action="record").
+
+    budget: max compiles allowed PER traced function name — distinct
+    shapes of one function share a name, which is exactly the point:
+    a shape-unstable loop is a recompile storm no matter how "valid"
+    each individual compile is. The first compile of a function is
+    expected (that's a cold start, not a recompile); every compile
+    beyond the first increments `oryx_recompiles_total{fn=...}` on
+    `registry` (a `utils.metrics.Registry`; pass
+    `serving_metrics.registry` from serving code).
+    """
+    if action not in ("raise", "record"):
+        raise ValueError(f"action must be 'raise' or 'record', got {action!r}")
+    import jax
+
+    stats = RecompileStats(budget)
+    counter = None
+    if registry is not None:
+        counter = registry.counter(
+            "oryx_recompiles_total", ("fn",), raw_name=True
+        )
+
+    def on_compile(fn_name: str) -> None:
+        n = stats.record(fn_name)
+        if n > 1 and counter is not None:
+            counter.labels(fn=fn_name).inc()
+
+    handler = _CompileLogHandler(on_compile)
+    jax_logger = logging.getLogger(logger_name)
+    prev_log_compiles = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    jax_logger.addHandler(handler)
+    try:
+        yield stats
+    finally:
+        jax_logger.removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev_log_compiles)
+    over = stats.over_budget()
+    if over and action == "raise":
+        worst = max(over, key=over.get)
+        raise RecompileStormError(
+            f"recompile storm: {worst!r} compiled {over[worst]} times "
+            f"(budget {stats.budget}) inside a recompile_watchdog block; "
+            f"all over budget: {over}. A fresh unhashable static operand "
+            "or an unbucketed shape is the usual cause."
+        )
+
+
+class DonationGuard:
+    """Tracks the jax-array leaves of pytrees across donating calls."""
+
+    def __init__(self, *trees: Any, label: str = ""):
+        import jax
+
+        self.label = label
+        self._leaves = [
+            leaf
+            for tree in trees
+            for leaf in jax.tree_util.tree_leaves(tree)
+            if isinstance(leaf, jax.Array)
+        ]
+
+    def _deleted(self) -> list[int]:
+        return [
+            i for i, a in enumerate(self._leaves) if a.is_deleted()
+        ]
+
+    @property
+    def consumed(self) -> bool:
+        """True when every tracked buffer was donated (deleted)."""
+        return bool(self._leaves) and len(self._deleted()) == len(
+            self._leaves
+        )
+
+    def assert_consumed(self) -> None:
+        """The donation contract held: every tracked buffer is gone.
+        Failing means the aliasing silently degraded to a copy — an
+        HBM-footprint regression on real hardware. Tracking zero
+        jax-array leaves also fails: a guard over an all-host tree
+        verifies nothing, which is its own refactor hazard."""
+        if not self._leaves:
+            raise AssertionError(
+                f"donation_guard{f' [{self.label}]' if self.label else ''}: "
+                "no jax-array leaves were tracked — the guarded tree has "
+                "no device buffers, so consumption cannot be verified"
+            )
+        dead = self._deleted()
+        if len(dead) != len(self._leaves):
+            live = len(self._leaves) - len(dead)
+            raise AssertionError(
+                f"donation_guard{f' [{self.label}]' if self.label else ''}: "
+                f"{live}/{len(self._leaves)} tracked buffers were NOT "
+                "consumed by the donating call (donation degraded to a "
+                "copy, or the call never donated)"
+            )
+
+    def check(self, tree: Any = None) -> None:
+        """Raise `UseAfterDonateError` if any leaf of `tree` (default:
+        the tracked trees) has been deleted — call this before a read
+        that must not touch donated storage."""
+        import jax
+
+        leaves = (
+            self._leaves
+            if tree is None
+            else [
+                leaf
+                for leaf in jax.tree_util.tree_leaves(tree)
+                if isinstance(leaf, jax.Array)
+            ]
+        )
+        for i, a in enumerate(leaves):
+            if a.is_deleted():
+                raise UseAfterDonateError(
+                    f"donation_guard"
+                    f"{f' [{self.label}]' if self.label else ''}: "
+                    f"leaf {i} ({a.aval}) was donated and deleted; "
+                    "reading it is use-after-donate"
+                )
+
+
+@contextlib.contextmanager
+def donation_guard(
+    *trees: Any, expect_consumed: bool = False, label: str = ""
+) -> Iterator[DonationGuard]:
+    """Context-manager sugar over `DonationGuard`. With
+    `expect_consumed=True` the exit asserts every tracked buffer was
+    donated (use in tests around a single donating call)."""
+    guard = DonationGuard(*trees, label=label)
+    yield guard
+    if expect_consumed:
+        guard.assert_consumed()
+
+
+def backend_donates() -> bool:
+    """Whether this backend actually consumes donated buffers (CPU on
+    some jax versions silently ignores donation) — tests gate
+    `assert_consumed` on this."""
+    import jax
+    import jax.numpy as jnp
+
+    probe = jax.jit(lambda x: x + 1, donate_argnums=0)
+    x = jnp.zeros((8,))
+    probe(x).block_until_ready()
+    # The read IS the probe: asking whether donation consumed it.
+    return x.is_deleted()  # oryxlint: disable=use-after-donate

@@ -359,6 +359,7 @@ def _stream_chunk(
     return carry, jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1)
 
 
+# hot-path
 def generate_stream(
     params,
     cfg: LLMConfig,
@@ -418,7 +419,9 @@ def generate_stream(
             stop_sequences, **common,
         )
         n = min(chunk, max_new_tokens - done)
-        toks, fin = np.asarray(toks)[:, :n], np.asarray(fin)[:, :n]
+        # The per-chunk harvest IS the yield surface (and the early-exit
+        # test below needs host booleans) — the one deliberate sync.
+        toks, fin = np.asarray(toks)[:, :n], np.asarray(fin)[:, :n]  # oryxlint: disable=host-sync
         yield (toks, carry[0]) if yield_cache else toks
         done += n
         if fin[:, -1].all():
@@ -701,6 +704,7 @@ def _grow_block_tables(
     return out
 
 
+# hot-path
 def generate_paged(
     params,
     cfg: LLMConfig,
@@ -748,7 +752,9 @@ def generate_paged(
         key = jax.random.key(0)
     padded_new = -(-max_new_tokens // chunk) * chunk
     lengths = jnp.asarray(lengths, jnp.int32)
-    host_len = [int(x) for x in np.asarray(lengths)]
+    # Page-geometry decisions (block-table growth) are host-side by
+    # design; one pre-loop copy of the row lengths, not a per-step sync.
+    host_len = [int(x) for x in np.asarray(lengths)]  # oryxlint: disable=host-sync
     row_tokens = [n + padded_new for n in host_len]
     if kv_capacity is None:
         from oryx_tpu.ops.packing import round_up_bucket
@@ -790,7 +796,8 @@ def generate_paged(
     key, sk = jax.random.split(key)
     row_keys = jax.random.split(sk, B)
     if prefill_chunk:
-        starts = set(int(x) for x in np.asarray(start_vec))
+        # One admission-time validation read, outside the decode loop.
+        starts = set(int(x) for x in np.asarray(start_vec))  # oryxlint: disable=host-sync
         if len(starts) != 1:
             raise ValueError(
                 f"prefill_chunk needs one shared start, got {sorted(starts)}"
@@ -823,8 +830,12 @@ def generate_paged(
             chunk=chunk, eos=eos, attn_impl=attn_impl,
             compute_dtype=compute_dtype,
         )
+        # The once-per-chunk harvest this loop exists to amortize (and
+        # the early-exit below needs host booleans).
+        # oryxlint: off=host-sync
         toks_out[:, done:done + chunk] = np.asarray(toks)
         fin_out[:, done:done + chunk] = np.asarray(fin)
+        # oryxlint: on=host-sync
         done += chunk
         if fin_out[:, done - 1].all():
             break

@@ -269,10 +269,14 @@ class ContinuousScheduler:
         )
         self.recent = np.full((S, stop_L), -2, np.int32)
         self.keys = jax.random.split(jax.random.key(seed), S)
+        # `slots`/`bt`/`lengths`/... are engine-thread-only; the ONLY
+        # state shared with the HTTP submit threads is the queue and
+        # the shutdown flag, and oryxlint enforces that every touch of
+        # them happens under the condition's lock.
         self.slots: list[_Request | None] = [None] * S
-        self._queue: deque[_Request] = deque()
+        self._queue: deque[_Request] = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._shutdown = False
+        self._shutdown = False  # guarded-by: _cond
         self._admit_seq = 0
         self.chunks_run = 0
         # Flight recorder of the last N requests (shared with the API
@@ -468,6 +472,13 @@ class ContinuousScheduler:
                         r.handle.done.set()
                         if r.trace is not None:
                             r.trace.finish(error=msg)
+                    # Every pop refreshes the gauge (same invariant as
+                    # the cancel path): after the drain /metrics must
+                    # say empty, and the drain-side observation lets a
+                    # queue_depth_slo episode re-arm.
+                    self.metrics.set_gauge("queue_depth", 0)
+                if self.anomaly is not None:
+                    self.anomaly.observe_queue_depth(0)
                 # The failed dispatch may have CONSUMED the donated page
                 # pool (donate_argnames=kv_pages): rebuild it so the
                 # engine keeps serving new traffic instead of erroring
@@ -493,6 +504,17 @@ class ContinuousScheduler:
             if req.handle.cancelled:
                 with self._cond:
                     self._queue.popleft()
+                    depth = len(self._queue)
+                    # Every pop must refresh the gauge: without this a
+                    # pre-admission cancel left queue_depth one high
+                    # until the next submit.
+                    self.metrics.set_gauge("queue_depth", depth)
+                if self.anomaly is not None:
+                    # Drain-side observation, same invariant as the
+                    # engine-failure drain: a backlog that empties via
+                    # client cancels must re-arm the queue_depth_slo
+                    # episode, or the next burst fires no event.
+                    self.anomaly.observe_queue_depth(depth)
                 req.trace.finish(cancelled=True)
                 _LOG.info("request %s cancelled in queue", req.trace.id)
                 continue
@@ -546,9 +568,14 @@ class ContinuousScheduler:
                 except Exception as e:
                     with self._cond:
                         self._queue.popleft()
-                        self.metrics.set_gauge(
-                            "queue_depth", len(self._queue)
-                        )
+                        depth = len(self._queue)
+                        self.metrics.set_gauge("queue_depth", depth)
+                    if self.anomaly is not None:
+                        # Same drain-side invariant as the cancel and
+                        # engine-failure pops: a backlog emptied by
+                        # rejections must re-arm the queue_depth_slo
+                        # episode.
+                        self.anomaly.observe_queue_depth(depth)
                     msg = f"{type(e).__name__}: {e}"
                     req.handle.error = msg
                     if isinstance(e, ValueError):
@@ -870,6 +897,7 @@ class ContinuousScheduler:
         self.metrics.inc("evicted")
         self._occupancy_gauge()
 
+    # hot-path
     def _step_chunk(self) -> None:
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
@@ -894,12 +922,17 @@ class ContinuousScheduler:
         # Host copies BLOCK on the device result — measure dt after
         # them, or async dispatch makes the window (and the per-token
         # histogram) cover only dispatch time, and the span<->xplane
-        # join would land the decode ops outside every window.
+        # join would land the decode ops outside every window. This is
+        # the engine's ONE deliberate sync point per chunk (the harvest
+        # the chunk exists to amortize) — anything else host-syncing in
+        # this function is a regression the host-sync rule catches.
+        # oryxlint: off=host-sync
         self.tok = np.asarray(tok).copy()
         self.lengths = np.asarray(lengths).copy()
         self.finished = np.asarray(finished).copy()
         self.recent = np.asarray(recent).copy()
         toks, fin = np.asarray(toks), np.asarray(fin)
+        # oryxlint: on=host-sync
         dt = time.monotonic() - t0
         self.chunks_run += 1
         self.metrics.inc("chunks")
@@ -940,6 +973,7 @@ class ContinuousScheduler:
 
     # ---- harvest / text emission ----------------------------------------
 
+    # hot-path
     def _advance(self, s: int, tokens: list[int]) -> int:
         """Feed slot s's newly decoded tokens through the host-side text
         machine; returns the number of USEFUL steps consumed (replayed

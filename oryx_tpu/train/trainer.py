@@ -240,6 +240,7 @@ class Trainer:
 
         return {k: put(k, v) for k, v in batch.items()}
 
+    # hot-path
     def fit(
         self,
         batches: Iterator[dict[str, np.ndarray]],
@@ -289,9 +290,11 @@ class Trainer:
                         )
                     # Async dispatch returns immediately; the sync span
                     # is where the device actually runs the step (plus
-                    # the compile on step 1).
+                    # the compile on step 1). The step loop's ONE
+                    # deliberate sync: everything downstream (logging,
+                    # anomaly detection) needs host scalars.
                     with tr.span("device_sync") as sp_sync:
-                        host_metrics = jax.device_get(metrics)
+                        host_metrics = jax.device_get(metrics)  # oryxlint: disable=host-sync
                     if self.watchdog is not None:
                         self.watchdog.beat()
                     # Phase seconds ride the metric record too, so the
@@ -351,7 +354,8 @@ class Trainer:
             # set the more specific "halted: <kind>" reason; keep it).
             if self.telemetry is not None and self.telemetry._ready:
                 self.telemetry.mark_ready(False, "step loop exited")
-        final_step = int(jax.device_get(self.state.step))
+        # Post-loop, pre-checkpoint: one sync after the last step.
+        final_step = int(jax.device_get(self.state.step))  # oryxlint: disable=host-sync
         if final_step > 0 and self.ckpt.latest_step() != final_step:
             self.ckpt.save(final_step, self.state, force=True)
         self.ckpt.wait()

@@ -106,8 +106,11 @@ class Trace:
         self.end_ns: int | None = None
         self.meta: dict[str, Any] = {}
         self.done = False
-        self.spans: list[Span] = []
-        self._stack: list[int] = []  # open-span indices (owner thread)
+        # Writers (owner thread) and readers (debug endpoints, the
+        # watchdog) both touch the span list; oryxlint holds every
+        # access to the lock.
+        self.spans: list[Span] = []  # guarded-by: _lock
+        self._stack: list[int] = []  # open-span indices # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ---- recording -------------------------------------------------------
@@ -135,8 +138,13 @@ class Trace:
     @contextlib.contextmanager
     def span(self, name: str, **args) -> Iterator[Span]:
         h = self.begin(name, **args)
+        # Resolve the handle under the lock (surfaced by the oryxlint
+        # lock-discipline self-application: an index into the mutable
+        # span list must not be chased while another thread appends).
+        with self._lock:
+            sp = self.spans[h]
         try:
-            yield self.spans[h]
+            yield sp
         finally:
             self.end(h)
 
@@ -233,8 +241,8 @@ class Tracer:
         # records nothing has no disable semantics worth supporting).
         self.capacity = max(1, capacity)
         self._lock = threading.Lock()
-        self._traces: deque[Trace] = deque(maxlen=self.capacity)
-        self._by_id: dict[str, Trace] = {}
+        self._traces: deque[Trace] = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._by_id: dict[str, Trace] = {}  # guarded-by: _lock
 
     def start_trace(self, kind: str, label: str = "",
                     id: str | None = None) -> Trace:
@@ -389,9 +397,9 @@ class StallWatchdog:
         self.tail = tail
         self.out = out  # None => sys.stderr resolved at dump time
         self.dumps = 0
-        self._last_beat = time.perf_counter()
-        self._active = False
-        self._armed = True
+        self._last_beat = time.perf_counter()  # guarded-by: _lock
+        self._active = False  # guarded-by: _lock
+        self._armed = True  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(

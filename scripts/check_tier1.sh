@@ -10,12 +10,25 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 350 = the 330 recorded at PR 3 plus the prefix-cache suite added in
-# PR 4 (allocator refcount/COW guards, radix index, cached-vs-cold
-# parity, chunked prefill; 365 observed with a warm /tmp/jax_cache),
-# with headroom for load-dependent flakes (bench-supervisor probes on
-# one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-350}
+# 385 = the 350 recorded at PR 4 plus the oryxlint/sanitizer suites
+# added in PR 5 (fixture-exact checker tests, CLI contract, repo
+# self-lint, recompile watchdog + donation guard, two regression
+# tests; 404 observed with a warm /tmp/jax_cache), with headroom for
+# load-dependent flakes (bench-supervisor probes on one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-385}
+
+# --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
+# Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
+# HEAD (+ untracked) for the quick local loop.
+lint_args=(--strict)
+if [ "${ORYX_LINT_CHANGED:-0}" != "0" ]; then
+    lint_args+=(--changed-only)
+fi
+echo "running oryxlint (${lint_args[*]})"
+if ! timeout -k 10 120 python scripts/run_oryxlint.py "${lint_args[@]}"; then
+    echo "ORYXLINT FAILED (static analysis findings above)" >&2
+    exit 1
+fi
 
 # --- ROADMAP.md "Tier-1 verify", verbatim -----------------------------------
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"

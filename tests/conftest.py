@@ -263,3 +263,37 @@ def pytest_collection_modifyitems(config, items):
     mark = pytest.mark.xfail(reason=_QUIRK_REASON, strict=False)
     for it in quirky:
         it.add_marker(mark)
+
+
+# ---------------------------------------------------------------------------
+# Opt-in recompile watchdog for the paged-decode parity tests: set
+# ORYX_RECOMPILE_WATCHDOG=<budget> (a bare "1" means budget 16) and the
+# shape-bucketing contract of the paged decode path is enforced while
+# those tests run — a parity refactor that starts recompiling per chunk
+# fails loudly here instead of surfacing as a TPU TTFT regression.
+# Off by default: the parity suite deliberately sweeps many geometries,
+# and an unconditionally armed watchdog would gate on compile counts
+# that legitimately vary with test parametrization.
+# ---------------------------------------------------------------------------
+
+import pytest  # noqa: E402  (after the platform-pinning prologue)
+
+_WATCHDOG_FILES = ("test_paged_decode.py", "test_prefix_cache.py")
+
+
+@pytest.fixture(autouse=True)
+def _opt_in_recompile_watchdog(request):
+    spec = os.environ.get("ORYX_RECOMPILE_WATCHDOG", "").strip().lower()
+    # "0"/"off"/"false" disable, matching ORYX_LINT_CHANGED's
+    # 0-means-off convention; any other value arms it ("1"/non-numeric
+    # = the default budget, an integer > 1 = that budget).
+    if spec in ("", "0", "off", "false") or os.path.basename(
+        str(request.fspath)
+    ) not in _WATCHDOG_FILES:
+        yield
+        return
+    from oryx_tpu.analysis.sanitizers import recompile_watchdog
+
+    budget = int(spec) if spec.isdigit() and int(spec) > 1 else 16
+    with recompile_watchdog(budget=budget, action="raise"):
+        yield

@@ -1,0 +1,34 @@
+"""use-after-donate clean fixture: the rebind-in-the-same-statement
+idiom, in straight line, loops, and through attribute chains."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("kv",))
+def decode(params, kv, tok):
+    return kv, tok + 1
+
+
+def straight_line(params, kv, tok):
+    kv, tok = decode(params, kv, tok)
+    kv, tok = decode(params, kv, tok)
+    return kv, tok
+
+
+def loop(params, kv):
+    tok = 0
+    for _ in range(4):
+        kv, tok = decode(params, kv, tok)
+    return kv, tok
+
+
+class Engine:
+    def __init__(self, kv):
+        self.kv = kv
+
+    def step(self, params):
+        kv2, tok = decode(params, self.kv, 0)
+        self.kv = kv2
+        return self.kv, tok

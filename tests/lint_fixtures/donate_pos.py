@@ -1,0 +1,45 @@
+"""use-after-donate positive fixture: reads of consumed buffers.
+
+Never imported; jax is referenced for realism only (the checker is
+pure-AST)."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("kv",))
+def decode(params, kv, tok):
+    return kv, tok + 1
+
+
+eat_state = jax.jit(decode, donate_argnums=1)
+
+
+def straight_line(params, kv, tok):
+    kv2, tok = decode(params, kv, tok)
+    return decode(params, kv, tok)  # expect: use-after-donate
+
+
+def loop_wraparound(params, kv):
+    out = None
+    for i in range(4):
+        out = decode(params, kv, i)  # expect: use-after-donate
+    return out
+
+
+def branch_merge(params, kv, flag):
+    if flag:
+        kv2, _ = decode(params, kv, 0)
+    return kv.shape  # expect: use-after-donate
+
+
+class Engine:
+    def __init__(self, kv):
+        self.kv = kv
+
+    def step_stale(self, params):
+        kv2, tok = decode(params, self.kv, 0)
+        stale = self.kv["k"]  # expect: use-after-donate
+        self.kv = kv2
+        return stale, tok

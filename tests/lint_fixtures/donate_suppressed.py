@@ -1,0 +1,17 @@
+"""use-after-donate suppressed fixture: deliberate reads (e.g. probing
+deletion in a test helper) carry suppressions — zero findings."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("kv",))
+def decode(params, kv, tok):
+    return kv, tok + 1
+
+
+def probe_donation(params, kv):
+    kv2, _ = decode(params, kv, 0)
+    # This read is the POINT: asserting the buffer was consumed.
+    return kv.is_deleted()  # oryxlint: disable=use-after-donate

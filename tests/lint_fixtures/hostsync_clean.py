@@ -1,0 +1,16 @@
+"""host-sync clean fixture: hot path stays on device; host work uses
+data that already crossed."""
+
+import jax.numpy as jnp
+
+
+# hot-path
+def decode_loop(carry, steps):
+    for _ in range(steps):
+        carry = carry * 2 + jnp.sum(carry)
+    return carry
+
+
+def harvest(host_tokens):
+    # Plain host-side work on host data: nothing to flag.
+    return [int(t) for t in host_tokens]

@@ -1,0 +1,23 @@
+"""host-sync positive fixture: implicit device→host syncs inside
+functions marked `# hot-path`."""
+
+import numpy as np
+
+import jax
+
+
+# hot-path
+def decode_loop(arrays, lengths):
+    total = 0.0
+    for a in arrays:
+        total += float(a)  # expect: host-sync
+        host = np.asarray(a)  # expect: host-sync
+        scalar = a.sum().item()  # expect: host-sync
+        pulled = jax.device_get(a)  # expect: host-sync
+        total += host.size + scalar + pulled.size
+    return total, float("nan")  # literal cast: not a sync
+
+
+def cold_path(a):
+    # Not marked hot: the same calls are fine here.
+    return float(a) + np.asarray(a).size

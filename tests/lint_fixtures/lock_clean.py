@@ -1,0 +1,27 @@
+"""lock-discipline clean fixture: every guarded access is under its
+lock (including multi-item withs and nested statements)."""
+
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+
+    def put(self, x):
+        with self._lock:
+            if not self._closed:
+                self._items.append(x)
+
+    def drain(self):
+        with self._aux, self._lock:
+            out = list(self._items)
+            self._items.clear()
+        return out
+
+    def close(self):
+        with self._lock:
+            self._closed = True

@@ -1,0 +1,34 @@
+"""lock-discipline positive fixture: guarded fields touched bare.
+
+`# expect: <rule>` comments mark the exact lines tests assert findings
+on. This file is excluded from the repo self-lint (lint_fixtures/) and
+is never imported.
+"""
+
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self.stats = {}  # unguarded on purpose: not annotated
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size_racy(self):
+        return len(self._items)  # expect: lock-discipline
+
+    def close_racy(self):
+        self._closed = True  # expect: lock-discipline
+
+    def drain(self):
+        out = []
+        with self._lock:
+            while self._items:
+                out.append(self._items.pop())
+        self.stats["drained"] = len(out)  # not annotated: no finding
+        return out

@@ -1,0 +1,24 @@
+"""lock-discipline suppressed fixture: same shapes as lock_pos.py,
+every escape carries a justification + suppression — zero findings."""
+
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size_estimate(self):
+        # Monitoring-only read; len() on a list is atomic under the
+        # GIL and an off-by-one snapshot is fine for a gauge.
+        return len(self._items)  # oryxlint: disable=lock-discipline
+
+    def close_from_signal_handler(self):
+        # Signal handlers must not take locks; a torn bool is benign.
+        self._closed = True  # oryxlint: disable=lock-discipline

@@ -1,0 +1,13 @@
+"""metric-name clean fixture: literal snake_case names, one kind per
+family, prefixed raw names."""
+
+
+def declare(reg, metrics):
+    reg.counter("requests_total")
+    reg.counter("anomaly_total", ("kind",))
+    reg.counter("oryx_recompiles_total", ("fn",), raw_name=True)
+    reg.gauge("queue_depth_fixture")
+    reg.histogram("ttft_seconds_fixture", (0.1, 1.0))
+    metrics.inc("requests_total")
+    metrics.set_gauge("queue_depth_fixture", 3)
+    metrics.observe("ttft_seconds_fixture", 0.2)

@@ -1,0 +1,16 @@
+"""metric-name positive fixture: naming violations, kind split-brain,
+computed declarations."""
+
+
+def declare(reg, metrics):
+    reg.counter("oryx_lint_fixture_total", raw_name=True)  # ok
+    reg.counter("BadCamelName")  # expect: metric-name
+    reg.counter("not_prefixed_raw", raw_name=True)  # expect: metric-name
+    reg.gauge("depth_split_brain")  # expect: metric-name
+    metrics.inc("depth_split_brain")  # expect: metric-name
+    reg.histogram("latency_seconds", (0.1, 1.0))  # ok
+
+
+def declare_computed(reg, names):
+    for n in names:
+        reg.gauge(n)  # expect: metric-name

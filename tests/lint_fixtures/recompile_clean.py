@@ -1,0 +1,21 @@
+"""recompile-hazard clean fixture: device-side branching and hashable
+static operands."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def step(x, gate, *, mode):
+    x = jnp.where(gate, x + 1, x)
+    if mode == "fast":
+        x = x * 2
+    return x
+
+
+def caller(x, bucketed_mode):
+    a = step(x, True, mode="fast")
+    b = step(x, False, mode=bucketed_mode)
+    return a, b

@@ -1,0 +1,34 @@
+"""recompile-hazard positive fixture: tracer branches and unhashable
+static operands."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def step(x, gate, *, mode):
+    if gate:  # expect: recompile-hazard
+        x = x + 1
+    while gate > 0:  # expect: recompile-hazard
+        x = x - 1
+    if mode == "fast":  # static arg: fine
+        x = x * 2
+    if gate is None:  # identity test: fine
+        x = x * 3
+    if x.shape[0] > 2:  # shape is static under trace: fine
+        x = x[:2]
+    return x
+
+
+@jax.jit
+def bare(x, flag):
+    return x if flag else -x  # expect: recompile-hazard
+
+
+def caller(x):
+    a = step(x, False, mode={"lr": 0.1})  # expect: recompile-hazard
+    b = step(x, False, mode=f"bucket_{x.shape[0]}")  # expect: recompile-hazard
+    c = step(x, False, mode="fast")  # hashable constant: fine
+    return a, b, c

@@ -1,0 +1,20 @@
+"""recompile-hazard suppressed fixture: a deliberate trace-time branch
+(config exploration in a one-shot compile) with justification."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def step(x, gate, *, mode):
+    # `gate` is always a Python bool at trace time in this codepath
+    # (weak-typed scalar), and the two programs are intentional.
+    if gate:  # oryxlint: disable=recompile-hazard
+        x = x + 1
+    return x
+
+
+def caller(x):
+    # One-shot setup call; the fresh dict compiles exactly once.
+    return step(x, False, mode={"lr": 0.1})  # oryxlint: disable=recompile-hazard

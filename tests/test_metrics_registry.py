@@ -117,14 +117,16 @@ def test_histogram_with_labels_renders_per_child():
 
 
 def test_duplicate_family_rejected():
+    # The kind clash below is the POINT of the test (the runtime twin
+    # of oryxlint's metric-name rule) — hence the suppressions.
     r = Registry()
-    r.counter("x")
+    r.counter("x")  # oryxlint: disable=metric-name
     with pytest.raises(ValueError, match="re-declared"):
-        r.gauge("x")
+        r.gauge("x")  # oryxlint: disable=metric-name
     with pytest.raises(ValueError, match="re-declared"):
-        r.counter("x", ("kind",))
+        r.counter("x", ("kind",))  # oryxlint: disable=metric-name
     # Identical re-declaration returns the same family.
-    assert r.counter("x") is r.counter("x")
+    assert r.counter("x") is r.counter("x")  # oryxlint: disable=metric-name
 
 
 def test_concurrent_increments_exact():
@@ -161,11 +163,12 @@ def test_info_metric_replaces():
         's_build_info{engine="continuous",revision="def"}': 1.0
     }
     # info() may replace only INFO families — clobbering a live
-    # counter would violate the no-duplicate-family invariant.
-    r.counter("reqs").inc()
+    # counter would violate the no-duplicate-family invariant. (The
+    # deliberate kind clash is what's under test here.)
+    r.counter("live_counter").inc()  # oryxlint: disable=metric-name
     with pytest.raises(ValueError, match="already registered"):
-        r.info("reqs", {"k": "v"})
-    assert r.get("reqs") == 1
+        r.info("live_counter", {"k": "v"})  # oryxlint: disable=metric-name
+    assert r.get("live_counter") == 1
 
 
 def test_get_on_histogram_and_labeled_is_zero():

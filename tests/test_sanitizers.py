@@ -1,0 +1,113 @@
+"""Runtime sanitizers: recompile_watchdog catches an induced recompile
+loop (and exports oryx_recompiles_total); donation_guard proves
+donation and trips on use-after-donate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oryx_tpu.analysis.sanitizers import (
+    RecompileStormError,
+    UseAfterDonateError,
+    backend_donates,
+    donation_guard,
+    recompile_watchdog,
+)
+from oryx_tpu.utils.metrics import Registry
+
+
+def test_watchdog_catches_induced_recompile_loop():
+    """The acceptance scenario: a shape-unstable loop recompiles one
+    function per iteration; the watchdog raises and the recompile
+    counter lands in the registry as oryx_recompiles_total{fn=...}."""
+    reg = Registry(prefix="oryx_serving")
+
+    def storm_fn(x):
+        return x * 2 + 1
+
+    f = jax.jit(storm_fn)
+    with pytest.raises(RecompileStormError, match="recompile storm"):
+        with recompile_watchdog(budget=2, registry=reg) as stats:
+            for n in range(1, 6):  # 5 distinct shapes = 5 compiles
+                f(jnp.zeros((n,))).block_until_ready()
+    assert stats.counts["storm_fn"] == 5
+    assert stats.over_budget()["storm_fn"] == 5
+    # Compiles beyond the first are recompiles: 4 increments.
+    fam = reg.existing("oryx_recompiles_total", raw_name=True)
+    assert fam is not None
+    assert fam.labels(fn="storm_fn").value == 4.0
+    rendered = reg.render()
+    assert 'oryx_recompiles_total{fn="storm_fn"} 4' in rendered
+
+
+def test_watchdog_quiet_within_budget():
+    def steady_fn(x):
+        return x + 1
+
+    f = jax.jit(steady_fn)
+    with recompile_watchdog(budget=1) as stats:
+        for _ in range(4):  # one shape: one compile, three cache hits
+            f(jnp.zeros((3,))).block_until_ready()
+    assert stats.counts.get("steady_fn", 0) <= 1
+    assert not stats.over_budget().get("steady_fn")
+
+
+def test_watchdog_record_mode_does_not_raise():
+    def quiet_storm_fn(x):
+        return x - 1
+
+    f = jax.jit(quiet_storm_fn)
+    with recompile_watchdog(budget=1, action="record") as stats:
+        for n in range(7, 10):
+            f(jnp.zeros((n,))).block_until_ready()
+    assert stats.counts["quiet_storm_fn"] == 3
+    assert stats.over_budget()["quiet_storm_fn"] == 3
+
+
+def test_watchdog_restores_jax_logging_config():
+    before = jax.config.jax_log_compiles
+    with recompile_watchdog(budget=100):
+        assert jax.config.jax_log_compiles is True
+    assert jax.config.jax_log_compiles == before
+
+
+def test_watchdog_rejects_bad_action():
+    with pytest.raises(ValueError, match="action"):
+        with recompile_watchdog(action="explode"):
+            pass
+
+
+def test_donation_guard_proves_consumption_and_trips_on_read():
+    if not backend_donates():
+        pytest.skip("backend ignores donation; nothing to guard")
+    eat = jax.jit(
+        lambda kv: {"k": kv["k"] + 1, "v": kv["v"] * 2},
+        donate_argnums=0,
+    )
+    kv = {"k": jnp.ones((8,)), "v": jnp.zeros((8,))}
+    with donation_guard(kv, expect_consumed=True, label="kv") as guard:
+        out = eat(kv)
+        jax.block_until_ready(out)
+    assert guard.consumed
+    with pytest.raises(UseAfterDonateError, match="use-after-donate"):
+        guard.check()
+    guard.check(out)  # the fresh tree is fine
+
+
+def test_donation_guard_flags_unconsumed():
+    keep = jax.jit(lambda kv: {"k": kv["k"] + 1})  # no donation
+    kv = {"k": jnp.ones((4,))}
+    with pytest.raises(AssertionError, match="NOT"):
+        with donation_guard(kv, expect_consumed=True):
+            jax.block_until_ready(keep(kv))
+
+
+def test_donation_guard_empty_tracking_is_not_vacuous():
+    """Regression: a tree whose leaves are host arrays (a refactor
+    hazard) tracked zero device buffers and assert_consumed passed
+    while verifying nothing."""
+    host_tree = {"k": np.ones((4,))}
+    with pytest.raises(AssertionError, match="no jax-array leaves"):
+        with donation_guard(host_tree, expect_consumed=True):
+            pass

@@ -276,3 +276,93 @@ def test_forced_stall_triggers_exactly_one_watchdog_dump(pipe):
     assert "STALL WATCHDOG" in text
     assert h.request_id in text  # recorder tail names the stuck request
     assert "slow_chunk" in text  # the stack shows where it hung
+
+
+def test_cancel_in_queue_refreshes_queue_depth_gauge(pipe):
+    """Regression: a request cancelled BEFORE admission popped the
+    queue without refreshing the queue_depth gauge, pinning it one
+    high until the next submit (found during the oryxlint
+    self-application pass over the scheduler's guarded state)."""
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=1, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    h = sched.submit({"question": "never mind"}, 4)
+    assert metrics.get("queue_depth") == 1
+    h.cancelled = True
+    sched._admit()  # engine loop body; thread never started
+    assert metrics.get("queue_depth") == 0
+    assert h.reply is None and not h.done.is_set()
+    sched.close()
+
+
+def test_cancel_drain_rearms_queue_depth_slo(pipe):
+    """Regression: a backlog that empties via client cancels never fed
+    the anomaly monitor, so the queue_depth_slo episode stayed disarmed
+    and the NEXT backlog burst fired no event — the drain side must
+    observe the depth, same as the engine-failure path."""
+    from oryx_tpu.utils.anomaly import AnomalyMonitor, AnomalyThresholds
+
+    monitor = AnomalyMonitor(
+        source="serve", thresholds=AnomalyThresholds(queue_depth_slo=1)
+    )
+    sched = ContinuousScheduler(
+        pipe, num_slots=1, page_size=16, chunk=4, max_ctx=512,
+        metrics=ServingMetrics(), autostart=False, anomaly=monitor,
+    )
+    h1 = sched.submit({"question": "a"}, 4)
+    h2 = sched.submit({"question": "b"}, 4)  # depth 2 > 1: fires
+    assert monitor.counts.get("queue_depth_slo") == 1
+    h1.cancelled = True
+    h2.cancelled = True
+    sched._admit()  # engine loop body; thread never started
+    # The cancel drain observed depth 0 <= slo/2: episode re-armed,
+    # so a second burst fires a second event.
+    hc = sched.submit({"question": "c"}, 4)
+    hd = sched.submit({"question": "d"}, 4)
+    assert monitor.counts.get("queue_depth_slo") == 2
+    hc.cancelled = True
+    hd.cancelled = True
+    sched._admit()  # drain + re-arm again
+    # Same invariant on the admission-rejection pop: a burst of invalid
+    # requests (prompt + max_tokens > max_ctx) fires the third event at
+    # submit, drains through the except path, and must re-arm for the
+    # fourth burst.
+    h3 = sched.submit({"question": "e"}, 4096)
+    h4 = sched.submit({"question": "f"}, 4096)
+    assert monitor.counts.get("queue_depth_slo") == 3
+    sched._admit()
+    for h in (h3, h4):
+        assert h.error_kind == "invalid_request"
+    sched.submit({"question": "g"}, 4)
+    sched.submit({"question": "h"}, 4)
+    assert monitor.counts.get("queue_depth_slo") == 4
+    sched.close()
+
+
+def test_engine_error_drains_queue_and_resets_gauge(pipe, monkeypatch):
+    """Regression: the engine-failure handler drained the queue without
+    refreshing the queue_depth gauge — /metrics kept reporting the dead
+    backlog until the next submit. Same every-pop-refreshes-the-gauge
+    invariant as the pre-admission cancel path."""
+    from oryx_tpu.serve import scheduler as sched_mod
+
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=1, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+
+    def boom(*a, **k):
+        raise RuntimeError("induced device failure")
+
+    monkeypatch.setattr(sched_mod.generate_lib, "paged_prefill", boom)
+    h1 = sched.submit({"question": "first"}, 4)
+    h2 = sched.submit({"question": "queued behind"}, 4)
+    sched.start()
+    for h in (h1, h2):
+        with pytest.raises(RuntimeError, match="induced device failure"):
+            h.result(timeout=120)
+    assert metrics.get("queue_depth") == 0
+    sched.close()

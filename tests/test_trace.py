@@ -168,3 +168,32 @@ def test_now_ns_monotone_and_anchored():
     assert b >= a
     # Anchored to the wall clock (needed for the xplane join).
     assert abs(a - time.time_ns()) < 60 * 1_000_000_000
+
+
+def test_span_handle_resolves_under_concurrent_appends():
+    """Regression (oryxlint lock-discipline self-application): span()
+    used to chase its handle into the span list OUTSIDE the lock while
+    other threads append — it must yield the right span, and keep
+    doing so with writers running."""
+    tr = trace_lib.Trace("req")
+    stop = threading.Event()
+
+    def appender():
+        while not stop.is_set():
+            tr.add_complete("noise", trace_lib.now_ns(), 10)
+
+    workers = [threading.Thread(target=appender) for _ in range(2)]
+    for w in workers:
+        w.start()
+    try:
+        for i in range(200):
+            with tr.span("work", i=i) as sp:
+                assert sp.name == "work"
+                assert sp.args == {"i": i}
+    finally:
+        stop.set()
+        for w in workers:
+            w.join()
+    tr.finish()
+    names = {s.name for s in tr.spans}
+    assert names == {"noise", "work"}
