@@ -680,7 +680,8 @@ def main() -> None:
             _phase("latency: 64-frame video-QA")
             params = oryx.init_params(cfg, jax.random.key(0))
             lat64 = bench_video_latency(params, cfg, 64)
-        except Exception as e:  # keep the primary metric even if this fails
+        # fault-boundary: keep the primary metric even if this fails
+        except Exception as e:
             print(f"# latency bench failed: {e!r}")
         # 256-frame north-star case (BASELINE config 3): real chips only
         # by default (256 frames through the tiny CPU config is all

@@ -25,6 +25,7 @@ from oryx_tpu.analysis.hostsync import HostSyncChecker
 from oryx_tpu.analysis.locks import LockDisciplineChecker
 from oryx_tpu.analysis.metric_names import MetricNameChecker
 from oryx_tpu.analysis.recompile import RecompileHazardChecker
+from oryx_tpu.analysis.swallow import SwallowedExceptionChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     LockDisciplineChecker,
@@ -32,6 +33,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     HostSyncChecker,
     RecompileHazardChecker,
     MetricNameChecker,
+    SwallowedExceptionChecker,
 )
 
 # Directories that are not our python (vendored assets, fixtures that

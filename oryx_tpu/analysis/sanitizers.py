@@ -87,7 +87,8 @@ class _CompileLogHandler(logging.Handler):
                     else record.args
                 )
             self._callback(fn)
-        except Exception:  # a broken sanitizer must never break the run
+        # fault-boundary: a broken sanitizer must never break the run
+        except Exception:
             pass
 
 

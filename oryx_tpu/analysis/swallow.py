@@ -1,0 +1,129 @@
+"""swallowed-exception: no silent broad catches outside fault
+boundaries.
+
+A `except Exception: pass` (or bare `except:`, or a handler that only
+logs and drops) turns every future bug at that site into silence — the
+engine keeps "serving" with a consumed pool, the trainer keeps
+"training" with frozen params. This PR family's whole posture is that
+failures are CONTAINED, not swallowed: containment sites are few,
+deliberate, and documented.
+
+Flagged: an `except` clause whose type is broad (bare, `Exception`,
+or `BaseException`) and whose body does nothing but drop — every
+statement is `pass`, `...`, `continue`, an `import`, or a logging-ish
+expression call (`logging`/`log`/`_LOG`/`logger` methods, `print`,
+`rank0_print`, `traceback.print_exc`, `warnings.warn`). Handlers that
+bind state, return a fallback, re-raise, or call real code are
+handling, not swallowing, and are not flagged.
+
+The escape hatch is an explicit annotation — a `# fault-boundary:
+<why>` comment on the `except` line or the line directly above it —
+which is exactly the review conversation the rule forces: every
+swallow must say what failure it bounds and why dropping is correct
+(a broken metrics collector must never break the scrape; a crashed
+restart attempt must not kill the supervisor). Ordinary per-line
+`# oryxlint: disable=swallowed-exception` suppressions work too, but
+the annotation is the idiom.
+
+Narrow catches (`except OSError: pass`) are NOT flagged: naming the
+exception type is itself the statement of what is expected to fail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+)
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_CALL_BASES = {"logging", "log", "logger", "_LOG", "LOG", "traceback",
+                   "warnings"}
+_LOG_CALL_NAMES = {"print", "rank0_print", "print_exc"}
+
+
+def _is_logging_call(call: ast.Call) -> bool:
+    d = dotted_name(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    if parts[0] in _LOG_CALL_BASES:
+        return True
+    return parts[-1] in _LOG_CALL_NAMES
+
+
+def _drops_silently(handler: ast.ExceptHandler) -> bool:
+    """True when every statement in the handler body is a no-op or a
+    log line — nothing is handled, returned, raised, or recorded."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Import,
+                             ast.ImportFrom)):
+            continue
+        if isinstance(stmt, ast.Expr):
+            v = stmt.value
+            if isinstance(v, ast.Constant):  # bare `...` / docstring
+                continue
+            if isinstance(v, ast.Call) and _is_logging_call(v):
+                continue
+        return False
+    return True
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return isinstance(t, ast.Name) and t.id in _BROAD
+
+
+def is_fault_boundary(mod: ParsedModule, handler: ast.ExceptHandler) -> bool:
+    """`# fault-boundary` on the except line or in the contiguous
+    comment block directly above it (tokenized comments only — a
+    docstring quoting the marker can never annotate a handler)."""
+    if "fault-boundary" in mod.comment_text(handler.lineno):
+        return True
+    line = handler.lineno - 1
+    # Comment-ONLY lines: a trailing comment on a code line above must
+    # not extend the annotation's reach.
+    while line >= 1 and mod.line_text(line).strip().startswith("#"):
+        if "fault-boundary" in mod.comment_text(line):
+            return True
+        line -= 1
+    return False
+
+
+class SwallowedExceptionChecker(Checker):
+    name = "swallowed-exception"
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding | None]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or not _drops_silently(node):
+                continue
+            if is_fault_boundary(mod, node):
+                continue
+            kind = (
+                "bare except" if node.type is None
+                else "broad except"
+            )
+            yield self.finding(
+                mod,
+                node,
+                f"{kind} swallows the exception (body only "
+                "passes/logs); handle it, narrow the type, or annotate "
+                "the line with `# fault-boundary: <why>` if dropping "
+                "is the containment",
+            )

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from oryx_tpu.ops.attention import attention
+from oryx_tpu.utils import faults
 
 
 class OutOfPagesError(RuntimeError):
@@ -95,6 +96,17 @@ class PageAllocator:
         return self._refs[page]
 
     def alloc(self, n: int) -> list[int]:
+        if n > 0:
+            # Chaos site: simulated pool exhaustion. Every caller must
+            # treat OutOfPagesError as a scheduling signal (defer /
+            # evict / COW-fallback), never a crash — the chaos suite
+            # proves refcounts stay exact through it.
+            faults.fault_point(
+                "page_alloc_oom",
+                exc=lambda: OutOfPagesError(
+                    f"injected pool exhaustion (asked {n} pages)"
+                ),
+            )
         if n > len(self._free):
             raise OutOfPagesError(
                 f"need {n} pages, {len(self._free)} free of {self.num_pages}"

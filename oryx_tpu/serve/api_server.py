@@ -61,6 +61,7 @@ from typing import Any
 
 import numpy as np
 
+from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 
 
@@ -177,6 +178,81 @@ def parse_messages(
     if any(a is None for _, a in history):
         raise ValueError("history user turns must alternate with assistant")
     return question, history, images
+
+
+class EngineSupervisor(threading.Thread):
+    """Watches the continuous scheduler's engine thread and restarts
+    it after a crash: `scheduler.restart()` requeues every in-flight
+    request for deterministic replay, rebuilds the page pool (invariant
+    checked), and /readyz flips 503 -> 200 around the window. Bounded:
+    more than `max_restarts` deaths inside `window_s` means the failure
+    is systemic — the supervisor gives up and leaves /readyz at 503 so
+    a load balancer ejects the replica instead of feeding a crash
+    loop."""
+
+    def __init__(self, scheduler, *, poll_s: float = 0.25,
+                 max_restarts: int = 5, window_s: float = 60.0):
+        super().__init__(daemon=True, name="engine-supervisor")
+        self.scheduler = scheduler
+        # The scheduler queues through an engine-death window only
+        # while someone is committed to reviving it; submit() rejects
+        # on a dead engine otherwise.
+        scheduler.supervised = True
+        self.poll_s = poll_s
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self.gave_up = False
+        self._stop = threading.Event()
+        self._restart_times: list[float] = []
+
+    def stop(self) -> None:
+        self.scheduler.supervised = False
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            s = self.scheduler
+            if s.stopping:
+                return  # deliberate shutdown/drain: nothing to revive
+            if s.alive() or self.gave_up:
+                continue
+            now = time.monotonic()
+            self._restart_times = [
+                t for t in self._restart_times
+                if now - t < self.window_s
+            ]
+            if len(self._restart_times) >= self.max_restarts:
+                # Systemic failure: stop reviving, stop accepting
+                # (submit rejects once `supervised` clears), and fail
+                # every stranded request — a hung client is worse
+                # than a 503.
+                self.gave_up = True
+                s.supervised = False
+                try:
+                    s.fail_inflight(
+                        "engine dead (supervisor gave up after "
+                        f"{self.max_restarts} restarts in "
+                        f"{self.window_s:g}s)"
+                    )
+                # fault-boundary: a failing cleanup must not kill the
+                # supervisor before it reaches its give-up endpoint
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                continue
+            self._restart_times.append(now)
+            try:
+                s.restart()
+            # A restart that itself crashes (pool rebuild failed?)
+            # counts against the budget and is retried next poll —
+            # the supervisor must outlive it to reach its bounded
+            # give-up endpoint.
+            # fault-boundary: failed restart retried next poll
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
 
 
 def _decode_bucket(max_new: int) -> int:
@@ -464,6 +540,11 @@ def build_server(
     ttft_slo: float | None = None,
     queue_depth_slo: int | None = None,
     events_path: str | None = None,
+    max_queue: int | None = 256,
+    request_timeout: float | None = None,
+    degraded_cooldown: float = 30.0,
+    supervise: bool = True,
+    faults_spec: str | None = None,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline.
 
@@ -480,9 +561,23 @@ def build_server(
     ttft_slo / queue_depth_slo arm the serving anomaly detectors
     (utils/anomaly.py): breaches increment oryx_anomaly_total{kind=}
     and, with events_path, append structured JSONL events.
+
+    Failure containment (continuous engine; docs/OBSERVABILITY.md
+    "Failure playbook"): max_queue bounds admission (full -> 429 +
+    Retry-After), request_timeout deadlines every request (-> 504),
+    the SLO detectors drive a degraded-mode ladder (gauge
+    oryx_serving_degraded_mode), an EngineSupervisor restarts a dead
+    engine thread with deterministic request replay, and
+    `srv.begin_drain()` (SIGTERM in main()) flips /readyz to 503,
+    stops admission and finishes resident decodes. faults_spec arms
+    the deterministic fault-injection registry (utils/faults.py) —
+    chaos testing only, never in production config.
     """
     from oryx_tpu.utils.anomaly import AnomalyMonitor, AnomalyThresholds
     from oryx_tpu.utils.metrics import ServingMetrics
+
+    if faults_spec:
+        faults.configure(faults_spec)
 
     if engine != "continuous" and (ttft_slo or queue_depth_slo):
         # Only the continuous scheduler feeds the SLO detectors; a
@@ -492,11 +587,22 @@ def build_server(
             "--ttft-slo/--queue-depth-slo require --engine continuous "
             "(the window batcher does not feed the SLO detectors)"
         )
+    if engine != "continuous" and request_timeout:
+        # Same fail-fast contract for the containment knob: deadlines
+        # are enforced by the continuous engine loop; accepting the
+        # flag on the window batcher would promise 504s that never
+        # fire.
+        raise ValueError(
+            "--request-timeout requires --engine continuous (the "
+            "window batcher does not enforce per-request deadlines)"
+        )
     metrics = ServingMetrics()
     metrics.set_info("build_info", {
         "revision": _git_revision(), "engine": engine,
         "model": model_name,
     })
+    if faults.armed():
+        faults.bind_registry(metrics.registry)
     anomaly = AnomalyMonitor(
         source="serve",
         thresholds=AnomalyThresholds(
@@ -515,7 +621,10 @@ def build_server(
     # each other and with the batcher through this lock. (Continuous
     # engine: the scheduler thread owns the device; no lock needed.)
     stream_lock = threading.Lock()
-    batcher = scheduler = None
+    batcher = scheduler = supervisor = None
+    # Drain state shared across handler threads: set once by
+    # begin_drain(), read by /readyz and every POST.
+    draining = threading.Event()
     if engine == "continuous":
         from oryx_tpu.serve.scheduler import ContinuousScheduler
 
@@ -524,7 +633,12 @@ def build_server(
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            max_queue=max_queue, request_timeout=request_timeout,
+            degraded_cooldown=degraded_cooldown,
         )
+        if supervise:
+            supervisor = EngineSupervisor(scheduler)
+            supervisor.start()
     elif engine == "window":
         batcher = Batcher(
             pipe, window=batch_window, max_batch=max_batch,
@@ -535,11 +649,20 @@ def build_server(
 
     def _ready() -> tuple[bool, str]:
         """Readiness = the engine loop is genuinely able to make
-        progress: model built (we exist), engine thread alive, and —
-        when a watchdog is armed — no in-flight stall. A load balancer
-        probing this never has to spend a real completion."""
+        progress: not draining, engine thread alive, and — when a
+        watchdog is armed — no in-flight stall. A load balancer
+        probing this never has to spend a real completion; routers
+        eject a draining or crash-looping replica on this signal."""
+        if draining.is_set():
+            return False, "draining"
         if scheduler is not None:
-            if not scheduler._thread.is_alive():
+            if not scheduler.alive():
+                if supervisor is not None and supervisor.gave_up:
+                    return False, (
+                        "engine dead (supervisor gave up after "
+                        f"{supervisor.max_restarts} restarts in "
+                        f"{supervisor.window_s:g}s)"
+                    )
                 return False, "scheduler loop dead"
             wd = scheduler.watchdog
             if wd is not None and wd.stalled():
@@ -557,13 +680,16 @@ def build_server(
             pass
 
         def _json(self, code: int, body: dict[str, Any],
-                  request_id: str | None = None) -> None:
+                  request_id: str | None = None,
+                  extra_headers: dict[str, str] | None = None) -> None:
             data = json.dumps(body).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             if request_id:
                 self.send_header("X-Request-Id", request_id)
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -629,6 +755,16 @@ def build_server(
         def do_POST(self):
             if self.path != "/v1/chat/completions":
                 self._json(404, {"error": "not found"})
+                return
+            if draining.is_set():
+                # Drain contract: after SIGTERM no new completion work
+                # is accepted; in-flight requests still finish. The
+                # router saw /readyz flip already — this is the
+                # stragglers' answer.
+                self._json(503, {"error": {
+                    "message": "server is draining (shutting down)",
+                    "type": "unavailable_error",
+                }}, extra_headers={"Retry-After": "1"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -816,22 +952,50 @@ def build_server(
             handler thread only drains the handle's event queue, so a
             slow client never blocks decode (a dead one flips
             `cancelled` and the slot frees at the next harvest)."""
-            handle = scheduler.submit(
-                request_dict, max_new, sampling,
-                streaming=bool(req.get("stream")),
-            )
+            from oryx_tpu.serve.scheduler import AdmissionRejected
+
+            try:
+                handle = scheduler.submit(
+                    request_dict, max_new, sampling,
+                    streaming=bool(req.get("stream")),
+                )
+            except AdmissionRejected as e:
+                # Backpressure / shed-load -> 429, draining -> 503;
+                # both carry Retry-After so well-behaved clients back
+                # off instead of hammering a saturated replica.
+                code = (503 if e.reason in ("draining", "engine_dead")
+                        else 429)
+                self._json(code, {"error": {
+                    "message": str(e),
+                    "type": "overloaded_error" if code == 429
+                    else "unavailable_error",
+                    "reason": e.reason,
+                }}, extra_headers={
+                    "Retry-After": str(max(1, round(e.retry_after_s))),
+                })
+                return
             rid = handle.request_id
             if not req.get("stream"):
                 handle.done.wait()
                 if handle.error is not None:
+                    # error_kind -> status: the scheduler classified
+                    # the failure; this is just the HTTP spelling.
                     if handle.error_kind == "invalid_request":
-                        # Admission-time rejection (context too long,
-                        # bad media, ...) is the client's fault — 400,
-                        # matching the window engine's up-front checks.
                         self._json(400, {"error": {
                             "message": handle.error,
                             "type": "invalid_request_error",
                         }}, request_id=rid)
+                    elif handle.error_kind == "timeout":
+                        self._json(504, {"error": {
+                            "message": handle.error,
+                            "type": "timeout_error",
+                        }}, request_id=rid)
+                    elif handle.error_kind == "unavailable":
+                        self._json(503, {"error": {
+                            "message": handle.error,
+                            "type": "unavailable_error",
+                        }}, request_id=rid,
+                            extra_headers={"Retry-After": "1"})
                     else:
                         self._json(
                             500, {"error": {"message": handle.error}},
@@ -895,6 +1059,11 @@ def build_server(
                 handle.cancelled = True
 
         def _sse(self, body: dict[str, Any]) -> None:
+            # Chaos site: mid-stream client disconnect — raising
+            # BrokenPipeError here takes the exact code path a dropped
+            # socket takes, so the suite can prove cancellation frees
+            # the slot's pages and prefix-cache shares.
+            faults.fault_point("client_disconnect", exc=BrokenPipeError)
             self.wfile.write(f"data: {json.dumps(body)}\n\n".encode())
             self.wfile.flush()
 
@@ -904,6 +1073,18 @@ def build_server(
     srv.batcher = batcher
     srv.tracer = tracer
     srv.anomaly = anomaly
+    srv.supervisor = supervisor
+
+    def begin_drain() -> None:
+        """Drain-on-shutdown, step 1: /readyz flips 503 NOW (router
+        health ejection), POSTs answer 503 + Retry-After, and the
+        continuous engine stops admission and finishes resident
+        decodes. Callers then `scheduler.drain()` and shutdown()."""
+        draining.set()
+        if scheduler is not None:
+            scheduler.begin_drain()
+
+    srv.begin_drain = begin_drain
     return srv
 
 
@@ -983,6 +1164,36 @@ def main(argv: list[str] | None = None) -> None:
         "(see docs/OBSERVABILITY.md for the schema)",
     )
     ap.add_argument(
+        "--max-queue", type=int, default=256,
+        help="continuous engine: bound on the admission queue; beyond "
+        "it new requests get 429 + Retry-After instead of unbounded "
+        "queueing (0 = unbounded)",
+    )
+    ap.add_argument(
+        "--request-timeout", type=float, default=None,
+        help="continuous engine: per-request deadline in seconds — a "
+        "request past it is cancelled (pages and cache shares freed) "
+        "and answered 504 wherever it was (queued, prefilling, "
+        "decoding)",
+    )
+    ap.add_argument(
+        "--no-supervisor", action="store_true",
+        help="continuous engine: disable the engine supervisor that "
+        "restarts a dead engine thread with deterministic request "
+        "replay",
+    )
+    ap.add_argument(
+        "--drain-timeout", type=float, default=60.0,
+        help="seconds to wait for resident decodes to finish after "
+        "SIGTERM before exiting anyway",
+    )
+    ap.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="arm deterministic fault injection (utils/faults.py), "
+        "e.g. 'page_alloc_oom:p=0.05,seed=7;engine_crash:after=40' — "
+        "chaos testing only ($ORYX_FAULTS also works)",
+    )
+    ap.add_argument(
         "--allow-local-files", action="store_true",
         help="let image_url reference server-local file paths (off by "
         "default: any network client could read arbitrary images)",
@@ -1031,7 +1242,29 @@ def main(argv: list[str] | None = None) -> None:
         ttft_slo=args.ttft_slo,
         queue_depth_slo=args.queue_depth_slo,
         events_path=args.events_path,
+        max_queue=args.max_queue or None,
+        request_timeout=args.request_timeout,
+        supervise=not args.no_supervisor,
+        faults_spec=args.faults or os.environ.get("ORYX_FAULTS"),
     )
+
+    def _drain_and_exit() -> None:
+        print("SIGTERM: draining (admission stopped, /readyz now 503)")
+        srv.begin_drain()
+        if srv.scheduler is not None:
+            drained = srv.scheduler.drain(timeout=args.drain_timeout)
+            print("drain complete" if drained
+                  else f"drain timed out after {args.drain_timeout:g}s")
+        srv.shutdown()
+
+    def _on_sigterm(signum, frame):
+        # serve_forever() owns this thread; drain from a helper so the
+        # signal handler returns immediately.
+        threading.Thread(target=_drain_and_exit, daemon=True).start()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     print(f"serving {args.model_name} on http://{args.host}:{args.port}")
     srv.serve_forever()
 

@@ -48,6 +48,29 @@ Metrics (utils/metrics.ServingMetrics): queue depth, slot occupancy,
 admitted/evicted/completed counts, TTFT and per-token latency
 histograms, wasted vs useful decode steps, prefix-cache hit/miss
 tokens + entries/pages/evictions, prefill tokens and chunk sizes.
+
+Failure containment (docs/DESIGN.md "Failure containment"):
+
+  * Bounded admission: `max_queue` caps the queue; `submit` raises
+    `AdmissionRejected` (the API server answers 429 + Retry-After)
+    instead of letting a backlog grow without bound.
+  * Per-request deadlines: `request_timeout` (or per-call `timeout_s`)
+    cancels a request wherever it is — queued, prefilling, or decoding
+    — freeing its slot pages and prefix-cache shares exactly (the
+    chaos suite asserts `check_invariant` after every induced
+    timeout). The API server maps the "timeout" error kind to 504.
+  * Degraded-mode ladder: serving SLO anomalies (ttft_slo /
+    queue_depth_slo) escalate `degraded_mode` 0→3 — 1 sheds the prefix
+    cache, 2 clamps max_tokens, 3 sheds load (submit rejects) — and
+    quiet periods of `degraded_cooldown` seconds walk it back down.
+  * Crash recovery: `restart()` (driven by the API server's engine
+    supervisor) requeues every in-flight request for deterministic
+    eviction-style replay, rebuilds the page pool, verifies the pool
+    invariant, and restarts the engine thread — clients ride through
+    an engine-thread death without an error.
+  * Drain-on-shutdown: `begin_drain()` stops admission (new submits
+    rejected, queue errored with "draining"), finishes resident
+    decodes, then exits the loop; /readyz flips 503 at drain start.
 """
 
 from __future__ import annotations
@@ -70,6 +93,7 @@ from oryx_tpu.ops import paged_kv
 from oryx_tpu.ops.packing import round_up_bucket
 from oryx_tpu.serve import pipeline as pipeline_lib
 from oryx_tpu.serve.prefix_cache import PagedPrefixCache
+from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyMonitor
 from oryx_tpu.utils.metrics import (
@@ -82,6 +106,19 @@ from oryx_tpu.utils.metrics import (
 # queue/admission/eviction/finish (same id as X-Request-Id and
 # /debug/trace).
 _LOG = logging.getLogger("oryx.serve.scheduler")
+
+
+class AdmissionRejected(RuntimeError):
+    """submit() refused the request without queueing it: backpressure
+    (bounded queue full), shed_load (degraded mode 3), or draining
+    (shutdown in progress). Carries the Retry-After hint the HTTP
+    layer forwards (429 for load, 503 for drain)."""
+
+    def __init__(self, message: str, *, reason: str,
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class RequestHandle:
@@ -100,8 +137,10 @@ class RequestHandle:
         self.finish_reason: str = "stop"
         self.usage: tuple[int, int] | None = None
         self.error: str | None = None
-        # "invalid_request" when the request itself was rejected at
-        # admission (HTTP 400 material) vs a server-side fault (500).
+        # HTTP mapping for `error`: "invalid_request" = rejected at
+        # admission (400), "timeout" = per-request deadline exceeded
+        # (504), "unavailable" = draining/restarting (503),
+        # "server_error" = anything else (500).
         self.error_kind: str = "server_error"
         self.cancelled = False
         # Streaming consumers read text deltas off `events`; plain ones
@@ -130,6 +169,11 @@ class _Request:
     handle: RequestHandle
     submit_time: float
     stops: list[str]
+    # Absolute monotonic deadline (None = no deadline): enforced in
+    # the queue, during chunked prefill, and at every harvest — a
+    # request past it frees its pages/refcounts and errors with the
+    # "timeout" kind (HTTP 504).
+    deadline: float | None = None
     # Filled at first admission; cached so an evicted request never
     # re-runs the host-side prompt/media prep.
     embeds: Any = None
@@ -186,6 +230,10 @@ class ContinuousScheduler:
         anomaly: AnomalyMonitor | None = None,
         prefill_chunk: int | None = None,
         prefix_cache: bool = True,
+        max_queue: int | None = None,
+        request_timeout: float | None = None,
+        degraded_cooldown: float = 30.0,
+        degraded_clamp_tokens: int = 64,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -245,6 +293,12 @@ class ContinuousScheduler:
         reg.gauge("prefix_cache_pages")
         reg.counter("prefill_tokens_total")
         reg.histogram("prefill_chunk_tokens", PREFILL_CHUNK_BUCKETS)
+        # Containment families, pre-registered so dashboards render
+        # them at zero before the first incident.
+        reg.counter("admission_rejected_total", ("reason",))
+        reg.counter("deadline_exceeded_total")
+        reg.counter("engine_restarts_total")
+        reg.gauge("degraded_mode")
         self.allocator = paged_kv.PageAllocator(self.num_pages, page_size)
         self.prefix_cache = (
             PagedPrefixCache(self.allocator, metrics=self.metrics)
@@ -277,8 +331,33 @@ class ContinuousScheduler:
         self._queue: deque[_Request] = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
         self._shutdown = False  # guarded-by: _cond
+        self._draining = False  # guarded-by: _cond
         self._admit_seq = 0
         self.chunks_run = 0
+        # Failure-containment knobs. max_queue bounds admission
+        # (backpressure -> AdmissionRejected -> HTTP 429);
+        # request_timeout is the default per-request deadline.
+        self.max_queue = max_queue
+        self.request_timeout = request_timeout
+        # Degraded-mode ladder (0 normal, 1 shed prefix cache, 2 clamp
+        # max_tokens, 3 shed load), escalated by serving SLO anomaly
+        # firings and walked back after `degraded_cooldown` quiet
+        # seconds. The mode is read by submit() (HTTP threads) and
+        # written by the engine thread, both under _cond.
+        self.degraded_cooldown = degraded_cooldown
+        self.degraded_clamp_tokens = degraded_clamp_tokens
+        self._degraded = 0  # guarded-by: _cond
+        self._slo_fired_seen = 0
+        self._degraded_changed = time.monotonic()
+        self._cache_shed = False  # engine-thread-only
+        self.restarts = 0
+        # Dead-engine admission guard: once the loop has STARTED, a
+        # dead thread with nobody to revive it (no EngineSupervisor —
+        # which sets `supervised` — or one that gave up and cleared
+        # it) must reject new work instead of queueing requests whose
+        # handles can never complete.
+        self._started = False
+        self.supervised = False
         # Flight recorder of the last N requests (shared with the API
         # server's /debug endpoints when it passes its own tracer) plus
         # an optional stall watchdog: no decode chunk completing within
@@ -298,6 +377,7 @@ class ContinuousScheduler:
 
     def start(self) -> None:
         if not self._thread.is_alive():
+            self._started = True
             self._thread.start()
 
     def submit(
@@ -307,7 +387,12 @@ class ContinuousScheduler:
         sampling: dict[str, Any] | None = None,
         *,
         streaming: bool = False,
+        timeout_s: float | None = None,
     ) -> RequestHandle:
+        """Queue one request; raises AdmissionRejected (without
+        queueing anything) when draining, shedding load (degraded mode
+        3), or the bounded queue is full. timeout_s overrides the
+        scheduler-wide request_timeout deadline for this request."""
         sampling = sampling or {}
         h = RequestHandle()
         h.streaming = streaming
@@ -320,18 +405,65 @@ class ContinuousScheduler:
         h.request_id = tr.id
         h.trace = tr
         h.debug["request_id"] = tr.id
+        now = time.monotonic()
+        eff_timeout = (
+            timeout_s if timeout_s is not None else self.request_timeout
+        )
         req = _Request(
             request=request, max_new=max_new, sampling=sampling,
-            handle=h, submit_time=time.monotonic(), stops=stops,
-            trace=tr,
+            handle=h, submit_time=now, stops=stops, trace=tr,
+            deadline=(now + eff_timeout) if eff_timeout else None,
         )
         req.qw_span = tr.begin("queue_wait")
-        _LOG.info("request %s queued (max_new=%d)", tr.id, max_new)
         with self._cond:
-            self._queue.append(req)
-            depth = len(self._queue)
-            self.metrics.set_gauge("queue_depth", depth)
-            self._cond.notify()
+            # Admission-control checks and the append are one atomic
+            # section: two racing submits can never both squeeze into
+            # the last queue slot.
+            reject = None
+            if self._shutdown or self._draining:
+                reject = ("draining", "server is draining; not "
+                          "accepting new requests", 1.0)
+            elif (
+                self._started and not self._thread.is_alive()
+                and not self.supervised
+            ):
+                # Permanently dead engine (no supervisor, or it gave
+                # up): queueing would hang the client forever — the
+                # deadline enforcer lives in the dead loop too.
+                reject = ("engine_dead", "engine is not running and "
+                          "nothing will restart it", 5.0)
+            elif self._degraded >= 3:
+                reject = ("shed_load", "server is shedding load "
+                          "(degraded mode 3); retry shortly", 2.0)
+            elif (
+                self.max_queue is not None
+                and len(self._queue) >= self.max_queue
+            ):
+                # Retry-After scales with how deep the backlog runs
+                # relative to serving capacity — a rough token-bucket
+                # hint, not a promise.
+                retry = min(
+                    30.0, 1.0 + len(self._queue) / max(1, self.num_slots)
+                )
+                reject = ("backpressure",
+                          f"admission queue full ({len(self._queue)} "
+                          f">= {self.max_queue})", retry)
+            if reject is None:
+                self._queue.append(req)
+                depth = len(self._queue)
+                self.metrics.set_gauge("queue_depth", depth)
+                self._cond.notify()
+        if reject is not None:
+            reason, msg, retry_after = reject
+            self.metrics.inc(
+                "admission_rejected_total", labels={"reason": reason}
+            )
+            tr.finish(error=msg, rejected=reason)
+            _LOG.info("request %s rejected (%s)", tr.id, reason)
+            raise AdmissionRejected(
+                msg, reason=reason, retry_after_s=retry_after
+            )
+        _LOG.info("request %s queued (max_new=%d)", tr.id, max_new)
         if self.anomaly is not None:
             self.anomaly.observe_queue_depth(depth)
         return h
@@ -344,6 +476,128 @@ class ContinuousScheduler:
             self._thread.join(timeout=30)
         if self.watchdog is not None:
             self.watchdog.stop()
+
+    def begin_drain(self) -> None:
+        """Start drain-on-shutdown: admission stops NOW (new submits
+        rejected, queued-but-unadmitted requests errored with
+        "draining"), resident requests — decoding or mid-prefill —
+        run to completion, then the engine loop exits. /readyz flips
+        503 the moment this is called (the `draining` property)."""
+        with self._cond:
+            if self._draining:
+                return
+            self._draining = True
+            self._cond.notify()
+        _LOG.info("drain started: admission stopped, finishing "
+                  "resident requests")
+
+    def drain(self, timeout: float | None = 60.0) -> bool:
+        """begin_drain() + wait for the engine loop to finish resident
+        work and exit; returns whether it fully drained in time."""
+        self.begin_drain()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        drained = not self._thread.is_alive()
+        if drained:
+            with self._cond:
+                stranded = bool(self._queue) or any(
+                    r is not None for r in self.slots
+                )
+            if stranded:
+                # The engine died before (or without) running the
+                # drain path: its queue-flush and resident-finish
+                # logic never ran, and nothing ever will complete
+                # these handles. Error them out now so clients get a
+                # retriable 503 instead of a connection reset at
+                # shutdown.
+                self.fail_inflight("server draining with engine stopped")
+        if drained and self.watchdog is not None:
+            self.watchdog.stop()
+        return drained
+
+    def fail_inflight(self, msg: str, *, kind: str = "unavailable"
+                      ) -> None:
+        """Error out EVERY queued and resident request and rebuild the
+        pool. Only for the engine-is-dead-and-staying-dead endgames
+        (supervisor give-up, drain of a dead engine): with the loop
+        stopped nothing else will ever complete these handles, and
+        this is what turns "hang forever" into a retriable 503. Must
+        not be called while the engine loop is running."""
+        with self._cond:
+            dropped = list(self._queue)
+            self._queue.clear()
+            self.metrics.set_gauge("queue_depth", 0)
+        for r in dropped:
+            self._reject_queued(r, msg, kind=kind)
+        if dropped and self.anomaly is not None:
+            self.anomaly.observe_queue_depth(0)
+        for s, req in enumerate(self.slots):
+            if req is not None:
+                self._finish_error(s, msg, kind=kind)
+        # The dead loop may have left the donated pool consumed;
+        # rebuild (clears every slot, asserts check_invariant).
+        self._reset_pool()
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
+
+    def alive(self) -> bool:
+        """Engine loop thread is running (the /readyz signal)."""
+        return self._thread.is_alive()
+
+    @property
+    def stopping(self) -> bool:
+        """close() or drain() in progress — the supervisor must not
+        restart a deliberately stopped engine."""
+        with self._cond:
+            return self._shutdown or self._draining
+
+    @property
+    def degraded_mode(self) -> int:
+        with self._cond:
+            return self._degraded
+
+    def restart(self) -> None:
+        """Recover from engine-thread death (the supervisor's entry
+        point): requeue every in-flight request at the FRONT for
+        deterministic replay (same machinery as eviction: same key0,
+        same prompt, `processed` tokens skipped on re-emission),
+        rebuild the consumed page pool, verify the pool invariant, and
+        start a fresh engine thread. No client sees an error."""
+        if self._thread.is_alive():
+            return
+        live = sorted(
+            ((req.admit_seq, s, req)
+             for s, req in enumerate(self.slots) if req is not None),
+            reverse=True,
+        )
+        for _, s, req in live:  # youngest first -> oldest ends at head
+            req.replay = req.processed
+            req.activated = False
+            req.spliced = 0
+            req.prefill_pos = 0
+            req.trace.event(
+                "engine_restart_replay", slot=s,
+                replay_tokens=req.processed,
+            )
+            req.qw_span = req.trace.begin("queue_wait", requeued=True)
+            with self._cond:
+                self._queue.appendleft(req)
+        with self._cond:
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        # The dead dispatch may have consumed the donated pool; rebuild
+        # (this clears every slot and asserts check_invariant).
+        self._reset_pool()
+        self.restarts += 1
+        self.metrics.inc("engine_restarts_total")
+        _LOG.warning(
+            "engine thread restarted (#%d): %d request(s) requeued "
+            "for replay", self.restarts, len(live),
+        )
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
 
     # ---- slot bookkeeping ------------------------------------------------
 
@@ -428,24 +682,69 @@ class ContinuousScheduler:
         if need > self.allocator.num_free:
             return False
         held = self._held(s)
-        self.bt[s, held: held + need] = self.allocator.alloc(need)
+        try:
+            pages = self.allocator.alloc(need)
+        except paged_kv.OutOfPagesError:
+            # Free-list said yes but alloc refused (injected OOM, or a
+            # racing holder): report "can't grow" so the normal
+            # eviction/defer machinery handles it — an allocation
+            # failure is a scheduling signal, never a crash. alloc is
+            # all-or-nothing, so nothing is held on this path.
+            return False
+        self.bt[s, held: held + need] = pages
         return True
 
     # ---- scheduling loop -------------------------------------------------
 
     def _run(self) -> None:
         while True:
+            drain_drop: list[_Request] = []
             with self._cond:
                 if self._shutdown:
                     return
-                if not self._queue and all(r is None for r in self.slots):
-                    if self.watchdog is not None:
-                        self.watchdog.set_active(False)
-                    self._cond.wait(timeout=0.1)
-                    continue
+                if self._draining and self._queue:
+                    # Drain: admission is over — queued-but-unadmitted
+                    # requests hold no pages; error them out so their
+                    # clients retry against another replica.
+                    while self._queue:
+                        drain_drop.append(self._queue.popleft())
+                    self.metrics.set_gauge("queue_depth", 0)
+                idle = not self._queue and all(
+                    r is None for r in self.slots
+                )
+                drain_exit = idle and self._draining
+            for r in drain_drop:
+                self._reject_queued(
+                    r, "server draining: request not admitted",
+                    kind="unavailable",
+                )
+            if drain_drop and self.anomaly is not None:
+                self.anomaly.observe_queue_depth(0)
+            if drain_exit:
+                _LOG.info("drain complete: engine loop exiting")
+                return
+            if idle:
+                # The degraded ladder must keep decaying while idle —
+                # mode 3 sheds load, so "no traffic" is exactly when
+                # it has to walk itself back down (called OUTSIDE the
+                # cond block: it takes the lock itself).
+                self._update_degraded()
+                if self.watchdog is not None:
+                    self.watchdog.set_active(False)
+                with self._cond:
+                    if not self._queue and not self._shutdown:
+                        self._cond.wait(timeout=0.1)
+                continue
             if self.watchdog is not None:
                 self.watchdog.set_active(True)
+            # Chaos site: engine-thread DEATH (outside the containment
+            # try below, so the exception escapes _run and the thread
+            # dies — exactly what the API server's supervisor exists
+            # to catch and restart).
+            faults.fault_point("engine_crash")
             try:
+                self._update_degraded()
+                self._enforce_deadlines()
                 self._admit()
                 # Chunked admission interleaves with decode: each engine
                 # step advances the in-flight admission by at most one
@@ -484,6 +783,102 @@ class ContinuousScheduler:
                 # engine keeps serving new traffic instead of erroring
                 # forever on a deleted array.
                 self._reset_pool()
+
+    def _reject_queued(
+        self, req: _Request, msg: str, *, kind: str = "server_error"
+    ) -> None:
+        """Error out a request that was ALREADY popped from the queue
+        and never placed (holds no pages)."""
+        req.handle.error = msg
+        req.handle.error_kind = kind
+        req.handle.events.put(("error", msg))
+        req.handle.done.set()
+        req.trace.finish(error=msg)
+        _LOG.info("request %s dropped: %s", req.trace.id, msg)
+
+    def _enforce_deadlines(self) -> None:
+        """Cancel every request past its deadline, wherever it lives:
+        queued (no pages held), mid-prefill, or decoding (slot pages +
+        prefix-cache shares freed via _clear_slot). Runs once per
+        engine step — a hung dispatch therefore converts into a clean
+        504 at the next step boundary."""
+        now = time.monotonic()
+        expired: list[_Request] = []
+        with self._cond:
+            if self._queue and any(
+                r.deadline is not None and now > r.deadline
+                for r in self._queue
+            ):
+                keep: deque[_Request] = deque()
+                for r in self._queue:
+                    if r.deadline is not None and now > r.deadline:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                self._queue = keep
+                depth = len(keep)
+                self.metrics.set_gauge("queue_depth", depth)
+            else:
+                depth = None
+        for r in expired:
+            self.metrics.inc("deadline_exceeded_total")
+            self._reject_queued(
+                r, "deadline exceeded before admission", kind="timeout"
+            )
+        if depth is not None and self.anomaly is not None:
+            self.anomaly.observe_queue_depth(depth)
+        for s, req in enumerate(self.slots):
+            if req is None or req.deadline is None or now <= req.deadline:
+                continue
+            self.metrics.inc("deadline_exceeded_total")
+            self._finish_error(
+                s,
+                f"deadline exceeded after {now - req.submit_time:.2f}s "
+                f"({len(req.emitted)} tokens emitted)",
+                kind="timeout",
+            )
+
+    def _update_degraded(self) -> None:
+        """Degraded-mode ladder: each NEW serving-SLO anomaly firing
+        escalates one level (1 shed prefix cache, 2 clamp max_tokens,
+        3 shed load); `degraded_cooldown` quiet seconds de-escalate
+        one level. Exported as the `degraded_mode` gauge."""
+        if self.anomaly is None:
+            return
+        fired = sum(
+            self.anomaly.counts.get(k, 0)
+            for k in ("ttft_slo", "queue_depth_slo")
+        )
+        now = time.monotonic()
+        with self._cond:
+            mode = self._degraded
+        if fired > self._slo_fired_seen:
+            self._slo_fired_seen = fired
+            self._degraded_changed = now
+            if mode < 3:
+                self._set_degraded(mode + 1)
+        elif mode > 0 and now - self._degraded_changed \
+                >= self.degraded_cooldown:
+            self._degraded_changed = now
+            self._set_degraded(mode - 1)
+
+    def _set_degraded(self, mode: int) -> None:
+        with self._cond:
+            prev, self._degraded = self._degraded, mode
+        self.metrics.set_gauge("degraded_mode", mode)
+        _LOG.warning(
+            "degraded mode %d -> %d (%s)", prev, mode,
+            ["normal", "prefix cache shed", "max_tokens clamped",
+             "shedding load"][mode],
+        )
+        if mode >= 1 and not self._cache_shed:
+            # Shed the prefix cache: free its pages for live requests
+            # and stop feeding it until the ladder fully clears.
+            self._cache_shed = True
+            if self.prefix_cache is not None:
+                self.prefix_cache.clear()
+        elif mode == 0:
+            self._cache_shed = False
 
     def _admit(self) -> None:
         gen = self.cfg.generation
@@ -550,6 +945,19 @@ class ContinuousScheduler:
                     req.topp = float(s_.get("top_p", gen.top_p) or 1.0)
                     req.topk = int(s_.get("top_k", gen.top_k) or 0)
                     req.key0 = jax.random.key(int(s_.get("seed") or 0))
+                    with self._cond:
+                        mode = self._degraded
+                    if (
+                        mode >= 2
+                        and req.max_new > self.degraded_clamp_tokens
+                    ):
+                        # Degraded mode 2: cap the decode budget so the
+                        # backlog turns over faster; the client sees a
+                        # "length" finish and the clamp in debug.
+                        req.max_new = self.degraded_clamp_tokens
+                        req.handle.debug["clamped_max_tokens"] = (
+                            self.degraded_clamp_tokens
+                        )
                     if req.length + req.max_new > self.max_ctx:
                         raise ValueError(
                             f"prompt ({req.length}) + max_tokens "
@@ -625,7 +1033,12 @@ class ContinuousScheduler:
         ps = self.page_size
         spliced = 0
         matched, pages = 0, []
-        if self.prefix_cache is not None and req.cache_tokens is not None:
+        cache_on = (
+            self.prefix_cache is not None
+            and req.cache_tokens is not None
+            and not self._cache_shed  # degraded >= 1: no splicing
+        )
+        if cache_on:
             matched, pages = self.prefix_cache.lookup(req.cache_tokens)
         use = min(matched, max(req.length - 1, 0))
         full = use // ps
@@ -644,7 +1057,7 @@ class ContinuousScheduler:
             )
         if total_need - full > avail:
             return False
-        if self.prefix_cache is not None and req.cache_tokens is not None:
+        if cache_on:
             if full:
                 share = [int(p) for p in pages[:full]]
                 self.allocator.share(share)
@@ -715,9 +1128,25 @@ class ContinuousScheduler:
         for s, req in enumerate(self.slots):
             if req is None or req.activated:
                 continue
+            if req.handle.cancelled:
+                # Client hung up mid-admission: the prefill must stop
+                # HERE, not run the rest of the prompt — and the slot's
+                # pages (including spliced prefix-cache shares) return
+                # now. Same invariant as the mid-decode cancel in
+                # _advance.
+                self.metrics.inc("cancelled")
+                self._clear_slot(s)
+                req.trace.finish(cancelled=True)
+                _LOG.info(
+                    "request %s cancelled mid-prefill", req.trace.id
+                )
+                continue
             self._advance_prefill(s, req)
 
     def _advance_prefill(self, s: int, req: _Request) -> None:
+        # Chaos site: prefill dispatch failure/stall. A raise here is
+        # contained by _run's catch-all (requests errored, pool reset).
+        faults.fault_point("prefill_dispatch")
         B1 = np.newaxis
         off = req.prefill_pos
         L = req.length
@@ -824,7 +1253,10 @@ class ContinuousScheduler:
         references, so the entry outlives the slot). Called at
         activation with the prompt — concurrent look-alikes hit
         immediately — and at finish with prompt + reply."""
-        if self.prefix_cache is None or req.cache_tokens is None:
+        if (
+            self.prefix_cache is None or req.cache_tokens is None
+            or self._cache_shed
+        ):
             return
         stream = req.cache_tokens
         if tokens > req.length:
@@ -899,6 +1331,11 @@ class ContinuousScheduler:
 
     # hot-path
     def _step_chunk(self) -> None:
+        # Chaos site: decode dispatch failure (raise -> every in-flight
+        # request errors, pool resets, serving continues) or hang
+        # (delay= -> the stall watchdog and per-request deadlines are
+        # what bound it).
+        faults.fault_point("decode_dispatch")
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
         with self.pipe._mesh_scope():
@@ -1080,10 +1517,13 @@ class ContinuousScheduler:
         )
         self.metrics.inc("completed")
 
-    def _finish_error(self, s: int, msg: str) -> None:
+    def _finish_error(
+        self, s: int, msg: str, *, kind: str = "server_error"
+    ) -> None:
         req = self.slots[s]
         self._clear_slot(s)
         req.handle.error = msg
+        req.handle.error_kind = kind
         req.handle.events.put(("error", msg))
         req.handle.done.set()
         req.trace.finish(error=msg)

@@ -94,6 +94,13 @@ def load_params(args, cfg: OryxConfig):
 
 def main(argv: list[str] | None = None) -> None:
     args = build_argparser().parse_args(argv)
+    from oryx_tpu.utils import faults
+
+    if faults.configure_from_env():
+        # $ORYX_FAULTS arms the trainer chaos sites (checkpoint_save/
+        # restore, data_loader_next, trainer_crash) — chaos testing
+        # only, never a production config.
+        rank0_print("fault injection armed from $ORYX_FAULTS")
     if args.coordinator or args.num_processes:
         mesh_lib.initialize_distributed(
             args.coordinator, args.num_processes, args.process_id

@@ -23,6 +23,7 @@ from oryx_tpu.parallel import sharding
 from oryx_tpu.train import step as step_lib
 from oryx_tpu.train import telemetry as telemetry_lib
 from oryx_tpu.train.optimizer import make_optimizer, make_schedule
+from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyThresholds
 from oryx_tpu.utils.checkpoint import CheckpointManager
@@ -45,6 +46,22 @@ def validate_train_batch(cfg: OryxConfig, batch: dict) -> None:
         )
 
 
+def _poison_one_float_leaf(batch: dict) -> dict:
+    """Chaos helper (`data_loader_next:corrupt=1`): NaN one element of
+    the first floating-point field, simulating a corrupt record — the
+    skip_nonfinite guard should skip the step, not crash the run."""
+    batch = dict(batch)
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            bad = arr.copy()
+            bad.flat[0] = np.nan
+            batch[k] = bad
+            rank0_print(f"fault injection: poisoned batch field {k!r}")
+            break
+    return batch
+
+
 class Trainer:
     def __init__(
         self,
@@ -62,8 +79,15 @@ class Trainer:
         on_anomaly: str = "warn",
         anomaly_thresholds: AnomalyThresholds | None = None,
         telemetry: telemetry_lib.TrainTelemetry | None = None,
+        max_data_faults: int = 8,
     ) -> None:
         self.cfg = cfg
+        # Data-loader containment: a transient loader failure skips
+        # that fetch and pulls the next batch (bounded by
+        # max_data_faults consecutive failures — a dead loader still
+        # fails loudly). `data_faults` counts the recoveries.
+        self.max_data_faults = max_data_faults
+        self.data_faults = 0
         self.mesh = mesh_lib.build_mesh(cfg.mesh)
         self.sharding_mode = sharding_mode
         self.logger = MetricLogger(
@@ -94,6 +118,10 @@ class Trainer:
                 port=metrics_port, events_path=events_path,
                 thresholds=anomaly_thresholds, on_anomaly=on_anomaly,
             )
+        if self.telemetry is not None and faults.armed():
+            # Chaos runs export oryx_faults_injected_total{site=}
+            # through the trainer registry, mirroring the serving side.
+            faults.bind_registry(self.telemetry.registry)
         self._lr_fn = make_schedule(cfg.train, cfg.train.learning_rate)
         # Per-step flight recorder (same Trace/Span model as serving):
         # each step records data / h2d / step_dispatch / device_sync /
@@ -240,6 +268,44 @@ class Trainer:
 
         return {k: put(k, v) for k, v in batch.items()}
 
+    def _next_batch(self, batches: Iterator, tr) -> tuple[dict, Any]:
+        """Fetch the next host batch with skip-and-requeue containment:
+        a transient loader exception (injectable at the
+        `data_loader_next` chaos site) logs, counts, and fetches the
+        NEXT batch instead of killing the run; `max_data_faults`
+        consecutive failures still abort loudly. StopIteration (data
+        genuinely exhausted) passes through untouched. Returns
+        (batch, data_span)."""
+        consecutive = 0
+        while True:
+            try:
+                with tr.span("data") as sp_data:
+                    # corrupt=1 at this site poisons one float leaf
+                    # with NaN instead of raising — driving the
+                    # existing skip_nonfinite guard end-to-end.
+                    corrupt = faults.fault_point("data_loader_next")
+                    batch = next(batches)
+                    if corrupt:
+                        batch = _poison_one_float_leaf(batch)
+                    return batch, sp_data
+            except StopIteration:
+                raise
+            # fault-boundary: transient data fault -> skip this fetch
+            except Exception as e:
+                consecutive += 1
+                self.data_faults += 1
+                rank0_print(
+                    f"data loader fault ({consecutive}/"
+                    f"{self.max_data_faults} consecutive): "
+                    f"{type(e).__name__}: {e}; skipping to next batch"
+                )
+                if consecutive >= self.max_data_faults:
+                    raise RuntimeError(
+                        f"{consecutive} consecutive data-loader "
+                        "failures — aborting (see Trainer "
+                        "max_data_faults)"
+                    ) from e
+
     # hot-path
     def fit(
         self,
@@ -265,13 +331,19 @@ class Trainer:
         try:
             with sharding.mesh_scope(self.mesh):
                 for step_i in range(start, num_steps):
+                    # Chaos site: a mid-run process death (raises out
+                    # of fit; nothing contains it — the test of this
+                    # site is that a FRESH Trainer auto-resumes from
+                    # the last good checkpoint bit-identically).
+                    faults.fault_point("trainer_crash")
                     t_step0 = time.perf_counter()
                     tr = self.tracer.start_trace(
                         "train_step", label=f"step {step_i + 1}"
                     )
                     try:
-                        with tr.span("data") as sp_data:
-                            host_batch = next(batches)
+                        host_batch, sp_data = self._next_batch(
+                            batches, tr
+                        )
                     except StopIteration:
                         tr.finish(exhausted=True)
                         rank0_print("data exhausted; stopping")

@@ -11,11 +11,15 @@ checkpoints goes through models/import_hf (safetensors import/export).
 from __future__ import annotations
 
 import os
-from typing import Any
+import time
+from typing import Any, Callable
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from oryx_tpu.utils import faults
+from oryx_tpu.utils.retry import BackoffPolicy, retry_call
 
 Params = dict[str, Any]
 
@@ -38,10 +42,32 @@ if PLACEHOLDER is None:
 
 
 class CheckpointManager:
-    """Async step-numbered checkpoints with retention, plus resume."""
+    """Async step-numbered checkpoints with retention, plus resume.
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3) -> None:
+    Failure containment: orbax itself writes each step into a temp
+    location and renames on finalize (a torn write can never become
+    "latest"); on top of that, `save` retries transient failures with
+    bounded exponential backoff (`save_retry`) — and a persistent
+    failure still fails loudly after the budget. Scope honestly: the
+    retry wraps the SYNCHRONOUS phase of an async save (directory
+    prep, serialization enqueue — and the `checkpoint_save` chaos
+    site). A failure in the background commit thread surfaces on the
+    NEXT save()/wait() call; the next save runs under this same
+    policy, so a transient background failure costs at most the one
+    torn checkpoint (which temp+rename keeps out of "latest") rather
+    than the run. `save_retries` counts the recoveries for
+    telemetry/tests. `sleep` is injectable so tests pin the schedule
+    without wall-clock waits."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_retry: BackoffPolicy | None = None,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
         self.directory = os.path.abspath(directory)
+        self._save_retry = save_retry or BackoffPolicy(
+            retries=3, base_s=0.5, factor=2.0, max_s=10.0
+        )
+        self._sleep = sleep
+        self.save_retries = 0
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -55,9 +81,24 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        """Async-save a pytree (TrainState or bare params)."""
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force
+        """Async-save a pytree (TrainState or bare params), retrying
+        transient failures per `save_retry`. The chaos site
+        `checkpoint_save` injects failures HERE, before orbax runs, so
+        the retry schedule is exercised deterministically."""
+
+        def attempt() -> bool:
+            faults.fault_point("checkpoint_save")
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            )
+
+        def count(_attempt, _exc, _delay) -> None:
+            self.save_retries += 1
+
+        return retry_call(
+            attempt, policy=self._save_retry, retry_on=(Exception,),
+            sleep=self._sleep, on_retry=count,
+            describe=f"checkpoint save (step {step})",
         )
 
     def restore(self, state_like: Any = None, step: int | None = None) -> Any:
@@ -68,6 +109,9 @@ class CheckpointManager:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        # Chaos site: restore failure — the resume path's caller (or
+        # the operator) decides whether an older step is acceptable.
+        faults.fault_point("checkpoint_restore")
         if state_like is None:
             return self._mgr.restore(step)
         restored = self._mgr.restore(
@@ -174,14 +218,27 @@ def _npz_path(path: str) -> str:
 
 def save_projector_only(path: str, params: Params) -> None:
     """Stage-1-style partial checkpoint: compressor/projector weights only
-    (the reference's `mm_projector.bin` analog), as a flat npz."""
+    (the reference's `mm_projector.bin` analog), as a flat npz.
+
+    Atomic: written to a temp sibling then os.replace'd, so a crash
+    mid-write can never leave a torn file at the published path."""
     flat = jax.tree_util.tree_flatten_with_path(params["compressor"])[0]
     arrays = {
         "/".join(p.key for p in path): np.asarray(leaf)
         for path, leaf in flat
     }
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(_npz_path(path), **arrays)
+    final = _npz_path(path)
+    os.makedirs(os.path.dirname(os.path.abspath(final)), exist_ok=True)
+    tmp = final + ".tmp"
+    try:
+        np.savez(tmp, **arrays)
+        # np.savez may append .npz to the temp name too; normalize.
+        written = tmp if os.path.exists(tmp) else _npz_path(tmp)
+        os.replace(written, final)
+    finally:
+        for leftover in (tmp, _npz_path(tmp)):
+            if os.path.exists(leftover):
+                os.remove(leftover)
 
 
 def load_projector_only(path: str, params: Params) -> Params:

@@ -62,7 +62,9 @@ class MetricLogger:
                 from torch.utils.tensorboard import SummaryWriter
 
                 self._tb = SummaryWriter(tensorboard_dir)
-            except Exception as e:  # TB optional: log and continue
+            # fault-boundary: TB is optional — its absence only
+            # disables TB, never training
+            except Exception as e:
                 rank0_print(f"tensorboard disabled: {e!r}")
         self._last_time = time.perf_counter()
         self._last_step = 0
@@ -402,8 +404,10 @@ class Registry:
         for fn in collectors:
             try:
                 fn()
+            # fault-boundary: a broken collector must never break the
+            # scrape
             except Exception:
-                pass  # a broken collector must never break the scrape
+                pass
         with self._lock:
             families = sorted(self._families.items())
         out: list[str] = []

@@ -479,6 +479,8 @@ class StallWatchdog:
         out.write(buf.getvalue())
         try:
             out.flush()
+        # fault-boundary: a closed/broken sink must not turn the stall
+        # dump itself into a second crash
         except Exception:
             pass
         self.dumps += 1

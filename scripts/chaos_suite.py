@@ -1,0 +1,394 @@
+"""Chaos suite: every named fault scenario against a LIVE tiny server,
+asserting the invariant triad after each one.
+
+Scenarios (one armed `utils/faults.py` spec each, fully deterministic):
+
+  * ``page_alloc_oom``    injected pool exhaustion during a concurrent
+                          shared-prefix burst — defer/evict absorbs it;
+                          every request still answers 200.
+  * ``engine_crash``      engine-thread death mid-decode — the
+                          EngineSupervisor restarts with deterministic
+                          replay; the client's reply is byte-identical
+                          to the solo pipeline and /readyz recovers.
+  * ``hung_dispatch``     a decode dispatch stalls past the
+                          per-request deadline — the request converts
+                          into a clean 504, pages freed.
+  * ``client_disconnect`` the SSE write path raises BrokenPipeError
+                          (the dropped-socket code path) — the request
+                          cancels, pages and cache shares freed.
+  * ``checkpoint_save``   injected save failures — bounded
+                          exponential-backoff retry lands the
+                          checkpoint; the schedule is pinned (no
+                          wall-clock sleeps).
+
+The invariant triad, asserted after EVERY serving scenario:
+
+  1. pool `check_invariant(holders)` holds — every page free or
+     exactly accounted to its holders (slots + prefix cache);
+  2. zero leaked pages/refcounts — with all slots idle, free pages +
+     cache-held pages == the whole pool;
+  3. the server RETURNS TO SERVING — /readyz 200, a fresh completion
+     answers 200, and `oryx_faults_injected_total{site=}` in /metrics
+     reconciles exactly against the injection schedule's own count.
+
+Exit 0 = all scenarios contained; nonzero prints the failing scenario.
+Wired into scripts/check_tier1.sh. See docs/OBSERVABILITY.md "Failure
+playbook" for what each scenario looks like in production telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# A chaos run must never inherit ambient fault specs on top of the
+# per-scenario ones this script arms itself.
+os.environ.pop("ORYX_FAULTS", None)
+
+
+class _Tokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def wait_for(predicate, timeout=120.0, what="condition") -> None:
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if predicate():
+            return
+        time.sleep(0.05)
+    fail(f"timed out waiting for {what}")
+
+
+class Harness:
+    """One tiny in-process server per scenario: build, run the
+    scenario body, assert the triad, tear down."""
+
+    def __init__(self, pipe):
+        self.pipe = pipe
+
+    def boot(self, faults_spec: str, **server_kw):
+        from oryx_tpu.serve import api_server
+
+        srv = api_server.build_server(
+            self.pipe, port=0, engine="continuous", num_slots=2,
+            page_size=16, decode_chunk=4, max_ctx=512,
+            faults_spec=faults_spec, **server_kw,
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def teardown(self, srv) -> None:
+        from oryx_tpu.utils import faults
+
+        faults.reset()
+        if srv.supervisor is not None:
+            srv.supervisor.stop()
+        if srv.scheduler is not None:
+            srv.scheduler.close()
+        srv.shutdown()
+
+    # -- HTTP helpers (utils/retry.urlopen_json: rides out the engine
+    # -- restart window instead of failing on one refused connect) ----
+
+    def get(self, url: str, **kw):
+        from oryx_tpu.utils.retry import urlopen_json
+
+        return urlopen_json(url, **kw)
+
+    def post_chat(self, base: str, content: str, max_tokens: int,
+                  timeout: float = 600.0):
+        return self.get(
+            base + "/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": content}],
+                "max_tokens": max_tokens,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            timeout=timeout,
+        )
+
+    # -- the triad -----------------------------------------------------
+
+    def assert_triad(self, srv, base: str, scenario: str,
+                    sites: list[str]) -> None:
+        from oryx_tpu.utils import faults
+
+        sched = srv.scheduler
+        wait_for(
+            lambda: all(r is None for r in sched.slots)
+            and not sched._queue,
+            what=f"[{scenario}] slots+queue to empty",
+        )
+        # 1. Pool invariant: every page free or exactly accounted.
+        sched._check_pool_invariant()
+        # 2. Zero leaks: with no residents, only the prefix cache may
+        #    hold pages.
+        cache_pages = (
+            len(sched.prefix_cache.held_pages())
+            if sched.prefix_cache is not None else 0
+        )
+        if sched.allocator.num_free + cache_pages != sched.num_pages:
+            fail(f"[{scenario}] leaked pages: free "
+                 f"{sched.allocator.num_free} + cache {cache_pages} "
+                 f"!= pool {sched.num_pages}")
+        # 3a. Back to serving: /readyz 200 and a real completion works.
+        status, body, _ = self.get(base + "/readyz", timeout=30)
+        if status != 200 or body.get("ready") is not True:
+            fail(f"[{scenario}] /readyz after the scenario: want "
+                 f"200/true, got {status} {body}")
+        status, body, _ = self.post_chat(base, "post-chaos probe", 3)
+        if status != 200:
+            fail(f"[{scenario}] post-scenario completion: want 200, "
+                 f"got {status} {body}")
+        # 3b. Metric reconciliation: what /metrics says happened is
+        #     exactly what the armed schedule says it injected.
+        import urllib.request
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            if r.status != 200:
+                fail(f"[{scenario}] /metrics scrape: want 200, got "
+                     f"{r.status}")
+            text = r.read().decode()
+        total = 0
+        for site in sites:
+            m = re.search(
+                rf'^oryx_faults_injected_total\{{site="{site}"\}} '
+                rf"([0-9.e+-]+)$", text, re.M,
+            )
+            metric = float(m.group(1)) if m else 0.0
+            count = faults.injected_count(site)
+            if metric != count:
+                fail(f"[{scenario}] oryx_faults_injected_total"
+                     f'{{site="{site}"}} is {metric}, injector '
+                     f"counted {count}")
+            total += count
+        print(f"  [{scenario}] contained: invariant holds, 0 leaks, "
+              f"/readyz 200, {total} fault(s) injected and accounted")
+
+
+# ---------------------------------------------------------------------
+# Scenarios
+# ---------------------------------------------------------------------
+
+
+def scenario_page_alloc_oom(h: Harness) -> None:
+    """Injected pool exhaustion on a deterministic schedule while a
+    shared-prefix burst runs: allocation failure is a scheduling
+    signal (defer / evict / COW-fallback) — every request answers."""
+    srv, base = h.boot("page_alloc_oom:every=3,times=6")
+    try:
+        sysprompt = "shared prefix for the chaos burst to splice! "
+        results: list[tuple[int, object]] = []
+
+        def one(i: int) -> None:
+            status, body, _ = h.post_chat(
+                base, sysprompt + f"q{i}", 3 + i % 2
+            )
+            results.append((status, body))
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        bad = [r for r in results if r[0] != 200]
+        if bad:
+            fail(f"[page_alloc_oom] burst requests failed under "
+                 f"injected OOM: {bad}")
+        h.assert_triad(srv, base, "page_alloc_oom", ["page_alloc_oom"])
+    finally:
+        h.teardown(srv)
+
+
+def scenario_engine_crash(h: Harness) -> None:
+    """Engine-thread death mid-flight: the supervisor restarts the
+    loop, the in-flight request replays deterministically, and the
+    client's reply is byte-identical to the solo pipeline."""
+    q, m = "hello there chaos", 10
+    ref = h.pipe.chat(q, max_new_tokens=m)
+    srv, base = h.boot("engine_crash:after=2")
+    try:
+        status, body, _ = h.post_chat(base, q, m)
+        if status != 200:
+            fail(f"[engine_crash] request through the crash: want "
+                 f"200, got {status} {body}")
+        reply = body["choices"][0]["message"]["content"]
+        if reply != ref:
+            fail(f"[engine_crash] replayed reply {reply!r} != solo "
+                 f"pipeline {ref!r} — replay was not deterministic")
+        wait_for(lambda: srv.scheduler.restarts >= 1, timeout=30,
+                 what="[engine_crash] supervisor restart")
+        if srv.metrics.get("engine_restarts_total") < 1:
+            fail("[engine_crash] engine_restarts_total never moved")
+        h.assert_triad(srv, base, "engine_crash", ["engine_crash"])
+    finally:
+        h.teardown(srv)
+
+
+def scenario_hung_dispatch(h: Harness) -> None:
+    """The FIRST decode dispatch stalls past the per-request deadline:
+    the next step boundary converts the hang into a clean 504 and
+    frees the slot's pages."""
+    srv, base = h.boot(
+        "decode_dispatch:delay=2.0,after=0", request_timeout=0.75,
+    )
+    try:
+        status, body, _ = h.post_chat(base, "about to hang", 64)
+        if status != 504:
+            fail(f"[hung_dispatch] want 504 from the deadline, got "
+                 f"{status} {body}")
+        if body["error"]["type"] != "timeout_error":
+            fail(f"[hung_dispatch] error type {body['error']} is not "
+                 "timeout_error")
+        if srv.metrics.get("deadline_exceeded_total") < 1:
+            fail("[hung_dispatch] deadline_exceeded_total never moved")
+        # The post-scenario probe in the triad must NOT inherit the
+        # deadline that 504s everything — lift it (server default for
+        # new requests only; the scenario's own request already ran).
+        srv.scheduler.request_timeout = None
+        h.assert_triad(srv, base, "hung_dispatch", ["decode_dispatch"])
+    finally:
+        h.teardown(srv)
+
+
+def scenario_client_disconnect(h: Harness) -> None:
+    """The SSE write path raises BrokenPipeError (the exact dropped-
+    socket code path): the request cancels and its pages and
+    prefix-cache shares come back."""
+    import urllib.error
+    import urllib.request
+
+    srv, base = h.boot("client_disconnect:after=0")
+    try:
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({
+                "messages": [
+                    {"role": "user", "content": "stream then vanish"}
+                ],
+                "max_tokens": 200, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        # The injected BrokenPipeError kills the response mid-stream;
+        # whatever the client sees (truncated body, reset) is fine —
+        # the assertion is server-side.
+        # fault-boundary: the client half of an injected disconnect
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                r.read()
+        except (OSError, urllib.error.URLError):
+            pass
+        wait_for(lambda: srv.metrics.get("cancelled") >= 1,
+                 what="[client_disconnect] cancellation")
+        h.assert_triad(
+            srv, base, "client_disconnect", ["client_disconnect"]
+        )
+    finally:
+        h.teardown(srv)
+
+
+def scenario_checkpoint_save(h: Harness) -> None:
+    """Two injected save failures: bounded backoff retries land the
+    checkpoint on the third attempt, schedule pinned (no wall-clock
+    sleeps), and the fault metric reconciles in the bound registry."""
+    import tempfile
+
+    import numpy as np
+
+    from oryx_tpu.utils import faults
+    from oryx_tpu.utils.checkpoint import CheckpointManager
+    from oryx_tpu.utils.metrics import Registry
+    from oryx_tpu.utils.retry import BackoffPolicy
+
+    faults.configure("checkpoint_save:times=2")
+    reg = Registry()  # raw-named family only; no prefix needed
+    faults.bind_registry(reg)
+    slept: list[float] = []
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(
+            os.path.join(d, "ck"),
+            save_retry=BackoffPolicy(retries=3, base_s=0.5,
+                                     factor=2.0, jitter=0.0),
+            sleep=slept.append,
+        )
+        try:
+            state = {"x": np.arange(16, dtype=np.float32)}
+            if mgr.save(1, state) is not True:
+                fail("[checkpoint_save] save did not land")
+            mgr.wait()
+            if mgr.latest_step() != 1:
+                fail("[checkpoint_save] latest_step != 1 after "
+                     "retried save")
+            restored = mgr.restore(None)
+            if not np.array_equal(np.asarray(restored["x"]),
+                                  state["x"]):
+                fail("[checkpoint_save] restored state differs")
+        finally:
+            mgr.close()
+    if slept != [0.5, 1.0]:
+        fail(f"[checkpoint_save] backoff schedule {slept} != "
+             "[0.5, 1.0] — retry policy drifted")
+    m = re.search(
+        r'^oryx_faults_injected_total\{site="checkpoint_save"\} '
+        r"([0-9.e+-]+)$", reg.render(), re.M,
+    )
+    metric = float(m.group(1)) if m else 0.0
+    if metric != 2 or faults.injected_count("checkpoint_save") != 2:
+        fail(f"[checkpoint_save] injected-count mismatch: metric "
+             f"{metric}, counter "
+             f"{faults.injected_count('checkpoint_save')}, want 2")
+    faults.reset()
+    print("  [checkpoint_save] contained: 2 injected failures, "
+          "pinned backoff [0.5, 1.0], checkpoint landed + restored, "
+          "2 fault(s) accounted")
+
+
+def main() -> None:
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    t0 = time.monotonic()
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_Tokenizer(), params, cfg)
+    h = Harness(pipe)
+    print("chaos suite: 5 scenarios against a live tiny server")
+    for scenario in (
+        scenario_page_alloc_oom,
+        scenario_engine_crash,
+        scenario_hung_dispatch,
+        scenario_client_disconnect,
+        scenario_checkpoint_save,
+    ):
+        scenario(h)
+    print(f"chaos suite OK: every fault contained, every pool "
+          f"invariant held ({time.monotonic() - t0:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,12 +10,14 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 385 = the 350 recorded at PR 4 plus the oryxlint/sanitizer suites
-# added in PR 5 (fixture-exact checker tests, CLI contract, repo
-# self-lint, recompile watchdog + donation guard, two regression
-# tests; 404 observed with a warm /tmp/jax_cache), with headroom for
-# load-dependent flakes (bench-supervisor probes on one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-385}
+# 455 = the 385 recorded at PR 5 plus the fault-injection/containment
+# suites added in PR 6 (faults registry, retry/backoff, serving
+# containment — deadlines, backpressure, degraded ladder, crash
+# replay, drain, disconnect, allocator failure schedules — trainer
+# faults incl. the bit-identical auto-resume, swallowed-exception lint
+# fixtures; 474 observed with a warm /tmp/jax_cache), with headroom
+# for load-dependent flakes (bench-supervisor probes on one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-455}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -64,6 +66,19 @@ echo "checking prefix-cache perf (bench_prefix_cache.py --smoke)"
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/bench_prefix_cache.py --smoke > /dev/null; then
     echo "PREFIX CACHE PERF CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- chaos suite: fault injection + failure containment ----------------------
+# Every named fault scenario (injected page-pool OOM, engine-thread
+# crash, hung dispatch vs deadline, mid-stream client disconnect,
+# checkpoint-save failure) against a live tiny server: pool invariants
+# hold, zero leaked pages/refcounts, /readyz returns to 200, and
+# oryx_faults_injected_total reconciles against the injection schedule.
+echo "checking failure containment (chaos_suite.py)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/chaos_suite.py; then
+    echo "CHAOS SUITE FAILED (a fault escaped containment)" >&2
     exit 1
 fi
 

@@ -1,0 +1,66 @@
+"""Clean fixture: handlers that narrow, handle, annotate, or re-raise
+— none of these are swallows."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def narrow_type_is_fine():
+    try:
+        risky()
+    except OSError:  # naming the type IS the statement of intent
+        pass
+
+
+def handled_with_fallback():
+    try:
+        return risky()
+    except Exception as e:
+        log.warning("falling back: %s", e)
+        return None
+
+
+def reraised():
+    try:
+        risky()
+    except Exception as e:
+        raise RuntimeError("context") from e
+
+
+def recorded():
+    errors = []
+    try:
+        risky()
+    except Exception as e:
+        errors.append(e)
+    return errors
+
+
+def annotated_boundary_trailing():
+    try:
+        risky()
+    except Exception:  # fault-boundary: broken sink, drop is correct
+        pass
+
+
+def annotated_boundary_line_above():
+    try:
+        risky()
+    # fault-boundary: a broken collector must never break the scrape
+    except Exception:
+        pass
+
+
+def annotated_boundary_block_above():
+    try:
+        risky()
+    # This drop is deliberate containment, explained over two
+    # comment lines, the second carrying the marker.
+    # fault-boundary: optional dependency; absence only disables it
+    except Exception:
+        pass
+
+
+def risky():
+    raise ValueError("boom")

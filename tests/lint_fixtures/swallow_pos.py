@@ -1,0 +1,55 @@
+"""Positive fixture: broad swallows the swallowed-exception rule must
+flag, with exact `# expect:` line markers."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def bare_pass():
+    try:
+        risky()
+    except:  # noqa: E722  # expect: swallowed-exception
+        pass
+
+
+def broad_pass():
+    try:
+        risky()
+    except Exception:  # expect: swallowed-exception
+        pass
+
+
+def broad_log_and_drop():
+    try:
+        risky()
+    except Exception as e:  # expect: swallowed-exception
+        log.warning("ignoring %s", e)
+
+
+def broad_ellipsis_continue():
+    for _ in range(3):
+        try:
+            risky()
+        except BaseException:  # expect: swallowed-exception
+            continue
+
+
+def broad_in_tuple():
+    try:
+        risky()
+    except (ValueError, Exception):  # expect: swallowed-exception
+        print("oh well")
+
+
+def broad_print_exc():
+    import traceback
+
+    try:
+        risky()
+    except Exception:  # expect: swallowed-exception
+        traceback.print_exc()
+
+
+def risky():
+    raise ValueError("boom")

@@ -1,0 +1,13 @@
+"""Suppressed fixture: the ordinary oryxlint per-line suppression also
+silences the rule (counted, never hidden)."""
+
+
+def suppressed_swallow():
+    try:
+        risky()
+    except Exception:  # oryxlint: disable=swallowed-exception
+        pass
+
+
+def risky():
+    raise ValueError("boom")

@@ -1,0 +1,176 @@
+"""Fault-injection registry: spec parsing, deterministic schedules,
+zero-overhead disarm, metrics reconciliation."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.utils import faults
+from oryx_tpu.utils.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_parse_spec_full_grammar():
+    spec = "page_alloc_oom:p=0.05,seed=7;engine_crash:after=40"
+    parsed = faults.parse_spec(spec)
+    assert parsed == {
+        "page_alloc_oom": {"p": 0.05, "seed": 7.0},
+        "engine_crash": {"after": 40.0},
+    }
+
+
+@pytest.mark.parametrize("bad", [
+    "site:notakey=1",          # unknown option
+    "site:p=high",             # non-numeric
+    "site:p=1.5",              # probability out of range
+    "bad site:p=0.5",          # bad site name
+    "a:p=0.1;a:p=0.2",         # duplicate site
+])
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+
+
+def test_disarmed_fault_point_is_inert():
+    assert faults.armed() is False
+    assert faults.fault_point("anything") is False
+    assert faults.injected_count() == 0
+
+
+def test_after_fires_exactly_once_at_the_right_hit():
+    faults.configure("boom:after=3")
+    for _ in range(3):
+        assert faults.fault_point("boom") is False
+    with pytest.raises(faults.FaultInjected) as ei:
+        faults.fault_point("boom")
+    assert ei.value.site == "boom"
+    # times defaults to 1 for `after`: subsequent hits pass clean.
+    for _ in range(5):
+        assert faults.fault_point("boom") is False
+    assert faults.injected_count("boom") == 1
+
+
+def test_every_and_times_cap():
+    faults.configure("tick:every=2,times=2")
+    fired = 0
+    for _ in range(10):
+        try:
+            faults.fault_point("tick")
+        except faults.FaultInjected:
+            fired += 1
+    assert fired == 2
+    assert faults.injected_count("tick") == 2
+
+
+def test_probability_schedule_is_seed_deterministic():
+    def run():
+        faults.configure("p50:p=0.5,seed=11")
+        out = []
+        for _ in range(32):
+            try:
+                faults.fault_point("p50")
+                out.append(False)
+            except faults.FaultInjected:
+                out.append(True)
+        return out
+
+    a, b = run(), run()
+    assert a == b
+    assert any(a) and not all(a)  # a real Bernoulli stream, not 0%/100%
+
+
+def test_custom_exception_factory():
+    class MyOOM(RuntimeError):
+        pass
+
+    faults.configure("oom:after=0")
+    with pytest.raises(MyOOM):
+        faults.fault_point("oom", exc=MyOOM)
+
+
+def test_delay_sleeps_and_does_not_raise():
+    faults.configure("slow:delay=0.05,times=1")
+    t0 = time.monotonic()
+    assert faults.fault_point("slow") is False
+    assert time.monotonic() - t0 >= 0.04
+    assert faults.injected_count("slow") == 1
+
+
+def test_corrupt_returns_true_for_the_caller():
+    faults.configure("garble:corrupt=1,times=1")
+    assert faults.fault_point("garble") is True
+    assert faults.fault_point("garble") is False
+
+
+def test_unlisted_site_never_fires():
+    faults.configure("only_this:after=0")
+    assert faults.fault_point("something_else") is False
+    assert faults.injected_count() == 0
+
+
+def test_metrics_registry_reconciles_with_injected_count():
+    reg = Registry(prefix="oryx_serving")
+    faults.configure("a:every=1,times=3;b:after=1")
+    faults.bind_registry(reg)
+    for _ in range(5):
+        for site in ("a", "b"):
+            try:
+                faults.fault_point(site)
+            except faults.FaultInjected:
+                pass
+    text = reg.render()
+    assert 'oryx_faults_injected_total{site="a"} 3' in text
+    assert 'oryx_faults_injected_total{site="b"} 1' in text
+    assert faults.injected_count() == 4
+    # The family renders (at zero members' absence) even before firing:
+    assert "# TYPE oryx_faults_injected_total counter" in text
+
+
+def test_configure_resets_counts_between_scenarios():
+    faults.configure("x:after=0")
+    with pytest.raises(faults.FaultInjected):
+        faults.fault_point("x")
+    faults.configure("x:after=0")
+    assert faults.injected_count("x") == 0
+    with pytest.raises(faults.FaultInjected):
+        faults.fault_point("x")
+
+
+def test_configure_from_env(monkeypatch):
+    monkeypatch.setenv("ORYX_FAULTS", "envsite:after=0")
+    assert faults.configure_from_env() is True
+    with pytest.raises(faults.FaultInjected):
+        faults.fault_point("envsite")
+    monkeypatch.delenv("ORYX_FAULTS")
+    faults.reset()
+    assert faults.configure_from_env() is False
+
+
+def test_thread_safety_exact_total_under_contention():
+    faults.configure("race:every=1")
+    hits_per_thread, nthreads = 200, 4
+    errs = []
+
+    def worker():
+        for _ in range(hits_per_thread):
+            try:
+                faults.fault_point("race")
+            except faults.FaultInjected:
+                pass
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert faults.injected_count("race") == hits_per_thread * nthreads

@@ -1,0 +1,115 @@
+"""Retry/backoff utility: deterministic schedules, bounded budgets,
+injectable sleep (tests never wall-clock sleep)."""
+
+import pytest
+
+from oryx_tpu.utils.retry import BackoffPolicy, backoff_delays, retry_call
+
+
+def test_backoff_schedule_is_deterministic_and_capped():
+    policy = BackoffPolicy(
+        retries=5, base_s=0.1, factor=2.0, max_s=0.5, jitter=0.0
+    )
+    assert backoff_delays(policy) == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_jitter_is_seeded_and_bounded():
+    policy = BackoffPolicy(
+        retries=8, base_s=1.0, factor=1.0, max_s=1.0, jitter=0.25
+    )
+    a = backoff_delays(policy, seed=3)
+    b = backoff_delays(policy, seed=3)
+    c = backoff_delays(policy, seed=4)
+    assert a == b
+    assert a != c
+    assert all(0.75 <= d <= 1.25 for d in a)
+    assert len(set(a)) > 1  # jitter actually varies per retry
+
+
+def test_retry_call_succeeds_after_transient_failures():
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_call(
+        flaky,
+        policy=BackoffPolicy(retries=4, base_s=0.1, factor=2.0,
+                             jitter=0.0),
+        retry_on=(OSError,),
+        sleep=slept.append,
+    )
+    assert out == "ok"
+    assert calls["n"] == 3
+    assert slept == [0.1, 0.2]  # exact schedule, no wall clock
+
+
+def test_retry_budget_exhaustion_reraises_last_error():
+    slept = []
+
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        retry_call(
+            always,
+            policy=BackoffPolicy(retries=2, base_s=0.5, jitter=0.0),
+            sleep=slept.append,
+        )
+    assert slept == [0.5, 1.0]  # budget spent, then the raise
+
+
+def test_non_retryable_exception_propagates_immediately():
+    slept = []
+
+    def typed():
+        raise KeyError("wrong kind")
+
+    with pytest.raises(KeyError):
+        retry_call(typed, retry_on=(OSError,), sleep=slept.append)
+    assert slept == []
+
+
+def test_on_retry_callback_sees_attempt_exc_delay():
+    seen = []
+
+    def flaky():
+        if len(seen) < 2:
+            raise OSError("again")
+        return 1
+
+    retry_call(
+        flaky,
+        policy=BackoffPolicy(retries=3, base_s=0.1, factor=3.0,
+                             jitter=0.0),
+        retry_on=(OSError,),
+        sleep=lambda _d: None,
+        on_retry=lambda a, e, d: seen.append((a, str(e), d)),
+    )
+    assert seen == [(0, "again", 0.1), (1, "again", pytest.approx(0.3))]
+
+
+def test_zero_retries_means_one_attempt():
+    calls = {"n": 0}
+
+    def once():
+        calls["n"] += 1
+        raise OSError("no")
+
+    with pytest.raises(OSError):
+        retry_call(
+            once, policy=BackoffPolicy(retries=0), sleep=lambda _d: None
+        )
+    assert calls["n"] == 1
+
+
+@pytest.mark.parametrize("kw", [
+    {"retries": -1}, {"factor": 0.5}, {"jitter": 1.0}, {"base_s": -1.0},
+])
+def test_policy_validation(kw):
+    with pytest.raises(ValueError):
+        BackoffPolicy(**kw)
