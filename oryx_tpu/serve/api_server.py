@@ -15,7 +15,11 @@ web-framework dependency.
                          503 with a reason otherwise)
   GET /metrics          (Prometheus text format, build_info gauge,
                          HBM gauges, oryx_anomaly_total on SLO breach)
-  GET /debug/requests   (flight recorder: last N requests, in-flight too)
+  GET /debug/requests   (flight recorder: last N requests, in-flight
+                         too; ?limit=K bounds the response, ?state=
+                         active|done|error filters — both built to stay
+                         usable mid load-sweep; finished entries carry
+                         the per-request cost ledger in meta.cost)
   GET /debug/trace?id=  (one request's span tree as Chrome trace JSON —
                          loads in Perfetto; id from the X-Request-Id
                          header every response carries)
@@ -702,12 +706,50 @@ def build_server(
                     200 if ready else 503,
                     {"ready": ready, "reason": reason},
                 )
-            elif self.path == "/debug/requests":
+            elif self.path.split("?", 1)[0] == "/debug/requests":
                 # Flight recorder: newest-first summaries of the last N
-                # requests (in-flight included).
+                # requests (in-flight included). ?limit= bounds the
+                # response and ?state=active|done|error filters — a
+                # load sweep pushes hundreds of requests through the
+                # recorder and the consumer usually wants "the failed
+                # ones" or "the last K", not the whole ring.
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                state = (q.get("state") or [""])[0]
+                if state not in ("", "all", "active", "done", "error"):
+                    self._json(400, {
+                        "error": f"unknown state {state!r} "
+                        "(active|done|error|all)",
+                    })
+                    return
+                try:
+                    limit = int((q.get("limit") or ["0"])[0])
+                    if limit < 0:
+                        raise ValueError
+                except ValueError:
+                    self._json(400, {
+                        "error": "limit must be a non-negative integer",
+                    })
+                    return
+                reqs = tracer.snapshot()
+                if state == "active":
+                    reqs = [r for r in reqs if not r["done"]]
+                elif state == "done":
+                    reqs = [
+                        r for r in reqs
+                        if r["done"] and "error" not in r["meta"]
+                    ]
+                elif state == "error":
+                    reqs = [r for r in reqs if "error" in r["meta"]]
+                total = len(reqs)
+                if limit:
+                    reqs = reqs[:limit]
                 self._json(200, {
                     "engine": engine,
-                    "requests": tracer.snapshot(),
+                    "total": total,
+                    "returned": len(reqs),
+                    "requests": reqs,
                 })
             elif self.path.startswith("/debug/trace"):
                 q = urllib.parse.parse_qs(
@@ -1002,10 +1044,17 @@ def build_server(
                             request_id=rid,
                         )
                 else:
-                    self._json(200, _completion_body(
+                    body = _completion_body(
                         model_name, handle.reply, handle.finish_reason,
                         usage=handle.usage, request_id=rid,
-                    ), request_id=rid)
+                    )
+                    # Per-request cost ledger (extra key; OpenAI
+                    # clients ignore unknown fields): what this
+                    # completion actually cost the engine.
+                    cost = handle.debug.get("cost")
+                    if cost is not None:
+                        body["oryx"] = {"cost": cost}
+                    self._json(200, body, request_id=rid)
                 return
             want_usage = bool(
                 (req.get("stream_options") or {}).get("include_usage")
@@ -1035,10 +1084,19 @@ def build_server(
                         break
                     else:  # ("end", reason, usage)
                         usage = payload[1]
-                        self._sse(_chunk_body(
+                        fin = _chunk_body(
                             model_name, cid, None, payload[0],
                             usage_field=want_usage,
-                        ))
+                        )
+                        # Final SSE metadata: the request's cost ledger
+                        # rides the finish chunk (the scheduler set it
+                        # in debug before queueing the end event), so a
+                        # streaming client — loadgen included — gets
+                        # per-request cost without a /debug round-trip.
+                        cost = handle.debug.get("cost")
+                        if cost is not None:
+                            fin["oryx"] = {"cost": cost}
+                        self._sse(fin)
                         break
                 if errored:
                     return

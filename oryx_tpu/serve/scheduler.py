@@ -49,6 +49,17 @@ admitted/evicted/completed counts, TTFT and per-token latency
 histograms, wasted vs useful decode steps, prefix-cache hit/miss
 tokens + entries/pages/evictions, prefill tokens and chunk sizes.
 
+Cost ledger: every request accumulates what it actually COST — prefill
+tokens computed vs tokens spliced from the prefix cache, device decode
+steps (replays included), queue/prefill/decode wall time from its own
+spans, and a pages-held x time integral (page-seconds, the HBM
+currency; refcount-weighted so shared prefix pages split their cost
+among their holders). The ledger is finalized on every terminal path into
+handle.debug["cost"] + the trace meta (so /debug/requests and the
+final SSE chunk carry it) and into the oryx_serving_request_*
+histogram families; scripts/loadgen.py turns the aggregate into
+capacity claims (docs/OBSERVABILITY.md "Capacity & load testing").
+
 Failure containment (docs/DESIGN.md "Failure containment"):
 
   * Bounded admission: `max_queue` caps the queue; `submit` raises
@@ -97,7 +108,10 @@ from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyMonitor
 from oryx_tpu.utils.metrics import (
+    PAGE_SECONDS_BUCKETS,
     PREFILL_CHUNK_BUCKETS,
+    REQUEST_SECONDS_BUCKETS,
+    REQUEST_TOKEN_BUCKETS,
     ServingMetrics,
     TTFT_BUCKETS,
 )
@@ -198,6 +212,19 @@ class _Request:
     processed: int = 0  # tokens consumed from the device stream
     replay: int = 0  # tokens to skip after an eviction re-admission
     admit_seq: int = -1  # admission order (eviction picks the youngest)
+    # Cost ledger (docs/OBSERVABILITY.md "Capacity & load testing"):
+    # per-request resource attribution, accumulated ACROSS placements
+    # (an evicted request's replay re-pays prefill — that cost was
+    # really spent). prefill tokens actually computed, tokens spliced
+    # from the prefix cache, device decode steps the row consumed
+    # (replay steps included: eviction overhead is still cost), and
+    # the pages-held x wall-time integral in page-seconds. Wall-time
+    # phase attribution comes from the trace spans at finalization.
+    cost_prefill_tokens: int = 0
+    cost_cached_tokens: int = 0
+    cost_decode_steps: int = 0
+    cost_page_seconds: float = 0.0
+    pages_t: float = 0.0  # last page-seconds accrual (0 = never held)
     # Span handles into `trace` for regions that outlive one method:
     # queue_wait opens at submit (and again at eviction), admission
     # opens when the request reaches the queue head. -1 = not open.
@@ -299,6 +326,18 @@ class ContinuousScheduler:
         reg.counter("deadline_exceeded_total")
         reg.counter("engine_restarts_total")
         reg.gauge("degraded_mode")
+        # Per-request cost-ledger families: the aggregate view of the
+        # ledger every terminal request carries in /debug/requests and
+        # its final SSE metadata (scripts/loadgen.py divides these by
+        # goodput for tokens-per-page-second capacity claims).
+        reg.histogram("request_prefill_tokens", REQUEST_TOKEN_BUCKETS)
+        reg.histogram("request_cached_tokens", REQUEST_TOKEN_BUCKETS)
+        reg.histogram("request_decode_steps", REQUEST_TOKEN_BUCKETS)
+        reg.histogram("request_page_seconds", PAGE_SECONDS_BUCKETS)
+        reg.histogram("request_queue_seconds", REQUEST_SECONDS_BUCKETS)
+        reg.histogram("request_prefill_seconds", REQUEST_SECONDS_BUCKETS)
+        reg.histogram("request_decode_seconds", REQUEST_SECONDS_BUCKETS)
+        reg.histogram("request_e2e_seconds", REQUEST_SECONDS_BUCKETS)
         self.allocator = paged_kv.PageAllocator(self.num_pages, page_size)
         self.prefix_cache = (
             PagedPrefixCache(self.allocator, metrics=self.metrics)
@@ -458,7 +497,8 @@ class ContinuousScheduler:
             self.metrics.inc(
                 "admission_rejected_total", labels={"reason": reason}
             )
-            tr.finish(error=msg, rejected=reason)
+            cost = self._finalize_cost(None, req, observe=False)
+            tr.finish(error=msg, rejected=reason, cost=cost)
             _LOG.info("request %s rejected (%s)", tr.id, reason)
             raise AdmissionRejected(
                 msg, reason=reason, retry_after_s=retry_after
@@ -574,6 +614,10 @@ class ContinuousScheduler:
             reverse=True,
         )
         for _, s, req in live:  # youngest first -> oldest ends at head
+            # The pool rebuild below frees these pages without
+            # _clear_slot: bank the page-seconds integral now so the
+            # ledger doesn't lose the pre-crash residency.
+            self._accrue_page_seconds(s)
             req.replay = req.processed
             req.activated = False
             req.spliced = 0
@@ -642,6 +686,70 @@ class ContinuousScheduler:
     def _held(self, s: int) -> int:
         return int((self.bt[s] != self._sentinel).sum())
 
+    def _accrue_page_seconds(self, s: int) -> None:
+        """Advance slot s's pages-held x time integral up to now,
+        REFCOUNT-WEIGHTED: a page shared by k holders charges each
+        holder 1/k (the prefix cache's own reference is a holder too),
+        so request_page_seconds summed across requests never exceeds
+        physical page-seconds — full-charging shared pages would make
+        the aggregate HBM currency look MORE expensive the better
+        prefix sharing works, inverting the metric. Runs before every
+        page-count change (grow / free), once per decode chunk (so
+        refcount samples stay fresh as neighbors splice/release), and
+        at finalization."""
+        req = self.slots[s]
+        if req is None or not req.pages_t:
+            return
+        now = time.monotonic()
+        weight = sum(
+            1.0 / max(1, self.allocator.refcount(int(p)))
+            for p in self.bt[s] if p != self._sentinel
+        )
+        req.cost_page_seconds += weight * (now - req.pages_t)
+        req.pages_t = now
+
+    def _finalize_cost(self, s: int | None, req: _Request,
+                       observe: bool = True) -> dict[str, Any]:
+        """Close the per-request cost ledger on a terminal path
+        (finish, error, cancel — BEFORE the slot's pages are freed;
+        s=None for a request that never held a slot, e.g. cancelled in
+        queue — its ledger is real too, just all-zero resources): final
+        page-seconds accrual, queue/prefill/decode wall time from the
+        request's own spans, aggregate histograms. The dict lands in
+        handle.debug["cost"] (the API server forwards it as final SSE
+        metadata) and in the trace meta (/debug/requests)."""
+        if s is not None:
+            self._accrue_page_seconds(s)
+        by = req.trace.span_seconds()
+        cost = {
+            "prefill_tokens": req.cost_prefill_tokens,
+            "cached_tokens": req.cost_cached_tokens,
+            "decode_steps": req.cost_decode_steps,
+            "page_seconds": round(req.cost_page_seconds, 6),
+            "queue_s": round(by.get("queue_wait", 0.0), 6),
+            "prefill_s": round(by.get("prefill", 0.0), 6),
+            "decode_s": round(by.get("decode_chunk", 0.0), 6),
+            "e2e_s": round(time.monotonic() - req.submit_time, 6),
+        }
+        req.handle.debug["cost"] = cost
+        if not observe:
+            # Submit-time rejections (429/503, never queued) keep
+            # their ledger for /debug, but must not flood the
+            # aggregate histograms with all-zero samples — a retry
+            # storm would drive every request_* distribution to the
+            # bottom bucket exactly when the overload view matters.
+            return cost
+        m = self.metrics
+        m.observe("request_prefill_tokens", cost["prefill_tokens"])
+        m.observe("request_cached_tokens", cost["cached_tokens"])
+        m.observe("request_decode_steps", cost["decode_steps"])
+        m.observe("request_page_seconds", cost["page_seconds"])
+        m.observe("request_queue_seconds", cost["queue_s"])
+        m.observe("request_prefill_seconds", cost["prefill_s"])
+        m.observe("request_decode_seconds", cost["decode_s"])
+        m.observe("request_e2e_seconds", cost["e2e_s"])
+        return cost
+
     def _free_slot_pages(self, s: int) -> None:
         pages = [int(p) for p in self.bt[s] if p != self._sentinel]
         if pages:
@@ -649,6 +757,10 @@ class ContinuousScheduler:
         self.bt[s] = self._sentinel
 
     def _clear_slot(self, s: int) -> None:
+        # Last accrual point while the occupant still holds its pages
+        # (eviction keeps accumulating on the same ledger after
+        # re-admission; terminal paths have already finalized).
+        self._accrue_page_seconds(s)
         self._free_slot_pages(s)
         self.slots[s] = None
         self.finished[s] = True
@@ -669,6 +781,9 @@ class ContinuousScheduler:
         need = self.allocator.pages_for(tokens) - self._held(s)
         if need <= 0:
             return True
+        # Page count is about to change: bank the integral at the OLD
+        # held count first, or the grown pages would be backdated.
+        self._accrue_page_seconds(s)
         if need > self.allocator.num_free and self.prefix_cache is not None:
             # Cached pages go before live requests: reclaim cache-only
             # (refcount-1) entries, LRU first, before reporting
@@ -766,11 +881,12 @@ class ContinuousScheduler:
                 with self._cond:
                     while self._queue:
                         r = self._queue.popleft()
+                        cost = self._finalize_cost(None, r)
                         r.handle.error = msg
                         r.handle.events.put(("error", msg))
                         r.handle.done.set()
                         if r.trace is not None:
-                            r.trace.finish(error=msg)
+                            r.trace.finish(error=msg, cost=cost)
                     # Every pop refreshes the gauge (same invariant as
                     # the cancel path): after the drain /metrics must
                     # say empty, and the drain-side observation lets a
@@ -788,12 +904,16 @@ class ContinuousScheduler:
         self, req: _Request, msg: str, *, kind: str = "server_error"
     ) -> None:
         """Error out a request that was ALREADY popped from the queue
-        and never placed (holds no pages)."""
+        and never placed (holds no pages). Still a terminal path: the
+        ledger (zero resources, real queue_s) is finalized — in the
+        saturated regime most requests end HERE, and cost attribution
+        that omits them would claim saturation is cheap."""
+        cost = self._finalize_cost(None, req)
         req.handle.error = msg
         req.handle.error_kind = kind
         req.handle.events.put(("error", msg))
         req.handle.done.set()
-        req.trace.finish(error=msg)
+        req.trace.finish(error=msg, cost=cost)
         _LOG.info("request %s dropped: %s", req.trace.id, msg)
 
     def _enforce_deadlines(self) -> None:
@@ -910,7 +1030,13 @@ class ContinuousScheduler:
                     # client cancels must re-arm the queue_depth_slo
                     # episode, or the next burst fires no event.
                     self.anomaly.observe_queue_depth(depth)
-                req.trace.finish(cancelled=True)
+                # A cancelled-in-queue request still gets a ledger
+                # (zero resources, real queue_s): its trace lands in
+                # /debug/requests?state=done, and the every-finished-
+                # request-has-a-complete-ledger audit must hold there
+                # too.
+                cost = self._finalize_cost(None, req)
+                req.trace.finish(cancelled=True, cost=cost)
                 _LOG.info("request %s cancelled in queue", req.trace.id)
                 continue
             if req.embeds is None:
@@ -985,12 +1111,13 @@ class ContinuousScheduler:
                         # episode.
                         self.anomaly.observe_queue_depth(depth)
                     msg = f"{type(e).__name__}: {e}"
+                    cost = self._finalize_cost(None, req)
                     req.handle.error = msg
                     if isinstance(e, ValueError):
                         req.handle.error_kind = "invalid_request"
                     req.handle.events.put(("error", msg))
                     req.handle.done.set()
-                    req.trace.finish(error=msg)
+                    req.trace.finish(error=msg, cost=cost)
                     _LOG.info(
                         "request %s rejected at admission: %s",
                         req.trace.id, msg,
@@ -1031,6 +1158,10 @@ class ContinuousScheduler:
         then waits). At least one suffix token always remains to
         prefill: the admission needs the next-token logit."""
         ps = self.page_size
+        # Page-seconds accrual starts the moment this placement can
+        # hold pages (held is 0 until the splice/grow below succeeds,
+        # so a False return leaves the integral untouched).
+        req.pages_t = time.monotonic()
         spliced = 0
         matched, pages = 0, []
         cache_on = (
@@ -1090,6 +1221,7 @@ class ContinuousScheduler:
         self.metrics.inc(
             "prefix_cache_miss_tokens_total", req.length - spliced
         )
+        req.cost_cached_tokens += spliced
         return True
 
     def _place(self, s: int, req: _Request) -> None:
@@ -1135,8 +1267,9 @@ class ContinuousScheduler:
                 # now. Same invariant as the mid-decode cancel in
                 # _advance.
                 self.metrics.inc("cancelled")
+                cost = self._finalize_cost(s, req)
                 self._clear_slot(s)
-                req.trace.finish(cancelled=True)
+                req.trace.finish(cancelled=True, cost=cost)
                 _LOG.info(
                     "request %s cancelled mid-prefill", req.trace.id
                 )
@@ -1194,6 +1327,7 @@ class ContinuousScheduler:
         req.trace.end(pf)
         self.kv_pages = kv
         req.prefill_pos = end
+        req.cost_prefill_tokens += end - off
         self.metrics.inc("prefill_tokens_total", end - off)
         self.metrics.observe(
             "prefill_chunk_tokens", end - off,
@@ -1390,6 +1524,12 @@ class ContinuousScheduler:
                 "decode_chunk", t0_ns, int(dt * 1e9),
                 chunk=self.chunks_run, slot=s,
             )
+            # Ledger: the device ran `chunk` steps for this row whether
+            # or not the host kept them (replay skips are still cost);
+            # the per-chunk accrual keeps page-seconds refcount samples
+            # fresh while neighbors splice and release shared pages.
+            req.cost_decode_steps += self.chunk
+            self._accrue_page_seconds(s)
             useful += self._advance(s, [int(t) for t in toks[s]])
         total = self.num_slots * self.chunk
         self.metrics.inc("decode_steps_total", total)
@@ -1426,8 +1566,9 @@ class ContinuousScheduler:
         useful = 0
         if req.handle.cancelled:
             self.metrics.inc("cancelled")
+            cost = self._finalize_cost(s, req)
             self._clear_slot(s)
-            req.trace.finish(cancelled=True)
+            req.trace.finish(cancelled=True, cost=cost)
             _LOG.info("request %s cancelled mid-decode", req.trace.id)
             return useful
         chunk_start = len(req.emitted)
@@ -1488,6 +1629,7 @@ class ContinuousScheduler:
 
     def _finish(self, s: int, reason: str, completion: int) -> None:
         req = self.slots[s]
+        cost = self._finalize_cost(s, req)
         # Donate the full-page prefix of prompt + reply before the
         # slot's references go: the cache's own share keeps the pages
         # alive, so the NEXT turn of this conversation (whose prompt
@@ -1509,7 +1651,7 @@ class ContinuousScheduler:
         req.handle.done.set()
         req.trace.finish(
             finish_reason=reason, prompt_tokens=req.length,
-            completion_tokens=completion,
+            completion_tokens=completion, cost=cost,
         )
         _LOG.info(
             "request %s finished (%s, %d tokens)",
@@ -1521,10 +1663,11 @@ class ContinuousScheduler:
         self, s: int, msg: str, *, kind: str = "server_error"
     ) -> None:
         req = self.slots[s]
+        cost = self._finalize_cost(s, req)
         self._clear_slot(s)
         req.handle.error = msg
         req.handle.error_kind = kind
         req.handle.events.put(("error", msg))
         req.handle.done.set()
-        req.trace.finish(error=msg)
+        req.trace.finish(error=msg, cost=cost)
         _LOG.info("request %s errored: %s", req.trace.id, msg)

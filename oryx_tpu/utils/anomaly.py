@@ -32,6 +32,10 @@ Event schema (one JSON object per line)::
      "message": str, "value": float, "threshold": float,
      "context": {...}}        # step / request_id / window median ...
 
+The sink is size-capped: past ``events_max_bytes`` the file rolls to
+``events.jsonl.1`` (one rotation generation kept) so a long-lived
+server can never fill a disk with anomaly history.
+
 Policy is the CALLER's job: the trainer raises ``AnomalyHalt`` under
 ``--on-anomaly=halt``; serving only counts and logs (a serving SLO
 breach is load, not corruption — you never want the server to kill
@@ -140,19 +144,28 @@ class AnomalyMonitor:
         events_path: str | None = None,
         registry=None,
         keep: int = 256,
+        events_max_bytes: int = 16 * 1024 * 1024,
     ):
         self.source = source
         self.thresholds = thresholds or AnomalyThresholds()
-        self.events_path = events_path
+        # Size-capped rotation: a long-lived server's sink must not
+        # grow without bound. When the file crosses events_max_bytes
+        # the current file rolls to `<events_path>.1` (replacing the
+        # previous roll) and a fresh file starts — one generation of
+        # history survives, disk usage stays <= ~2x the cap. 0 disables
+        # rotation.
+        self.events_path = (
+            os.path.abspath(events_path) if events_path else None
+        )
+        self.events_max_bytes = events_max_bytes
         self.recent: deque[AnomalyEvent] = deque(maxlen=keep)
         self.counts: dict[str, int] = {}
         self.total = 0
         self._lock = threading.Lock()
         self._f = None
-        if events_path:
-            d = os.path.dirname(os.path.abspath(events_path))
-            os.makedirs(d, exist_ok=True)
-            self._f = open(events_path, "a")
+        if self.events_path:
+            os.makedirs(os.path.dirname(self.events_path), exist_ok=True)
+            self._f = open(self.events_path, "a")
         # The shared cross-registry family: oryx_anomaly_total{kind=}.
         # raw_name — deliberately NOT prefixed, so the train and serve
         # exporters publish the same series name and one Prometheus
@@ -186,6 +199,17 @@ class AnomalyMonitor:
             if self._f is not None:
                 self._f.write(json.dumps(ev.to_dict()) + "\n")
                 self._f.flush()
+                if (
+                    self.events_max_bytes
+                    and self._f.tell() >= self.events_max_bytes
+                ):
+                    # Rotate AFTER the write that crossed the cap: the
+                    # live file is always a complete JSONL (never a
+                    # torn line), and the event that triggered the roll
+                    # lands in `.1` with its episode-mates.
+                    self._f.close()
+                    os.replace(self.events_path, self.events_path + ".1")
+                    self._f = open(self.events_path, "a")
         if self._counter is not None:
             self._counter.labels(kind=kind).inc()
         _LOG.warning("anomaly[%s] %s: %s", self.source, kind, message)

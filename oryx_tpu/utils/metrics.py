@@ -428,6 +428,110 @@ PER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 PREFILL_CHUNK_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                          256.0, 512.0, 1024.0, 2048.0, 4096.0)
 
+# Per-request cost-ledger ladders (the `oryx_serving_request_*` families
+# the continuous scheduler observes when a request reaches any terminal
+# state; docs/OBSERVABILITY.md "Capacity & load testing"). Token counts
+# run in powers of two to past the context ceiling; page-seconds — the
+# pages-held x wall-time integral, the real HBM currency — spans a
+# sub-chunk hold through minutes-long residency.
+REQUEST_TOKEN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                         256.0, 512.0, 1024.0, 2048.0, 4096.0, 8192.0)
+REQUEST_SECONDS_BUCKETS = TTFT_BUCKETS + (120.0, 300.0)
+PAGE_SECONDS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                        5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+# The canonical per-request cost-ledger keys: what the scheduler writes
+# into handle.debug["cost"] / the trace meta at every terminal state,
+# what the final SSE chunk carries under "oryx", and what the capacity
+# harness (scripts/loadgen.py) asserts is complete for every finished
+# request in /debug/requests.
+REQUEST_COST_KEYS = (
+    "prefill_tokens", "cached_tokens", "decode_steps", "page_seconds",
+    "queue_s", "prefill_s", "decode_s", "e2e_s",
+)
+
+
+# ---------------------------------------------------------------------------
+# Quantile helpers (shared by the loadgen report, the serving-endpoint
+# CI gate, and tests — one implementation of the bucket math)
+# ---------------------------------------------------------------------------
+
+
+def histogram_quantile(q: float, buckets: tuple[float, ...] | list[float],
+                       counts: list[int],
+                       total: int | None = None) -> float:
+    """Quantile from a cumulative-`le` histogram (Prometheus shape).
+
+    `buckets` are the finite upper bounds in ascending order; `counts`
+    the CUMULATIVE observation count at each bound (the `_bucket`
+    series); `total` the +Inf count (defaults to the last cumulative
+    count). Linear interpolation inside the covering bucket, with the
+    first bucket's lower edge at 0; ranks past the last finite bound
+    clamp to that bound (the Prometheus `histogram_quantile`
+    convention). Returns NaN for an empty histogram."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    n = total if total is not None else (counts[-1] if counts else 0)
+    if n <= 0 or not buckets:
+        return float("nan")
+    rank = q * n
+    prev_bound, prev_count = 0.0, 0
+    for b, c in zip(buckets, counts):
+        if c >= rank and c > prev_count:
+            frac = (rank - prev_count) / (c - prev_count)
+            return prev_bound + (float(b) - prev_bound) * frac
+        prev_bound, prev_count = float(b), c
+    return float(buckets[-1])
+
+
+def parse_prom_histogram(
+    text: str, family: str
+) -> tuple[list[float], list[int], int, float] | None:
+    """Extract one UNLABELED histogram family from a Prometheus text
+    exposition: (finite bounds, cumulative counts, total count, sum).
+    Returns None when the family has no bucket lines. Feed the result
+    to `histogram_quantile` (two scrapes subtract element-wise for a
+    windowed quantile)."""
+    import re
+
+    bounds: list[float] = []
+    counts: list[int] = []
+    total = 0
+    for m in re.finditer(
+        rf'^{re.escape(family)}_bucket\{{le="([^"]+)"\}} (\d+)$',
+        text, re.M,
+    ):
+        le, c = m.group(1), int(m.group(2))
+        if le == "+Inf":
+            total = c
+        else:
+            bounds.append(float(le))
+            counts.append(c)
+    if not bounds and total == 0:
+        return None
+    s = 0.0
+    if sm := re.search(
+        rf"^{re.escape(family)}_sum ([0-9.eE+-]+)$", text, re.M
+    ):
+        s = float(sm.group(1))
+    return bounds, counts, total, s
+
+
+def sample_quantile(values: list[float], q: float) -> float:
+    """Exact quantile of raw samples: linear interpolation between
+    order statistics. NaN on an empty list."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    if not values:
+        return float("nan")
+    vs = sorted(values)
+    if len(vs) == 1:
+        return float(vs[0])
+    pos = q * (len(vs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    return float(vs[lo] + (vs[hi] - vs[lo]) * (pos - lo))
+
 
 # ---------------------------------------------------------------------------
 # Collectors (process / runtime / device memory)

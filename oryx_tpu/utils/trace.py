@@ -179,6 +179,20 @@ class Trace:
             self.end_ns = t
             self.done = True
 
+    def span_seconds(self) -> dict[str, float]:
+        """Total recorded duration per span NAME, in seconds (open
+        spans count up to now). The scheduler's cost ledger reads its
+        queue/prefill/decode wall-time attribution from here instead of
+        keeping parallel stopwatches."""
+        t = now_ns()
+        with self._lock:
+            out: dict[str, float] = {}
+            for s in self.spans:
+                d = s.dur_ns if s.dur_ns is not None \
+                    else max(0, t - s.start_ns)
+                out[s.name] = out.get(s.name, 0.0) + d / 1e9
+            return out
+
     # ---- serialization ---------------------------------------------------
 
     def summary(self) -> dict[str, Any]:

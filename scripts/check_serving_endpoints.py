@@ -14,8 +14,15 @@ pushes one request through it, then checks:
     one deliberate exception), the build_info gauge is present with
     revision + engine labels, and the HBM gauges exist;
   * GET /debug/requests — valid JSON, the request we sent is recorded;
+    ?limit= bounds the response, ?state=done returns only finished
+    requests and every one carries a COMPLETE per-request cost ledger
+    (utils/metrics.REQUEST_COST_KEYS), a bogus state is a 400;
   * GET /debug/trace?id= — valid Chrome trace JSON with a non-empty
     traceEvents list covering prefill and decode;
+  * the TTFT histogram read back through the SHARED quantile helpers
+    (utils/metrics.parse_prom_histogram + histogram_quantile — the
+    same math scripts/loadgen.py reports with): finite, positive,
+    ordered p50 <= p99;
   * prefix cache under a shared-prefix burst — after several requests
     carrying one long system prompt, the
     `oryx_serving_prefix_cache_{hit,miss}_tokens_total` counters,
@@ -34,6 +41,7 @@ import os
 import re
 import sys
 import threading
+import urllib.error
 import urllib.request
 
 sys.path.insert(
@@ -189,14 +197,87 @@ def main() -> None:
         if hit <= 0:
             fail("shared-prefix burst produced zero "
                  "prefix_cache_hit_tokens_total — the cache never hit")
+
+        # TTFT quantiles through the SHARED bucket-interpolation
+        # helpers (the loadgen report uses the same math): the
+        # histogram must parse and produce finite, ordered quantiles.
+        from oryx_tpu.utils.metrics import (
+            REQUEST_COST_KEYS,
+            histogram_quantile,
+            parse_prom_histogram,
+        )
+
+        hist = parse_prom_histogram(
+            metrics_text, "oryx_serving_ttft_seconds"
+        )
+        if hist is None:
+            fail("oryx_serving_ttft_seconds histogram missing")
+        bounds, counts, total, _ = hist
+        if total < 4:
+            fail(f"ttft histogram recorded {total} < 4 requests")
+        p50 = histogram_quantile(0.5, bounds, counts, total)
+        p99 = histogram_quantile(0.99, bounds, counts, total)
+        if not (0 < p50 <= p99):
+            fail(f"ttft quantiles malformed: p50={p50} p99={p99}")
+        # The per-request cost-ledger families must render (at the
+        # request count) alongside the latency ladders.
+        if not re.search(
+            r"^oryx_serving_request_page_seconds_count [1-9]",
+            metrics_text, re.M,
+        ):
+            fail("oryx_serving_request_page_seconds histogram did not "
+                 "record any finished request")
+
+        # /debug/requests filters: ?limit= bounds the response,
+        # ?state=done shows only finished requests — each carrying a
+        # complete cost ledger — and a bogus state is a 400.
+        with urllib.request.urlopen(
+            base + "/debug/requests?limit=1", timeout=30
+        ) as r:
+            lim = json.load(r)
+        if len(lim["requests"]) != 1 or lim["returned"] != 1:
+            fail(f"/debug/requests?limit=1 returned "
+                 f"{len(lim['requests'])} entries")
+        if lim["total"] < 4:
+            fail(f"/debug/requests?limit=1 total={lim['total']}, "
+                 "want >= 4 (the burst flowed through the recorder)")
+        with urllib.request.urlopen(
+            base + "/debug/requests?state=done", timeout=30
+        ) as r:
+            done = json.load(r)
+        if not done["requests"]:
+            fail("/debug/requests?state=done is empty after the burst")
+        for rec in done["requests"]:
+            if not rec["done"]:
+                fail(f"?state=done returned in-flight request "
+                     f"{rec['id']}")
+            cost = (rec.get("meta") or {}).get("cost")
+            missing = [
+                k for k in REQUEST_COST_KEYS
+                if not isinstance(cost, dict) or k not in cost
+            ]
+            if missing:
+                fail(f"finished request {rec['id']} cost ledger "
+                     f"missing {missing}")
+        try:
+            with urllib.request.urlopen(
+                base + "/debug/requests?state=bogus", timeout=30
+            ) as r:
+                fail("/debug/requests?state=bogus did not 400")
+        except urllib.error.HTTPError as e:
+            if e.code != 400:
+                fail(f"/debug/requests?state=bogus -> {e.code}, "
+                     "want 400")
+            e.close()
     finally:
         if srv.scheduler is not None:
             srv.scheduler.close()
         srv.shutdown()
     print("serving endpoints OK: /healthz + /readyz + /metrics "
           "(content-type, prefix, build_info, hbm gauges) + "
-          "/debug/requests + /debug/trace + prefix-cache family "
-          "under a shared-prefix burst")
+          "/debug/requests (+ limit/state filters, cost ledger) + "
+          "/debug/trace + prefix-cache family under a shared-prefix "
+          "burst + ttft quantiles via the shared histogram helper")
 
 
 if __name__ == "__main__":

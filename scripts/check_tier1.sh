@@ -10,14 +10,14 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 455 = the 385 recorded at PR 5 plus the fault-injection/containment
-# suites added in PR 6 (faults registry, retry/backoff, serving
-# containment — deadlines, backpressure, degraded ladder, crash
-# replay, drain, disconnect, allocator failure schedules — trainer
-# faults incl. the bit-identical auto-resume, swallowed-exception lint
-# fixtures; 474 observed with a warm /tmp/jax_cache), with headroom
-# for load-dependent flakes (bench-supervisor probes on one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-455}
+# 470 = the 455 recorded at PR 6 plus the capacity-harness/cost-ledger
+# suites added in PR 7 (histogram-quantile helpers, concurrent-scrape
+# torn-line checks, events.jsonl rotation, /debug/requests filters,
+# per-request cost ledger incl. eviction-replay accounting, loadgen
+# arrival/knee/schema/gate units + a live single-stage sweep; 497
+# observed with a warm /tmp/jax_cache), with headroom for
+# load-dependent flakes (bench-supervisor probes on one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-470}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -79,6 +79,19 @@ echo "checking failure containment (chaos_suite.py)"
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/chaos_suite.py; then
     echo "CHAOS SUITE FAILED (a fault escaped containment)" >&2
+    exit 1
+fi
+
+# --- open-loop capacity harness ----------------------------------------------
+# Seeded Poisson sweep against a self-booted tiny continuous-engine
+# server with the SLO detectors armed: the report must be schema-valid,
+# a saturation knee must exist, zero ttft_slo/queue_depth_slo firings
+# at/below the knee, and every finished request must carry a complete
+# cost ledger (prefill/cached tokens, decode steps, page-seconds).
+echo "checking capacity harness (loadgen.py --smoke)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/loadgen.py --smoke > /dev/null; then
+    echo "LOADGEN CAPACITY CHECK FAILED" >&2
     exit 1
 fi
 

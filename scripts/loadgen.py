@@ -1,0 +1,767 @@
+#!/usr/bin/env python
+"""Open-loop load/SLO capacity harness for the serving stack.
+
+Drives a live server at a controlled OFFERED load — seeded Poisson
+arrivals that do NOT wait for completions (open loop: a saturated
+server keeps receiving work at the offered rate, exactly the regime
+where closed-loop benchmarks lie) — across a sweep of rates, and
+reports what capacity actually is:
+
+  * client-measured p50/p95/p99 TTFT and per-token latency per stage
+    (streaming SSE requests; TTFT = first content delta);
+  * goodput: tokens/s from requests that completed WITHIN the SLO,
+    vs offered load — the curve whose flattening is saturation;
+  * the saturation knee: the highest offered load whose stage still
+    met the SLO for >= --knee-good-frac of its requests (every stage
+    past it is saturated);
+  * error breakdown (429 backpressure / 503 unavailable / 504
+    deadline / transport);
+  * per-stage deltas of the server's own SLO anomaly detectors
+    (oryx_anomaly_total{kind="ttft_slo"|"queue_depth_slo"}) — the
+    pass/fail gate: ZERO firings at or below the knee;
+  * per-request cost attribution from the scheduler's ledger (final
+    SSE metadata): prefill vs prefix-cache-spliced tokens, decode
+    steps, and page-seconds (pages-held x time, the HBM currency).
+
+Workload shape: prompt and output lengths are drawn per-request from
+small mixed distributions, and --shared-prefix-frac of requests carry
+one of --shared-prefix-count long shared system prompts so the sweep
+exercises the TokenTrie prefix cache like real traffic does.
+
+Everything client-side is stdlib (urllib + threading + random); the
+histogram math comes from the shared helpers in oryx_tpu.utils.metrics
+(the same bucket interpolation scripts/check_serving_endpoints.py
+uses).
+
+    # against a live server
+    python scripts/loadgen.py --base-url http://127.0.0.1:8000 \
+        --rates 1,2,4,8,16 --duration 30 --slo-ttft 2.0 --gate
+
+    # CI smoke: boots a tiny CPU server in-process, short sweep,
+    # SLO-detector gate + report schema check + cost-ledger audit
+    JAX_PLATFORMS=cpu python scripts/loadgen.py --smoke
+
+Writes BENCH_loadgen.json (see docs/OBSERVABILITY.md "Capacity & load
+testing" for how to read the knee and the goodput curve).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+ANOMALY_KINDS = ("ttft_slo", "queue_depth_slo")
+
+WORDS = (
+    "capacity goodput latency saturation paged prefill decode cache "
+    "page token slot queue chunk splice replay admit evict serve"
+).split()
+
+
+# ---------------------------------------------------------------------------
+# Workload synthesis (all draws from one seeded Random -> the schedule
+# and every request body are reproducible)
+# ---------------------------------------------------------------------------
+
+
+def poisson_arrivals(rng: random.Random, rate: float,
+                     duration: float) -> list[float]:
+    """Open-loop arrival offsets in [0, duration): exponential
+    inter-arrival times at `rate` req/s. Always at least one arrival
+    (a stage that sends nothing measures nothing)."""
+    out: list[float] = []
+    t = rng.expovariate(rate)
+    while t < duration:
+        out.append(t)
+        t += rng.expovariate(rate)
+    return out or [0.0]
+
+
+def filler_text(rng: random.Random, chars: int) -> str:
+    words = []
+    n = 0
+    while n < chars:
+        w = rng.choice(WORDS)
+        words.append(w)
+        n += len(w) + 1
+    return " ".join(words)[:chars]
+
+
+def build_body(rng: random.Random, cfg: dict) -> dict:
+    """One request body: sampled prompt/output lengths, a shared
+    system prefix with probability shared_prefix_frac (exercises the
+    prefix cache), streaming with usage so the client can count tokens
+    and read the final cost metadata."""
+    messages = []
+    if cfg["shared_prefixes"] and rng.random() < cfg["shared_prefix_frac"]:
+        messages.append({
+            "role": "system",
+            "content": rng.choice(cfg["shared_prefixes"]),
+        })
+    chars = rng.choice(cfg["prompt_chars_choices"])
+    messages.append({
+        "role": "user",
+        "content": f"q{rng.randrange(1_000_000)}: "
+                   + filler_text(rng, chars),
+    })
+    return {
+        "messages": messages,
+        "max_tokens": rng.choice(cfg["max_tokens_choices"]),
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+
+
+# ---------------------------------------------------------------------------
+# SSE client
+# ---------------------------------------------------------------------------
+
+
+def send_stream(base: str, body: dict, timeout: float) -> dict:
+    """POST one streaming completion; returns the client-side record:
+    status, ttft_s (first content delta), per_token_s, completion
+    token count (from the usage chunk), the server's cost ledger
+    (from the final chunk's "oryx" metadata) and an error class."""
+    rec: dict = {
+        "status": None, "ok": False, "ttft_s": None, "per_token_s": None,
+        "e2e_s": None, "tokens": 0, "cost": None, "error": None,
+    }
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        base + "/v1/chat/completions", data=data,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.monotonic()
+    t_first = t_last = None
+    finished = False
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            rec["status"] = r.status
+            for raw in r:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                obj = json.loads(payload)
+                if "error" in obj:
+                    rec["error"] = "stream_error"
+                    break
+                now = time.monotonic()
+                choices = obj.get("choices") or []
+                if choices:
+                    if choices[0].get("delta", {}).get("content"):
+                        if t_first is None:
+                            t_first = now
+                            rec["ttft_s"] = now - t0
+                        t_last = now
+                    if choices[0].get("finish_reason"):
+                        finished = True
+                if obj.get("usage"):
+                    rec["tokens"] = int(
+                        obj["usage"].get("completion_tokens", 0)
+                    )
+                if isinstance(obj.get("oryx"), dict):
+                    rec["cost"] = obj["oryx"].get("cost")
+    except urllib.error.HTTPError as e:
+        rec["status"] = e.code
+        rec["error"] = str(e.code)
+        e.close()
+        rec["e2e_s"] = time.monotonic() - t0
+        return rec
+    except Exception:
+        rec["error"] = "transport"
+        rec["e2e_s"] = time.monotonic() - t0
+        return rec
+    rec["e2e_s"] = time.monotonic() - t0
+    rec["ok"] = rec["error"] is None and finished
+    if (
+        rec["ok"] and rec["tokens"] > 1
+        and t_first is not None and t_last is not None and t_last > t_first
+    ):
+        rec["per_token_s"] = (t_last - t_first) / (rec["tokens"] - 1)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Server-side scrapes
+# ---------------------------------------------------------------------------
+
+
+def scrape_metrics(base: str, timeout: float = 30.0) -> str:
+    with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def anomaly_counts(text: str) -> dict[str, float]:
+    out = {}
+    for kind in ANOMALY_KINDS:
+        m = re.search(
+            rf'^oryx_anomaly_total\{{kind="{kind}"\}} ([0-9.e+-]+)$',
+            text, re.M,
+        )
+        out[kind] = float(m.group(1)) if m else 0.0
+    return out
+
+
+def server_hist_quantiles(
+    m0: str, m1: str, family: str, qs: tuple[float, ...] = (0.5, 0.99)
+) -> dict[str, float | None]:
+    """Windowed quantiles of a server histogram across one stage: the
+    element-wise DELTA of two cumulative scrapes is itself a valid
+    cumulative histogram, fed to the shared bucket-interpolation
+    helper."""
+    from oryx_tpu.utils.metrics import histogram_quantile, \
+        parse_prom_histogram
+
+    h0, h1 = parse_prom_histogram(m0, family), parse_prom_histogram(m1, family)
+    out: dict[str, float | None] = {}
+    if h0 is None or h1 is None or h0[0] != h1[0]:
+        return {f"p{int(q * 100)}": None for q in qs}
+    bounds = h1[0]
+    counts = [b - a for a, b in zip(h0[1], h1[1])]
+    total = h1[2] - h0[2]
+    for q in qs:
+        v = histogram_quantile(q, bounds, counts, total)
+        out[f"p{int(q * 100)}"] = None if v != v else round(v, 6)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stage runner + aggregation
+# ---------------------------------------------------------------------------
+
+
+def _dist(values: list[float]) -> dict:
+    from oryx_tpu.utils.metrics import sample_quantile
+
+    if not values:
+        return {"n": 0, "p50": None, "p95": None, "p99": None,
+                "mean": None, "max": None}
+    return {
+        "n": len(values),
+        "p50": round(sample_quantile(values, 0.5), 6),
+        "p95": round(sample_quantile(values, 0.95), 6),
+        "p99": round(sample_quantile(values, 0.99), 6),
+        "mean": round(sum(values) / len(values), 6),
+        "max": round(max(values), 6),
+    }
+
+
+def aggregate_stage(rate: float, duration: float, results: list[dict],
+                    hung: int, m0: str, m1: str, slo_ttft: float,
+                    slo_per_token: float | None) -> dict:
+    """One stage's record for the report. Goodput divides by the
+    ARRIVAL window (`duration`), not the drain: open-loop capacity is
+    tokens served per second of offered-load time. A hung request
+    (worker still blocked past the drain, so it never appended a
+    record) counts in `sent` and against `slo_good_frac` — offered
+    traffic that never completed is the OPPOSITE of healthy and must
+    not inflate the fraction the knee is found on."""
+    ok = [r for r in results if r["ok"]]
+    good = [
+        r for r in ok
+        if r["ttft_s"] is not None and r["ttft_s"] <= slo_ttft
+        and (
+            slo_per_token is None or r["per_token_s"] is None
+            or r["per_token_s"] <= slo_per_token
+        )
+    ]
+    errors = {"429": 0, "503": 0, "504": 0, "other_http": 0,
+              "transport": 0, "stream_error": 0,
+              "harness_inflight_cap": 0}
+    for r in results:
+        e = r["error"]
+        if e is None:
+            continue
+        if e in ("429", "503", "504"):
+            errors[e] += 1
+        elif e in ("transport", "stream_error", "harness_inflight_cap"):
+            # harness_inflight_cap is a HARNESS-side shed, not a
+            # server response — bucketing it as HTTP would blame the
+            # server for load the generator never sent.
+            errors[e] += 1
+        else:
+            errors["other_http"] += 1
+    a0, a1 = anomaly_counts(m0), anomaly_counts(m1)
+    costs = [r["cost"] for r in results if r["cost"]]
+    prefill = sum(c["prefill_tokens"] for c in costs)
+    cached = sum(c["cached_tokens"] for c in costs)
+    page_s = sum(c["page_seconds"] for c in costs)
+    goodput = sum(r["tokens"] for r in good) / duration
+    sent = len(results) + hung
+    return {
+        "offered_rps": rate,
+        "sent": sent,
+        "ok": len(ok),
+        "good": len(good),
+        "hung": hung,
+        "slo_good_frac": round(len(good) / max(1, sent), 4),
+        "goodput_tps": round(goodput, 3),
+        "completed_tps": round(
+            sum(r["tokens"] for r in ok) / duration, 3
+        ),
+        "ttft_s": _dist([
+            r["ttft_s"] for r in results if r["ttft_s"] is not None
+        ]),
+        "per_token_s": _dist([
+            r["per_token_s"] for r in results
+            if r["per_token_s"] is not None
+        ]),
+        "server_ttft_s": server_hist_quantiles(
+            m0, m1, "oryx_serving_ttft_seconds"
+        ),
+        "errors": errors,
+        "anomalies": {
+            k: a1[k] - a0.get(k, 0.0) for k in ANOMALY_KINDS
+        },
+        "cost": {
+            "requests_with_cost": len(costs),
+            "prefill_tokens": prefill,
+            "cached_tokens": cached,
+            "cache_hit_frac": round(
+                cached / max(1, prefill + cached), 4
+            ),
+            "decode_steps": sum(c["decode_steps"] for c in costs),
+            "page_seconds": round(page_s, 3),
+            "mean_page_seconds": round(page_s / max(1, len(costs)), 6),
+            "goodput_tokens_per_page_second": round(
+                goodput * duration / page_s, 3
+            ) if page_s > 0 else None,
+        },
+    }
+
+
+def run_stage(base: str, rate: float, cfg: dict,
+              rng: random.Random,
+              carryover: list | None = None) -> dict:
+    """Run one open-loop stage at `rate` req/s: the dispatcher sleeps
+    to each pre-drawn arrival time and fires a daemon thread per
+    request — completions never gate arrivals. A bounded in-flight cap
+    (way above anything a healthy stage reaches) keeps a wedged server
+    from accumulating threads without limit; capped sends are recorded
+    as harness errors, never silently dropped. `carryover` is the
+    cross-stage straggler registry: threads still blocked from EARLIER
+    stages count against the cap too (pass the same list to every
+    stage of a sweep), otherwise a wedged server accumulates up to
+    max_inflight threads PER STAGE."""
+    duration = cfg["duration"]
+    arrivals = poisson_arrivals(rng, rate, duration)
+    bodies = [build_body(rng, cfg) for _ in arrivals]
+    results: list[dict] = []
+    lock = threading.Lock()
+    threads: list[threading.Thread] = []
+    carry = carryover if carryover is not None else []
+    carry[:] = [t for t in carry if t.is_alive()]
+
+    def worker(body: dict) -> None:
+        rec = send_stream(base, body, cfg["request_timeout"])
+        with lock:
+            results.append(rec)
+
+    m0 = scrape_metrics(base)
+    t0 = time.monotonic()
+    for off, body in zip(arrivals, bodies):
+        delay = t0 + off - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        live = sum(t.is_alive() for t in threads) + sum(
+            t.is_alive() for t in carry
+        )
+        if live >= cfg["max_inflight"]:
+            with lock:
+                results.append({
+                    "status": None, "ok": False, "ttft_s": None,
+                    "per_token_s": None, "e2e_s": None, "tokens": 0,
+                    "cost": None, "error": "harness_inflight_cap",
+                })
+            continue
+        t = threading.Thread(target=worker, args=(body,), daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + cfg["drain_s"]
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    hung = sum(t.is_alive() for t in threads)
+    carry.extend(t for t in threads if t.is_alive())
+    m1 = scrape_metrics(base)
+    with lock:
+        # Snapshot: hung daemon workers may still append after the
+        # drain; aggregation must see one consistent list.
+        snapshot = list(results)
+    return aggregate_stage(
+        rate, duration, snapshot, hung, m0, m1,
+        cfg["slo_ttft"], cfg["slo_per_token"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Knee + report schema + gate
+# ---------------------------------------------------------------------------
+
+
+def find_knee(stages: list[dict], good_frac: float = 0.9) -> dict | None:
+    """The saturation knee: the highest offered load whose stage still
+    met the SLO for >= good_frac of its requests, with every
+    lower-load stage healthy too (prefix property — a sick low-load
+    stage caps the knee below it). None = saturated at the lowest
+    offered load."""
+    knee = None
+    for i, st in enumerate(stages):
+        if st["sent"] > 0 and st["slo_good_frac"] >= good_frac:
+            knee = i
+        else:
+            break
+    if knee is None:
+        return None
+    st = stages[knee]
+    return {
+        "index": knee,
+        "offered_rps": st["offered_rps"],
+        "goodput_tps": st["goodput_tps"],
+        "saturated": knee < len(stages) - 1,
+    }
+
+
+_STAGE_KEYS = (
+    "offered_rps", "sent", "ok", "good", "slo_good_frac", "goodput_tps",
+    "completed_tps", "ttft_s", "per_token_s", "server_ttft_s", "errors",
+    "anomalies", "cost",
+)
+
+
+def validate_report(report: dict) -> list[str]:
+    """Schema well-formedness: the shape downstream tooling (CI gates,
+    dashboards diffing BENCH_loadgen.json across PRs) depends on.
+    Returns problems, [] when clean."""
+    probs = []
+    for k in ("bench", "config", "stages", "knee", "gate"):
+        if k not in report:
+            probs.append(f"missing top-level key {k!r}")
+    if report.get("bench") != "loadgen":
+        probs.append("bench != 'loadgen'")
+    stages = report.get("stages") or []
+    if not stages:
+        probs.append("no stages")
+    for i, st in enumerate(stages):
+        for k in _STAGE_KEYS:
+            if k not in st:
+                probs.append(f"stage {i} missing {k!r}")
+        for k in ("p50", "p95", "p99"):
+            if k not in (st.get("ttft_s") or {}):
+                probs.append(f"stage {i} ttft_s missing {k!r}")
+            if k not in (st.get("per_token_s") or {}):
+                probs.append(f"stage {i} per_token_s missing {k!r}")
+        for k in ANOMALY_KINDS:
+            if k not in (st.get("anomalies") or {}):
+                probs.append(f"stage {i} anomalies missing {k!r}")
+        for k in ("429", "503", "504", "transport"):
+            if k not in (st.get("errors") or {}):
+                probs.append(f"stage {i} errors missing {k!r}")
+    knee = report.get("knee")
+    if knee is not None and not isinstance(knee, dict):
+        probs.append("knee is neither null nor an object")
+    if isinstance(knee, dict):
+        for k in ("index", "offered_rps", "goodput_tps", "saturated"):
+            if k not in knee:
+                probs.append(f"knee missing {k!r}")
+    return probs
+
+
+def check_cost_ledger(base: str) -> list[str]:
+    """Every finished request in the flight recorder must carry a
+    COMPLETE cost ledger (the acceptance bar for the per-request
+    attribution path). The key list is the scheduler's own contract
+    (utils/metrics.REQUEST_COST_KEYS) — one source of truth."""
+    from oryx_tpu.utils.metrics import REQUEST_COST_KEYS
+
+    with urllib.request.urlopen(
+        base + "/debug/requests?state=done", timeout=30
+    ) as r:
+        body = json.load(r)
+    if body.get("engine") != "continuous":
+        # The window batcher has no cost ledger (or SLO detectors):
+        # one clear reason beats N "missing every key" lines.
+        return [
+            "cost-ledger audit requires --engine continuous (server "
+            f"reports engine={body.get('engine')!r})"
+        ]
+    reqs = body.get("requests", [])
+    if not reqs:
+        return ["no finished requests in /debug/requests?state=done"]
+    probs = []
+    for rec in reqs:
+        cost = (rec.get("meta") or {}).get("cost")
+        missing = [
+            k for k in REQUEST_COST_KEYS
+            if not isinstance(cost, dict) or k not in cost
+        ]
+        if missing:
+            probs.append(
+                f"request {rec.get('id')}: cost ledger missing {missing}"
+            )
+    return probs
+
+
+def evaluate_gate(report: dict, *, ledger_problems: list[str]) -> dict:
+    """Pass/fail: schema valid, a knee exists, and ZERO SLO-detector
+    firings (and zero hung/transport casualties) at or below it."""
+    reasons = list(validate_report(report))
+    reasons += ledger_problems
+    knee = report.get("knee")
+    if knee is None:
+        reasons.append(
+            "saturated at the lowest offered load (no knee found)"
+        )
+    else:
+        for st in report["stages"][: knee["index"] + 1]:
+            fired = sum(st["anomalies"].values())
+            if fired:
+                reasons.append(
+                    f"{fired:g} SLO-detector firing(s) at offered "
+                    f"{st['offered_rps']:g} rps (at/below the knee)"
+                )
+            capped = st["errors"].get("harness_inflight_cap", 0)
+            if st["hung"] or st["errors"]["transport"] or capped:
+                reasons.append(
+                    f"{st['hung']} hung / "
+                    f"{st['errors']['transport']} transport-failed / "
+                    f"{capped} harness-capped request(s) at offered "
+                    f"{st['offered_rps']:g} rps (at/below the knee)"
+                )
+    return {"passed": not reasons, "reasons": reasons}
+
+
+# ---------------------------------------------------------------------------
+# Self-boot tiny server (smoke / no --base-url)
+# ---------------------------------------------------------------------------
+
+
+class _CharTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def boot_tiny_server(args):
+    """In-process tiny-geometry continuous-engine server with the SLO
+    detectors ARMED (they are the gate). Returns (srv, base_url)."""
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve import api_server
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_CharTokenizer(), params, cfg)
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32,
+        ttft_slo=args.server_ttft_slo,
+        queue_depth_slo=args.server_queue_depth_slo,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def warmup(base: str, cfg: dict, rng: random.Random) -> None:
+    """Compile the prefill buckets the sweep will hit BEFORE measuring
+    — first-touch XLA compiles belong to deployment, not to the
+    latency distribution a capacity claim rests on."""
+    seen = set()
+    for shared in (False, True):
+        for chars in cfg["prompt_chars_choices"]:
+            key = (shared, chars)
+            if key in seen:
+                continue
+            seen.add(key)
+            body = {
+                "messages": (
+                    [{"role": "system",
+                      "content": cfg["shared_prefixes"][0]}]
+                    if shared and cfg["shared_prefixes"] else []
+                ) + [{
+                    "role": "user",
+                    "content": "warmup: " + filler_text(rng, chars),
+                }],
+                "max_tokens": max(cfg["max_tokens_choices"]),
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            }
+            send_stream(base, body, cfg["request_timeout"])
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+
+def run(argv=None) -> dict:
+    ap = argparse.ArgumentParser(
+        description="open-loop load/SLO capacity harness "
+        "(see module docstring)"
+    )
+    ap.add_argument("--base-url", default=None,
+                    help="target server; omitted = boot a tiny CPU "
+                    "server in-process")
+    ap.add_argument("--rates", default="1,2,4,8",
+                    help="comma-separated offered loads (req/s), "
+                    "swept in order")
+    ap.add_argument("--duration", type=float, default=15.0,
+                    help="arrival window per stage (s)")
+    ap.add_argument("--drain-s", type=float, default=60.0,
+                    help="max wait for stragglers after each stage")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-tokens-choices", default="8,16,32")
+    ap.add_argument("--prompt-chars-choices", default="48,128")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.5,
+                    help="fraction of requests carrying a shared "
+                    "system prompt (exercises the prefix cache)")
+    ap.add_argument("--shared-prefix-count", type=int, default=2)
+    ap.add_argument("--shared-prefix-chars", type=int, default=200)
+    ap.add_argument("--slo-ttft", type=float, default=30.0,
+                    help="client goodput SLO: TTFT bound (s)")
+    ap.add_argument("--slo-per-token", type=float, default=None,
+                    help="client goodput SLO: per-token latency bound")
+    ap.add_argument("--server-ttft-slo", type=float, default=30.0,
+                    help="self-boot server's --ttft-slo (detector arm)")
+    ap.add_argument("--server-queue-depth-slo", type=int, default=16,
+                    help="self-boot server's --queue-depth-slo")
+    ap.add_argument("--knee-good-frac", type=float, default=0.9,
+                    help="a stage below the knee must meet the SLO for "
+                    "at least this request fraction")
+    ap.add_argument("--request-timeout", type=float, default=300.0)
+    ap.add_argument("--max-inflight", type=int, default=256)
+    ap.add_argument("--out", default="BENCH_loadgen.json",
+                    help="report path ('' disables). The default "
+                    "deliberately refreshes the tracked artifact: "
+                    "every PR's gate re-runs the same seeded sweep "
+                    "and commits the new capacity point, which is the "
+                    "regression-diff workflow (docs/OBSERVABILITY.md)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit nonzero when the gate fails (implied "
+                    "by --smoke)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: tiny self-boot server, short sweep, "
+                    "hard gate + schema + cost-ledger audit")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.base_url = None
+        args.rates = "1,4"
+        args.duration = 5.0
+        args.drain_s = 60.0
+        args.max_tokens_choices = "4,6"
+        args.prompt_chars_choices = "32,64"
+        args.gate = True
+
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    rng = random.Random(args.seed)
+    shared_rng = random.Random(args.seed + 1)
+    cfg = {
+        "duration": args.duration,
+        "drain_s": args.drain_s,
+        "request_timeout": args.request_timeout,
+        "max_inflight": args.max_inflight,
+        "slo_ttft": args.slo_ttft,
+        "slo_per_token": args.slo_per_token,
+        "max_tokens_choices": [
+            int(x) for x in args.max_tokens_choices.split(",")
+        ],
+        "prompt_chars_choices": [
+            int(x) for x in args.prompt_chars_choices.split(",")
+        ],
+        "shared_prefix_frac": args.shared_prefix_frac,
+        "shared_prefixes": [
+            filler_text(shared_rng, args.shared_prefix_chars)
+            for _ in range(args.shared_prefix_count)
+        ],
+    }
+
+    srv = None
+    base = args.base_url
+    self_booted = base is None
+    try:
+        if self_booted:
+            srv, base = boot_tiny_server(args)
+        warmup(base, cfg, random.Random(args.seed + 2))
+        stages = []
+        stragglers: list = []  # live threads from earlier stages
+        for rate in rates:
+            print(f"stage: offered {rate:g} req/s for "
+                  f"{args.duration:g}s ...", file=sys.stderr)
+            st = run_stage(base, rate, cfg, rng, carryover=stragglers)
+            print(
+                f"  sent={st['sent']} ok={st['ok']} "
+                f"good_frac={st['slo_good_frac']} "
+                f"goodput={st['goodput_tps']} tok/s "
+                f"ttft_p99={st['ttft_s']['p99']}", file=sys.stderr,
+            )
+            stages.append(st)
+        knee = find_knee(stages, args.knee_good_frac)
+        report = {
+            "bench": "loadgen",
+            "config": {
+                "gated": bool(args.gate),
+                "base_url": args.base_url or "self-boot tiny (cpu)",
+                "rates_rps": rates,
+                "duration_s": args.duration,
+                "seed": args.seed,
+                "slo_ttft_s": args.slo_ttft,
+                "slo_per_token_s": args.slo_per_token,
+                "knee_good_frac": args.knee_good_frac,
+                "max_tokens_choices": cfg["max_tokens_choices"],
+                "prompt_chars_choices": cfg["prompt_chars_choices"],
+                "shared_prefix_frac": args.shared_prefix_frac,
+                "shared_prefix_chars": args.shared_prefix_chars,
+                "smoke": args.smoke,
+            },
+            "stages": stages,
+            "knee": knee,
+            "gate": {},
+        }
+        # Cost-ledger audit rides the same server session (the flight
+        # recorder still holds the sweep's requests).
+        ledger_problems = check_cost_ledger(base)
+        report["gate"] = evaluate_gate(
+            report, ledger_problems=ledger_problems
+        )
+    finally:
+        if srv is not None:
+            if srv.scheduler is not None:
+                srv.scheduler.close()
+            srv.shutdown()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def main(argv=None) -> None:
+    report = run(argv)
+    print(json.dumps(report, indent=2))
+    gate = report["gate"]
+    if report["config"]["gated"] and not gate["passed"]:
+        for r in gate["reasons"]:
+            print(f"FAIL: {r}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -226,3 +226,54 @@ def test_warn_policy_keeps_training(tmp_path):
     assert math.isnan(tel.registry.get("loss"))
     assert 'oryx_anomaly_total{kind="nan_loss"} 1' in tel.registry.render()
     tel.close()
+
+
+def test_events_jsonl_size_capped_rotation(tmp_path):
+    """The sink must not grow without bound: past events_max_bytes the
+    file rolls to events.jsonl.1 and a fresh file starts. Both files
+    stay valid JSONL, the live file stays under ~cap + one event, and
+    the newest event is in the live file."""
+    path = tmp_path / "events.jsonl"
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(ttft_slo_s=1.0),
+        events_path=str(path),
+        events_max_bytes=400,
+    )
+    for i in range(20):
+        fired = mon.observe_ttft(2.0, request_id=f"req-{i:02d}")
+        assert len(fired) == 1  # re-armed below, so every breach fires
+        mon.observe_ttft(0.1)  # clear -> re-arm
+    mon.close()
+    assert mon.counts["ttft_slo"] == 20
+    rolled = tmp_path / "events.jsonl.1"
+    assert rolled.exists(), "rotation never rolled to events.jsonl.1"
+    live, old = _events(path), _events(rolled)
+    for ev in live + old:  # every surviving line is a whole event
+        assert ev["kind"] == "ttft_slo"
+    # The live file was rotated down: bounded by the cap plus at most
+    # the one event whose write crossed it.
+    assert path.stat().st_size < 400 + 300
+    assert any(
+        ev["context"]["request_id"] == "req-19" for ev in live + old
+    ), "the newest event was lost in rotation"
+    # Rotation preserves ordering: old file's events all precede the
+    # live file's.
+    if live and old:
+        assert old[-1]["time_unix_s"] <= live[0]["time_unix_s"]
+
+
+def test_events_jsonl_rotation_disabled_with_zero_cap(tmp_path):
+    path = tmp_path / "events.jsonl"
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(ttft_slo_s=1.0),
+        events_path=str(path),
+        events_max_bytes=0,
+    )
+    for _ in range(10):
+        mon.observe_ttft(2.0)
+        mon.observe_ttft(0.1)
+    mon.close()
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert len(_events(path)) == 10

@@ -941,3 +941,168 @@ def test_window_engine_request_id_and_debug(server):
     # Window engine build_info says so.
     with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
         assert 'engine="window"' in r.read().decode()
+
+
+def test_debug_requests_limit_and_state_filters(continuous_server):
+    """Satellite: /debug/requests stays usable during a load sweep —
+    ?limit= bounds the response, ?state= filters by lifecycle, bad
+    values are 400s, and finished entries carry the full cost
+    ledger."""
+    from oryx_tpu.utils.metrics import REQUEST_COST_KEYS
+
+    url, _ = continuous_server
+    for i in range(3):
+        with _post(url, {
+            "messages": [{"role": "user", "content": f"filter q {i}"}],
+            "max_tokens": 3,
+        }) as r:
+            json.load(r)
+
+    with urllib.request.urlopen(
+        url + "/debug/requests", timeout=30
+    ) as r:
+        full = json.load(r)
+    assert full["total"] == full["returned"] == len(full["requests"])
+    assert full["total"] >= 3
+
+    with urllib.request.urlopen(
+        url + "/debug/requests?limit=2", timeout=30
+    ) as r:
+        lim = json.load(r)
+    assert lim["returned"] == len(lim["requests"]) == 2
+    assert lim["total"] == full["total"]  # total counts pre-limit
+    # Newest-first order is preserved under limit.
+    assert [e["id"] for e in lim["requests"]] == [
+        e["id"] for e in full["requests"][:2]
+    ]
+
+    with urllib.request.urlopen(
+        url + "/debug/requests?state=done&limit=5", timeout=30
+    ) as r:
+        done = json.load(r)
+    assert done["requests"], "no finished requests recorded"
+    for e in done["requests"]:
+        assert e["done"] and "error" not in e["meta"]
+        cost = e["meta"].get("cost")
+        assert cost and set(REQUEST_COST_KEYS) <= set(cost), e
+
+    with urllib.request.urlopen(
+        url + "/debug/requests?state=active", timeout=30
+    ) as r:
+        active = json.load(r)
+    for e in active["requests"]:
+        assert not e["done"]
+
+    for bad in ("?state=bogus", "?limit=-1", "?limit=x"):
+        try:
+            urllib.request.urlopen(
+                url + "/debug/requests" + bad, timeout=30
+            )
+            raise AssertionError(f"{bad}: expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_cost_ledger_in_completion_and_final_sse_chunk(continuous_server):
+    """Tentpole surface: the per-request cost ledger rides the
+    non-streaming completion body and the final SSE chunk under
+    "oryx", with prefill + cached partitioning the prompt."""
+    from oryx_tpu.utils.metrics import REQUEST_COST_KEYS
+
+    url, _ = continuous_server
+    with _post(url, {
+        "messages": [{"role": "user", "content": "cost ledger body"}],
+        "max_tokens": 4,
+    }) as r:
+        out = json.load(r)
+    cost = out["oryx"]["cost"]
+    assert set(REQUEST_COST_KEYS) <= set(cost)
+    assert (
+        cost["prefill_tokens"] + cost["cached_tokens"]
+        == out["usage"]["prompt_tokens"]
+    )
+    assert cost["page_seconds"] > 0
+
+    with _post(url, {
+        "messages": [{"role": "user", "content": "cost ledger sse"}],
+        "max_tokens": 4, "stream": True,
+        "stream_options": {"include_usage": True},
+    }) as r:
+        raw = r.read().decode()
+    chunks = [
+        json.loads(l[6:]) for l in raw.splitlines()
+        if l.startswith("data: ") and l != "data: [DONE]"
+    ]
+    with_cost = [c for c in chunks if "oryx" in c]
+    assert len(with_cost) == 1
+    fin = with_cost[0]
+    # The ledger rides the FINISH chunk (the one carrying
+    # finish_reason), before any usage-totals chunk.
+    assert fin["choices"][0]["finish_reason"] is not None
+    assert set(REQUEST_COST_KEYS) <= set(fin["oryx"]["cost"])
+    assert fin["oryx"]["cost"]["decode_steps"] >= 4
+
+
+def test_concurrent_metrics_scrapes_during_load(continuous_server):
+    """Satellite: /metrics scraped in parallel WHILE the engine is
+    decoding — every exposition must be well-formed (no torn lines, no
+    duplicate families) and every histogram internally consistent
+    (cumulative buckets, +Inf == _count)."""
+    url, _ = continuous_server
+    errors: list[str] = []
+    done = threading.Event()
+
+    def client(i: int) -> None:
+        try:
+            with _post(url, {
+                "max_tokens": 6,
+                "messages": [
+                    {"role": "user", "content": f"scrape load {i}"}
+                ],
+            }) as r:
+                json.load(r)
+        except Exception as e:
+            errors.append(f"client {i}: {e!r}")
+
+    def scraper() -> None:
+        import re as re_lib
+
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(
+                    url + "/metrics", timeout=30
+                ) as r:
+                    text = r.read().decode()
+                values = _parse_prometheus(text)  # asserts line shape
+                # Histogram internal consistency within ONE scrape.
+                fams = {
+                    m.group(1)
+                    for line in text.splitlines()
+                    if (m := re_lib.match(r"^(\S+)_bucket\{", line))
+                }
+                for fam in fams:
+                    cum = [
+                        v for k, v in values.items()
+                        if k.startswith(f"{fam}_bucket{{")
+                    ]
+                    assert cum, fam
+                    inf = values[f'{fam}_bucket{{le="+Inf"}}']
+                    assert inf == values[f"{fam}_count"], fam
+                    assert max(cum) == inf, fam
+            except Exception as e:
+                errors.append(f"scraper: {e!r}")
+                return
+
+    clients = [
+        threading.Thread(target=client, args=(i,)) for i in range(4)
+    ]
+    scrapers = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in scrapers + clients:
+        t.start()
+    for t in clients:
+        t.join(timeout=600)
+    done.set()
+    for t in scrapers:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in clients + scrapers), "hung"
+    assert not errors, errors

@@ -1,0 +1,304 @@
+"""Open-loop capacity harness (scripts/loadgen.py): seeded arrival
+schedules, knee identification, report schema validation, and one live
+single-stage sweep against a tiny continuous-engine server (client
+TTFT + per-request cost metadata end to end)."""
+
+import importlib.util
+import json
+import os
+import random
+import threading
+import urllib.request
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "oryx_loadgen", os.path.join(ROOT, "scripts", "loadgen.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+loadgen = _load()
+
+
+def test_poisson_arrivals_seeded_and_open_loop():
+    rng = random.Random(7)
+    a1 = loadgen.poisson_arrivals(rng, rate=20.0, duration=10.0)
+    a2 = loadgen.poisson_arrivals(random.Random(7), 20.0, 10.0)
+    assert a1 == a2, "same seed must give the same schedule"
+    assert a1 == sorted(a1)
+    assert all(0 <= t < 10.0 for t in a1)
+    # ~200 expected arrivals; Poisson(200) stays within 4 sigma.
+    assert 140 <= len(a1) <= 260, len(a1)
+    mean_gap = a1[-1] / (len(a1) - 1)
+    assert 0.03 <= mean_gap <= 0.07, mean_gap
+    # Degenerate stage still sends one request.
+    assert loadgen.poisson_arrivals(random.Random(0), 0.001, 0.01) == [0.0]
+
+
+def test_build_body_shared_prefix_mix_and_determinism():
+    cfg = {
+        "shared_prefixes": ["SYS-A " * 20, "SYS-B " * 20],
+        "shared_prefix_frac": 0.5,
+        "prompt_chars_choices": [32, 64],
+        "max_tokens_choices": [4, 8],
+    }
+    bodies = [
+        loadgen.build_body(random.Random(i), cfg) for i in range(200)
+    ]
+    again = [
+        loadgen.build_body(random.Random(i), cfg) for i in range(200)
+    ]
+    assert bodies == again
+    shared = [
+        b for b in bodies if b["messages"][0]["role"] == "system"
+    ]
+    # The mix knob holds loosely at scale.
+    assert 60 <= len(shared) <= 140, len(shared)
+    for b in bodies:
+        assert b["stream"] is True
+        assert b["max_tokens"] in (4, 8)
+        assert b["messages"][-1]["role"] == "user"
+
+
+def _stage(rate, good_frac, anomalies=0.0, hung=0, transport=0,
+           capped=0):
+    return {
+        "offered_rps": rate, "sent": 20, "ok": 20, "good": 18,
+        "hung": hung, "slo_good_frac": good_frac,
+        "goodput_tps": rate * 5, "completed_tps": rate * 5,
+        "ttft_s": {"n": 20, "p50": 0.1, "p95": 0.2, "p99": 0.3,
+                   "mean": 0.1, "max": 0.3},
+        "per_token_s": {"n": 20, "p50": 0.01, "p95": 0.02, "p99": 0.03,
+                        "mean": 0.01, "max": 0.03},
+        "server_ttft_s": {"p50": 0.1, "p99": 0.3},
+        "errors": {"429": 0, "503": 0, "504": 0, "other_http": 0,
+                   "transport": transport, "stream_error": 0,
+                   "harness_inflight_cap": capped},
+        "anomalies": {"ttft_slo": anomalies, "queue_depth_slo": 0.0},
+        "cost": {"requests_with_cost": 20, "prefill_tokens": 100,
+                 "cached_tokens": 50, "cache_hit_frac": 0.33,
+                 "decode_steps": 80, "page_seconds": 2.0,
+                 "mean_page_seconds": 0.1,
+                 "goodput_tokens_per_page_second": 50.0},
+    }
+
+
+def test_find_knee_healthy_saturated_and_hopeless():
+    healthy = [_stage(1, 1.0), _stage(2, 0.95), _stage(4, 0.92)]
+    k = loadgen.find_knee(healthy, 0.9)
+    assert k == {"index": 2, "offered_rps": 4, "goodput_tps": 20,
+                 "saturated": False}
+
+    saturating = [_stage(1, 1.0), _stage(2, 0.95), _stage(4, 0.5),
+                  _stage(8, 0.1)]
+    k = loadgen.find_knee(saturating, 0.9)
+    assert k["index"] == 1 and k["offered_rps"] == 2
+    assert k["saturated"] is True
+
+    assert loadgen.find_knee([_stage(1, 0.2), _stage(2, 0.1)], 0.9) is None
+    # Prefix property: a sick LOW-load stage caps the knee even when a
+    # later stage looks healthy (that "health" is an artifact).
+    weird = [_stage(1, 0.5), _stage(2, 1.0)]
+    assert loadgen.find_knee(weird, 0.9) is None
+
+
+def _report(stages, knee):
+    return {
+        "bench": "loadgen", "config": {"gated": True},
+        "stages": stages, "knee": knee, "gate": {},
+    }
+
+
+def test_validate_report_schema():
+    stages = [_stage(1, 1.0), _stage(4, 0.95)]
+    rep = _report(stages, loadgen.find_knee(stages, 0.9))
+    assert loadgen.validate_report(rep) == []
+
+    broken = _report(stages, {"index": 0})  # knee missing keys
+    assert any("knee missing" in p for p in loadgen.validate_report(broken))
+    st = _stage(1, 1.0)
+    del st["ttft_s"]["p99"]
+    del st["anomalies"]["queue_depth_slo"]
+    probs = loadgen.validate_report(_report([st], None))
+    assert any("ttft_s missing 'p99'" in p for p in probs)
+    assert any("anomalies missing 'queue_depth_slo'" in p for p in probs)
+    assert any("no stages" in p for p in loadgen.validate_report(
+        _report([], None)
+    ))
+
+
+def test_gate_fires_on_below_knee_slo_breach_and_no_knee():
+    ok = _report(
+        [_stage(1, 1.0), _stage(4, 0.95)],
+        {"index": 1, "offered_rps": 4, "goodput_tps": 20,
+         "saturated": False},
+    )
+    gate = loadgen.evaluate_gate(ok, ledger_problems=[])
+    assert gate["passed"], gate
+
+    # A detector firing at/below the knee fails the gate even though
+    # the stage's client-side good_frac looked fine.
+    fired = _report(
+        [_stage(1, 1.0, anomalies=1.0), _stage(4, 0.95)],
+        {"index": 1, "offered_rps": 4, "goodput_tps": 20,
+         "saturated": False},
+    )
+    gate = loadgen.evaluate_gate(fired, ledger_problems=[])
+    assert not gate["passed"]
+    assert any("SLO-detector firing" in r for r in gate["reasons"])
+
+    nok = _report([_stage(1, 0.1)], None)
+    gate = loadgen.evaluate_gate(nok, ledger_problems=[])
+    assert not gate["passed"]
+    assert any("no knee" in r for r in gate["reasons"])
+
+    gate = loadgen.evaluate_gate(ok, ledger_problems=["missing cost"])
+    assert not gate["passed"]
+
+    hung = _report(
+        [_stage(1, 1.0, hung=1)],
+        {"index": 0, "offered_rps": 1, "goodput_tps": 5,
+         "saturated": False},
+    )
+    assert not loadgen.evaluate_gate(hung, ledger_problems=[])["passed"]
+
+    # A harness-side in-flight-cap shed below the knee fails the gate
+    # too: the generator didn't actually offer the recorded load.
+    capped = _report(
+        [_stage(1, 1.0, capped=2)],
+        {"index": 0, "offered_rps": 1, "goodput_tps": 5,
+         "saturated": False},
+    )
+    gate = loadgen.evaluate_gate(capped, ledger_problems=[])
+    assert not gate["passed"]
+    assert any("harness-capped" in r for r in gate["reasons"])
+
+
+def test_aggregate_stage_counts_hung_in_denominator():
+    """A hung request (no record appended — its worker is still
+    blocked) must count in `sent` and drag slo_good_frac down: offered
+    traffic that never completed is the opposite of healthy."""
+    ok_rec = {
+        "status": 200, "ok": True, "ttft_s": 0.1, "per_token_s": 0.01,
+        "e2e_s": 0.5, "tokens": 4, "cost": None, "error": None,
+    }
+    st = loadgen.aggregate_stage(
+        2.0, 5.0, [dict(ok_rec), dict(ok_rec)], 2, "", "", 1.0, None
+    )
+    assert st["sent"] == 4
+    assert st["ok"] == 2
+    assert st["hung"] == 2
+    assert st["slo_good_frac"] == 0.5
+    # And a harness cap shed is its own error class, not other_http.
+    capped_rec = dict(ok_rec)
+    capped_rec.update(ok=False, ttft_s=None, tokens=0,
+                      error="harness_inflight_cap")
+    st = loadgen.aggregate_stage(
+        2.0, 5.0, [dict(ok_rec), capped_rec], 0, "", "", 1.0, None
+    )
+    assert st["errors"]["harness_inflight_cap"] == 1
+    assert st["errors"]["other_http"] == 0
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve import api_server
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    class Tok:
+        def encode(self, text, add_special_tokens=False):
+            return [min(ord(c), 500) for c in text]
+
+        def decode(self, ids, skip_special_tokens=True):
+            return "".join(chr(i) for i in ids if 0 < i < 500)
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(Tok(), params, cfg)
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32,
+        ttft_slo=60.0, queue_depth_slo=32,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.scheduler.close()
+    srv.shutdown()
+
+
+def test_single_stage_against_live_server(live_server):
+    """One short open-loop stage end to end: client-measured TTFT, the
+    cost metadata off the final SSE chunk, a well-formed stage record,
+    and the cost-ledger audit over /debug/requests."""
+    cfg = {
+        "duration": 2.0, "drain_s": 120.0, "request_timeout": 300.0,
+        "max_inflight": 64, "slo_ttft": 60.0, "slo_per_token": None,
+        "max_tokens_choices": [3, 4],
+        "prompt_chars_choices": [24, 48],
+        "shared_prefix_frac": 0.5,
+        "shared_prefixes": [loadgen.filler_text(random.Random(1), 120)],
+    }
+    st = loadgen.run_stage(live_server, 3.0, cfg, random.Random(0))
+    assert st["sent"] >= 1
+    assert st["ok"] == st["sent"], st
+    assert st["hung"] == 0
+    assert st["slo_good_frac"] == 1.0
+    assert st["goodput_tps"] > 0
+    assert st["ttft_s"]["p50"] > 0
+    assert st["cost"]["requests_with_cost"] == st["ok"]
+    assert st["cost"]["page_seconds"] > 0
+    assert st["anomalies"] == {"ttft_slo": 0.0, "queue_depth_slo": 0.0}
+    # Stage record is schema-complete (the report validator's unit).
+    for k in loadgen._STAGE_KEYS:
+        assert k in st, k
+    assert loadgen.check_cost_ledger(live_server) == []
+    # And the shared-prefix mix actually hit the cache at least once
+    # across the stage (0.5 mix, one shared prefix, several requests).
+    if st["sent"] >= 4:
+        assert st["cost"]["cached_tokens"] > 0
+
+
+def test_inflight_cap_counts_cross_stage_stragglers():
+    """Review fix: threads still blocked from EARLIER stages count
+    against --max-inflight (the carryover registry), so a wedged
+    server cannot accumulate max_inflight threads per stage."""
+    from oryx_tpu.utils.metrics import Registry, TelemetryServer
+
+    # A /metrics-only server: the stage scrapes it, but every send is
+    # capped before any completion request goes out.
+    srv = TelemetryServer(Registry(prefix="oryx_serving"), port=0).start()
+    straggler_gate = threading.Event()
+    straggler = threading.Thread(target=straggler_gate.wait, daemon=True)
+    straggler.start()
+    try:
+        cfg = {
+            "duration": 0.3, "drain_s": 1.0, "request_timeout": 5.0,
+            "max_inflight": 1, "slo_ttft": 1.0, "slo_per_token": None,
+            "max_tokens_choices": [2], "prompt_chars_choices": [8],
+            "shared_prefix_frac": 0.0, "shared_prefixes": [],
+        }
+        carry = [straggler]
+        st = loadgen.run_stage(
+            f"http://127.0.0.1:{srv.port}", 30.0, cfg,
+            random.Random(0), carryover=carry,
+        )
+        assert st["sent"] > 0
+        # Every arrival was shed by the harness cap: the one straggler
+        # from the "previous stage" held the whole budget.
+        assert st["errors"]["harness_inflight_cap"] == st["sent"]
+        assert st["ok"] == 0
+        assert straggler in carry  # still registered while alive
+    finally:
+        straggler_gate.set()
+        srv.close()

@@ -4,6 +4,7 @@ rejection, collectors, and the TelemetryServer HTTP surface — the
 backbone both ServingMetrics and the trainer exporter sit on."""
 
 import json
+import math
 import re
 import threading
 import urllib.error
@@ -267,3 +268,158 @@ def test_telemetry_server_endpoints():
         assert ei.value.code == 404
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Shared quantile helpers (histogram bucket interpolation — the one
+# implementation loadgen and check_serving_endpoints both use)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantile_interpolation():
+    from oryx_tpu.utils.metrics import histogram_quantile
+
+    # Observations 0.5, 1.5, 1.5, 3.0 over bounds (1, 2, 4):
+    # cumulative counts (1, 3, 4), total 4.
+    bounds, counts, total = [1.0, 2.0, 4.0], [1, 3, 4], 4
+    # p50: rank 2 inside (1, 2] between cum 1 and 3 -> 1.5 exactly.
+    assert histogram_quantile(0.5, bounds, counts, total) == pytest.approx(1.5)
+    # p100 lands at the top of the last bucket.
+    assert histogram_quantile(1.0, bounds, counts, total) == pytest.approx(4.0)
+    # p25: rank 1 is the full first bucket -> its upper bound.
+    assert histogram_quantile(0.25, bounds, counts, total) == pytest.approx(1.0)
+    # q=0 clamps to the lower edge of the first occupied bucket.
+    assert histogram_quantile(0.0, bounds, counts, total) == pytest.approx(0.0)
+
+
+def test_histogram_quantile_edges():
+    from oryx_tpu.utils.metrics import histogram_quantile
+
+    # Empty histogram -> NaN.
+    assert math.isnan(histogram_quantile(0.5, [1.0], [0], 0))
+    assert math.isnan(histogram_quantile(0.5, [], [], 0))
+    # Observations past the last finite bound clamp to it (the
+    # Prometheus convention): 3 of 4 obs overflowed the ladder.
+    assert histogram_quantile(0.99, [1.0], [1], 4) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        histogram_quantile(1.5, [1.0], [1], 1)
+
+
+def test_parse_prom_histogram_roundtrip():
+    """Render a real registry histogram, parse it back with the shared
+    parser, and check the quantile is consistent with the samples."""
+    from oryx_tpu.utils.metrics import (
+        histogram_quantile,
+        parse_prom_histogram,
+    )
+
+    reg = Registry(prefix="oryx_test")
+    h = reg.histogram("lat_seconds", (0.1, 0.5, 1.0, 5.0))
+    for v in (0.05, 0.2, 0.3, 0.7, 2.0, 9.0):
+        h.observe(v)
+    text = reg.render()
+    parsed = parse_prom_histogram(text, "oryx_test_lat_seconds")
+    assert parsed is not None
+    bounds, counts, total, s = parsed
+    assert bounds == [0.1, 0.5, 1.0, 5.0]
+    assert counts == [1, 3, 4, 5]
+    assert total == 6
+    assert s == pytest.approx(12.25)
+    p50 = histogram_quantile(0.5, bounds, counts, total)
+    assert 0.1 <= p50 <= 0.5  # the median sample (0.3-ish bucket)
+    # Absent family -> None, never a crash.
+    assert parse_prom_histogram(text, "oryx_test_nope_seconds") is None
+
+
+def test_sample_quantile_exact():
+    from oryx_tpu.utils.metrics import sample_quantile
+
+    assert math.isnan(sample_quantile([], 0.5))
+    assert sample_quantile([3.0], 0.99) == 3.0
+    vals = [4.0, 1.0, 3.0, 2.0]
+    assert sample_quantile(vals, 0.5) == pytest.approx(2.5)
+    assert sample_quantile(vals, 0.0) == 1.0
+    assert sample_quantile(vals, 1.0) == 4.0
+    with pytest.raises(ValueError):
+        sample_quantile(vals, -0.1)
+
+
+# ---------------------------------------------------------------------------
+# Concurrent scrapes under write load (registry thread-safety + no
+# torn exposition lines)
+# ---------------------------------------------------------------------------
+
+
+def _assert_histograms_consistent(text: str) -> None:
+    """Within ONE exposition, every histogram's bucket counts must be
+    cumulative non-decreasing and its +Inf bucket must equal its
+    _count line — a torn render (counts snapshotted mid-observe)
+    breaks one of these."""
+    import collections
+
+    buckets: dict[str, list[tuple[float, int]]] = collections.defaultdict(list)
+    counts: dict[str, int] = {}
+    for line in text.splitlines():
+        m = re.match(r'^(\S+)_bucket\{le="([^"]+)"\} (\d+)$', line)
+        if m:
+            le = float("inf") if m.group(2) == "+Inf" else float(m.group(2))
+            buckets[m.group(1)].append((le, int(m.group(3))))
+            continue
+        m = re.match(r"^(\S+)_count (\d+)$", line)
+        if m:
+            counts[m.group(1)] = int(m.group(2))
+    assert buckets, "no histograms in exposition"
+    for name, bs in buckets.items():
+        cs = [c for _, c in sorted(bs)]
+        assert cs == sorted(cs), f"{name}: non-cumulative buckets {bs}"
+        assert cs[-1] == counts[name], (
+            f"{name}: +Inf bucket {cs[-1]} != count {counts[name]}"
+        )
+
+
+def test_concurrent_scrapes_no_torn_lines():
+    """Writers hammering counters/gauges/histograms (labeled children
+    included) while readers render: every exposition parses line-clean
+    (parse_exposition asserts per-line well-formedness and no
+    duplicate TYPE), and every histogram is internally consistent."""
+    import random as random_lib
+
+    reg = Registry(prefix="oryx_test")
+    c = reg.counter("ops_total")
+    g = reg.gauge("depth")
+    h = reg.histogram("lat_seconds", (0.1, 0.5, 1.0, 5.0))
+    lbl = reg.counter("kinds_total", ("kind",))
+    stop = threading.Event()
+    failures: list[BaseException] = []
+
+    def writer(seed: int) -> None:
+        rng = random_lib.Random(seed)
+        while not stop.is_set():
+            c.inc()
+            g.set(rng.random() * 100)
+            h.observe(rng.random() * 10)
+            lbl.labels(kind=f"k{rng.randrange(4)}").inc()
+
+    def reader() -> None:
+        try:
+            for _ in range(40):
+                text = reg.render()
+                parse_exposition(text)
+                _assert_histograms_consistent(text)
+        except BaseException as e:  # surfaces through `failures`
+            failures.append(e)
+
+    writers = [
+        threading.Thread(target=writer, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in writers + readers:
+        t.start()
+    for t in readers:
+        t.join(timeout=120)
+    stop.set()
+    for t in writers:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in readers), "reader hung"
+    assert not failures, failures[0]
