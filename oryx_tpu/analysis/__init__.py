@@ -5,19 +5,31 @@ Static side (dependency-free, AST-only — see `core.py`):
   rule id           what it catches
   ----------------  ---------------------------------------------------
   lock-discipline   `# guarded-by:` fields touched outside their lock
+  lock-order        with-nesting that inverts the declared lock order
+                    (oryx_tpu/concurrency.py), cycles, locks held
+                    across `# hot-path` dispatches — interprocedural
+  atomicity         check-then-act on a guarded field across a lock
+                    release
   use-after-donate  buffers read after a donating jit call consumed them
   host-sync         implicit device→host syncs inside `# hot-path` code
   recompile-hazard  tracer branches / unhashable static operands
   metric-name       family naming + one-kind-per-name, repo-wide
+  swallowed-exception  broad excepts that only pass/log, un-annotated
 
-Run it: `python scripts/run_oryxlint.py [--strict] [--changed-only]`.
+Run it: `python scripts/run_oryxlint.py [--strict] [--changed-only]
+[--max-suppressions N] [--json-out PATH]`.
 Suppress a finding: `# oryxlint: disable=<rule>` on its line (regions:
 `# oryxlint: off=<rule>` … `# oryxlint: on=<rule>`).
 
-Runtime side (`sanitizers.py`, imports jax lazily):
-`recompile_watchdog()` (compile-storm budget + `oryx_recompiles_total`)
-and `donation_guard()` (donation actually happened / use-after-donate
-tripwire).
+Runtime side (`sanitizers.py`, imports jax lazily except the lock
+tooling, which is stdlib-only):
+`recompile_watchdog()` (compile-storm budget + `oryx_recompiles_total`),
+`donation_guard()` (donation actually happened / use-after-donate
+tripwire), and the concurrency half armed by `ORYX_LOCK_SANITIZER=1`:
+`named_lock()` + `LockOrderSanitizer` (held stacks, order/cycle/
+re-entrancy checks, `oryx_lock_{wait,hold}_seconds{lock=}`),
+`hot_dispatch()` and the `RaceDetector` over `# guarded-by:` /
+`# thread-owned:` annotated fields.
 """
 
 from oryx_tpu.analysis.core import (  # noqa: F401
@@ -38,10 +50,25 @@ from oryx_tpu.analysis.runner import (  # noqa: F401
 )
 from oryx_tpu.analysis.sanitizers import (  # noqa: F401
     DonationGuard,
+    LockOrderSanitizer,
+    LockOrderViolation,
+    RaceDetector,
+    RaceViolation,
     RecompileStats,
     RecompileStormError,
     UseAfterDonateError,
+    arm_lock_sanitizer,
     backend_donates,
+    bind_lock_metrics,
+    disarm_lock_sanitizer,
     donation_guard,
+    hot_dispatch,
+    lock_sanitizer,
+    lock_sanitizer_armed,
+    lock_stats,
+    maybe_arm_from_env,
+    named_lock,
+    race_exempt,
+    race_violations,
     recompile_watchdog,
 )

@@ -189,6 +189,54 @@ class Checker:
         return Finding(mod.path, line, col, self.name, message)
 
 
+# Shared field-annotation syntax: a field declaration line carrying a
+# concurrency marker in a REAL comment (comment_text — quoted syntax in
+# docstrings never counts). Two markers:
+#   self._queue: deque = deque()   # guarded-by: _cond
+#   cost_decode_steps: int = 0     # thread-owned: engine
+# The declaration form covers `self.x = ...`, `self.x: T = ...`, and
+# bare dataclass / class-body fields (`x: T = ...`, `x: T`).
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+_THREAD_OWNED_RE = re.compile(r"#\s*thread-owned:\s*(\w+)")
+_FIELD_DECL_RE = re.compile(
+    r"^\s*(?:self\.)?([A-Za-z_]\w*)\s*(?::[^=#]+)?(?:=(?!=)|$)"
+)
+
+
+def class_line_span(cls: ast.ClassDef) -> tuple[int, int]:
+    end = max(
+        (getattr(n, "end_lineno", cls.lineno) for n in ast.walk(cls)),
+        default=cls.lineno,
+    )
+    return cls.lineno, end
+
+
+def field_annotations(
+    mod: "ParsedModule", cls: ast.ClassDef
+) -> dict[str, tuple[str, str]]:
+    """field -> ("guarded-by", lock_attr) | ("thread-owned", owner_tag)
+    from marker comments on declaration lines inside the class body.
+    Used by the lock-discipline / atomicity static rules AND by the
+    runtime race detector (analysis.sanitizers), so the annotation
+    language can never drift between the two halves."""
+    start, end = class_line_span(cls)
+    out: dict[str, tuple[str, str]] = {}
+    for line in range(start, end + 1):
+        comment = mod.comment_text(line)
+        m = _GUARDED_RE.search(comment)
+        kind = "guarded-by"
+        if not m:
+            m = _THREAD_OWNED_RE.search(comment)
+            kind = "thread-owned"
+        if not m:
+            continue
+        code = mod.line_text(line).split("#", 1)[0]
+        decl = _FIELD_DECL_RE.match(code)
+        if decl:
+            out[decl.group(1)] = (kind, m.group(1))
+    return out
+
+
 def dotted_name(node: ast.AST) -> str | None:
     """`a`, `a.b.c`, `self.kv_pages` → dotted string; anything with a
     non-Name base (calls, subscripts) → None."""

@@ -1,0 +1,796 @@
+"""lock-order / atomicity: ordering and atomicity BETWEEN locks.
+
+The lock-discipline rule (locks.py) proves each guarded field is
+touched under ITS lock; these two rules prove the locks compose:
+
+  * `lock-order` — the repo declares its lock-acquisition order once
+    (oryx_tpu/concurrency.py):
+
+        # lock-order: scheduler._cond < trace._lock < registry._lock
+
+    Locks are named at their creation site
+    (`self._cond = named_lock("scheduler._cond", ...)`, or a
+    `# lock-name: <name>` comment on the assignment). This checker
+    builds the repo-wide may-acquire-while-holding graph from
+    `with self.<lock>:` nesting — interprocedurally: a call made while
+    holding a lock inherits the held set, and the callee's transitive
+    may-acquire set lands as edges — and reports (a) any edge that
+    inverts the declared order, (b) any cycle among locks the manifest
+    doesn't rank, (c) a call to a `# hot-path` function made while
+    holding any lock (a device dispatch under a lock serializes the
+    whole stack on device latency), and (d) contradictory manifest
+    declarations.
+
+  * `atomicity` — check-then-act on a `# guarded-by:` field where the
+    lock is RELEASED between the check and the dependent act (the
+    exact shape of the queue-depth-gauge bugs PR 5 found by hand):
+
+        with self._cond:
+            if not self._queue:
+                return              # checked under the lock...
+        ...
+        with self._cond:
+            self._queue.popleft()   # ...acted on after releasing it
+
+    Two shapes are flagged: an early-exit check (the guarded test's
+    body ends in return/break/continue/raise) followed by a later
+    same-lock block mutating the same field, and a value read under
+    the lock that escapes to a local whose test guards a later
+    same-lock mutation. Sites that are safe for a structural reason
+    the checker can't see (single-consumer queues) carry a per-line
+    `# oryxlint: disable=atomicity` with the reason — the suppression
+    IS the documentation of the concurrency model.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import types
+from typing import Iterator
+
+from oryx_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ParsedModule,
+    RepoContext,
+    dotted_name,
+    field_annotations,
+)
+from oryx_tpu.analysis.hostsync import is_hot
+
+# The chain stops at a second '#' so a trailing comment (fixtures'
+# `# expect:` markers) never becomes a lock name.
+_LOCK_ORDER_RE = re.compile(r"#\s*lock-order:\s*([^#]+)")
+_LOCK_NAME_RE = re.compile(r"#\s*lock-name:\s*([\w.\-]+)")
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# Method names owned by stdlib containers/primitives: never resolved
+# by bare name (a `self._queue.clear()` must not alias to a repo
+# class's `clear`). Typed receivers (`self.prefix_cache.clear()`,
+# where the attr's class is known) still resolve precisely.
+_STDLIB_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "update", "add", "discard", "get", "keys",
+    "values", "items", "setdefault", "put", "put_nowait", "get_nowait",
+    "qsize", "join", "start", "run", "wait", "wait_for", "notify",
+    "notify_all", "acquire", "release", "locked", "set", "is_set",
+    "sort", "reverse", "count", "index", "copy", "split", "strip",
+    "lower", "upper", "format", "encode", "decode", "read", "write",
+    "flush", "close", "open", "seek", "tell", "search", "match",
+    "finditer", "findall", "group", "sub", "replace", "startswith",
+    "endswith", "is_alive", "item", "tolist", "tobytes", "astype",
+    "reshape", "sum", "min", "max", "mean", "any", "all", "fill",
+})
+
+
+def _terminal_names(node: ast.AST) -> list[str]:
+    """Candidate class-ish names mentioned in an annotation or value
+    expression: `trace_lib.Tracer` -> Tracer, `X | None` -> X, ..."""
+    out: list[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            out.append(n.attr)
+        elif isinstance(n, ast.Name):
+            out.append(n.id)
+    return out
+
+
+class _Fn:
+    """One function's lock summary (scan pass)."""
+
+    __slots__ = ("path", "cls", "name", "hot",
+                 "acquires", "calls", "may_acquire")
+
+    def __init__(self, path: str, cls: str | None, name: str, hot: bool):
+        self.path = path
+        self.cls = cls
+        self.name = name
+        self.hot = hot
+        # (lock_name, frozenset(held), line)
+        self.acquires: list[tuple[str, frozenset, int]] = []
+        # (ref, frozenset(held), line); ref is ("self", m) /
+        # ("selfattr", attr, m) / ("mod", alias, f) / ("any", m) /
+        # ("bare", f)
+        self.calls: list[tuple[tuple, frozenset, int]] = []
+        self.may_acquire: set[str] = set()
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+
+    def __init__(self) -> None:
+        # Scan-pass accumulators (instance-scoped: runner builds fresh
+        # checkers per run_lint call).
+        self.methods: dict[tuple[str, str], list[_Fn]] = {}
+        self.functions: dict[tuple[str, str], list[_Fn]] = {}
+        self.class_locks: dict[tuple[str, str], str] = {}
+        self.attr_ann: dict[tuple[str, str], set[str]] = {}
+        self.known_classes: set[str] = set()
+        self.name_locks: dict[tuple[str, str], str] = {}  # (path, var)
+        self.manifest: list[tuple[str, int, list[str]]] = []
+        self.imports: dict[str, dict[str, str]] = {}  # path -> alias->modtail
+        self._analyzed: dict | None = None
+
+    # ------------------------------------------------------------------
+    # scan pass
+    # ------------------------------------------------------------------
+
+    def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
+        path = mod.path
+        for line in range(1, len(mod.lines) + 1):
+            m = _LOCK_ORDER_RE.search(mod.comment_text(line))
+            if m:
+                chain = [p.strip() for p in m.group(1).split("<")]
+                chain = [p for p in chain if p]
+                if len(chain) >= 2:
+                    self.manifest.append((path, line, chain))
+        imap = self.imports.setdefault(path, {})
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imap[a.asname or a.name.split(".")[0]] = \
+                        a.name.rsplit(".", 1)[-1]
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    imap[a.asname or a.name] = a.name
+        modtail = path.rsplit("/", 1)[-1].removesuffix(".py")
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        # Pass A: declarations — name-lock bindings anywhere, class
+        # field types, self.<attr> lock declarations — so the held-set
+        # walk below resolves locks regardless of source order.
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                self.known_classes.add(node.name)
+                for item in node.body:
+                    if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name
+                    ):
+                        self.attr_ann.setdefault(
+                            (node.name, item.target.id), set()
+                        ).update(_terminal_names(item.annotation))
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._scan_attr_decls(mod, item, node.name)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._scan_lock_decl(
+                    mod, node,
+                    module_level=isinstance(
+                        parents.get(node), ast.Module
+                    ),
+                    modtail=modtail,
+                )
+        # Pass B: one summary per function, owner class = direct
+        # parent ClassDef (nested closures register by bare name).
+        for node in ast.walk(mod.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            owner = parents.get(node)
+            cls = owner.name if isinstance(owner, ast.ClassDef) else None
+            info = _Fn(path, cls, node.name, is_hot(mod, node))
+            if cls is not None:
+                self.methods.setdefault((cls, node.name), []).append(info)
+            else:
+                self.functions.setdefault(
+                    (modtail, node.name), []
+                ).append(info)
+            self._walk_held(mod, info, node.body, frozenset(),
+                            cls=cls, modtail=modtail)
+
+    def _scan_lock_decl(self, mod, node, *, module_level, modtail) -> None:
+        """Register `x = named_lock(...)` / `x = threading.Lock()` (and
+        `# lock-name:` annotated) NAME assignments as known locks.
+        Unannotated function-local plain locks are deliberately
+        invisible: tests build throwaway lock pairs all the time, and
+        only locks someone bothered to name participate in ordering."""
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        value = node.value
+        if value is None or len(targets) != 1:
+            return
+        target = targets[0]
+        if not isinstance(target, ast.Name):
+            return
+        named, factory = self._lock_value(value)
+        comment = _LOCK_NAME_RE.search(mod.comment_text(node.lineno))
+        var = target.id
+        if comment:
+            self.name_locks[(mod.path, var)] = comment.group(1)
+        elif named:
+            self.name_locks[(mod.path, var)] = named
+        elif factory and module_level:
+            self.name_locks[(mod.path, var)] = f"{modtail}.{var}"
+
+    def _lock_value(self, value: ast.AST) -> tuple[str | None, bool]:
+        """(explicit name from a named_lock("...") call, any lock
+        factory present) anywhere inside the value expression."""
+        named = None
+        factory = False
+        for n in ast.walk(value):
+            if not isinstance(n, ast.Call):
+                continue
+            fname = (
+                n.func.id if isinstance(n.func, ast.Name)
+                else n.func.attr if isinstance(n.func, ast.Attribute)
+                else None
+            )
+            if fname == "named_lock":
+                factory = True
+                if n.args and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    named = n.args[0].value
+            elif fname in _LOCK_FACTORIES:
+                factory = True
+        return named, factory
+
+    def _scan_attr_decls(self, mod, fn, cls: str) -> None:
+        """self.<attr> assignments: lock declarations and attr types."""
+        param_ann = {
+            a.arg: set(_terminal_names(a.annotation))
+            for a in list(fn.args.args) + list(fn.args.kwonlyargs)
+            if a.annotation is not None
+        }
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if len(targets) != 1 or node.value is None:
+                continue
+            t = targets[0]
+            if not (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"
+            ):
+                continue
+            attr = t.attr
+            named, factory = self._lock_value(node.value)
+            comment = _LOCK_NAME_RE.search(mod.comment_text(node.lineno))
+            if comment:
+                self.class_locks[(cls, attr)] = comment.group(1)
+            elif named:
+                self.class_locks[(cls, attr)] = named
+            elif factory:
+                self.class_locks.setdefault(
+                    (cls, attr), f"{cls}.{attr}"
+                )
+            ann = set(_terminal_names(node.value))
+            if isinstance(node, ast.AnnAssign):
+                ann |= set(_terminal_names(node.annotation))
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in param_ann:
+                ann |= param_ann[node.value.id]
+            if ann:
+                self.attr_ann.setdefault((cls, attr), set()).update(ann)
+
+    # ------------------------------------------------------------------
+    # held-set walk
+    # ------------------------------------------------------------------
+
+    def _with_lock(self, mod, item: ast.withitem, cls, modtail
+                   ) -> str | None:
+        d = dotted_name(item.context_expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and d.count(".") == 1:
+            attr = d.split(".", 1)[1]
+            if cls is not None and (cls, attr) in self.class_locks:
+                return self.class_locks[(cls, attr)]
+            return None
+        if "." not in d:
+            return self.name_locks.get((mod.path, d))
+        return None
+
+    def _walk_held(self, mod, info: _Fn, body, held: frozenset,
+                   *, cls, modtail) -> None:
+        for node in body:
+            self._walk_node(mod, info, node, held, cls=cls,
+                            modtail=modtail)
+
+    def _walk_node(self, mod, info, node, held, *, cls, modtail) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return  # nested scopes summarize separately
+        if isinstance(node, ast.With):
+            got = set(held)
+            for item in node.items:
+                self._walk_node(mod, info, item.context_expr, held,
+                                cls=cls, modtail=modtail)
+                lock = self._with_lock(mod, item, cls, modtail)
+                if lock is not None:
+                    info.acquires.append((lock, frozenset(got),
+                                          node.lineno))
+                    got.add(lock)
+            inner = frozenset(got)
+            self._walk_held(mod, info, node.body, inner,
+                            cls=cls, modtail=modtail)
+            return
+        if isinstance(node, ast.Call):
+            ref = self._call_ref(node)
+            if ref is not None:
+                info.calls.append((ref, held, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(mod, info, child, held, cls=cls,
+                            modtail=modtail)
+
+    def _call_ref(self, call: ast.Call) -> tuple | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ("bare", func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return ("self", func.attr)
+            return ("mod", base.id, func.attr)
+        if isinstance(base, ast.Attribute) and isinstance(
+            base.value, ast.Name
+        ) and base.value.id == "self":
+            return ("selfattr", base.attr, func.attr)
+        return ("any", func.attr)
+
+    # ------------------------------------------------------------------
+    # check pass (graph analysis runs once, findings emitted per module)
+    # ------------------------------------------------------------------
+
+    def _resolve(self, info: _Fn, ref: tuple, path: str) -> list[_Fn]:
+        kind = ref[0]
+        if kind == "self" and info.cls is not None:
+            hit = self.methods.get((info.cls, ref[1]))
+            if hit:
+                return hit
+            return self._by_name(ref[1])
+        if kind == "selfattr" and info.cls is not None:
+            out: list[_Fn] = []
+            for t in self.attr_ann.get((info.cls, ref[1]), ()):
+                out.extend(self.methods.get((t, ref[2]), ()))
+            if out:
+                return out
+            return self._by_name(ref[2])
+        if kind == "mod":
+            alias, f = ref[1], ref[2]
+            tail = self.imports.get(path, {}).get(alias, alias)
+            hit = self.functions.get((tail, f))
+            if hit:
+                return hit
+            return self._by_name(f)
+        if kind == "bare":
+            f = ref[1]
+            tail = path.rsplit("/", 1)[-1].removesuffix(".py")
+            hit = self.functions.get((tail, f))
+            if hit:
+                return hit
+            ctor = self.methods.get((f, "__init__"))
+            if ctor:
+                return ctor
+            out = []
+            for (_, name), fns in self.functions.items():
+                if name == f:
+                    out.extend(fns)
+            return out
+        if kind in ("self", "selfattr", "any"):
+            return self._by_name(ref[-1])
+        return []
+
+    def _by_name(self, m: str) -> list[_Fn]:
+        if m in _STDLIB_METHODS:
+            return []
+        out: list[_Fn] = []
+        for (_, name), fns in self.methods.items():
+            if name == m:
+                out.extend(fns)
+        for (_, name), fns in self.functions.items():
+            if name == m:
+                out.extend(fns)
+        return out
+
+    def _analyze(self) -> dict:
+        if self._analyzed is not None:
+            return self._analyzed
+        all_fns: list[_Fn] = [
+            f for fns in list(self.methods.values())
+            + list(self.functions.values()) for f in fns
+        ]
+        resolved: dict[int, list[list[_Fn]]] = {}
+        for f in all_fns:
+            resolved[id(f)] = [
+                self._resolve(f, ref, f.path) for ref, _, _ in f.calls
+            ]
+        # may-acquire fixpoint over the call graph.
+        for f in all_fns:
+            f.may_acquire = {l for l, _, _ in f.acquires}
+        changed = True
+        while changed:
+            changed = False
+            for f in all_fns:
+                for callees in resolved[id(f)]:
+                    for g in callees:
+                        extra = g.may_acquire - f.may_acquire
+                        if extra:
+                            f.may_acquire |= extra
+                            changed = True
+        # Observed edges (held -> acquired) with first witness.
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        hot_sites: list[tuple[str, int, str, str]] = []
+        for f in all_fns:
+            for lock, heldset, line in f.acquires:
+                for h in heldset:
+                    if h != lock:
+                        edges.setdefault(
+                            (h, lock),
+                            (f.path, line,
+                             f"'with' nesting in {f.name}"),
+                        )
+            for (ref, heldset, line), callees in zip(
+                f.calls, resolved[id(f)]
+            ):
+                if not heldset:
+                    continue
+                for g in callees:
+                    if g.hot:
+                        hot_sites.append(
+                            (f.path, line, g.name,
+                             ", ".join(sorted(heldset))),
+                        )
+                    for lock in g.may_acquire:
+                        for h in heldset:
+                            if h != lock:
+                                edges.setdefault(
+                                    (h, lock),
+                                    (f.path, line,
+                                     f"call to {g.name}() from "
+                                     f"{f.name}"),
+                                )
+        # Declared order: consecutive pairs from every chain; conflicts
+        # reported where the contradiction lands.
+        declared: dict[str, set[str]] = {}
+        conflicts: list[tuple[str, int, str]] = []
+
+        def reaches(a: str, b: str) -> bool:
+            seen, stack = set(), [a]
+            while stack:
+                n = stack.pop()
+                if n == b:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(declared.get(n, ()))
+            return False
+
+        for path, line, chain in sorted(self.manifest):
+            for a, b in zip(chain, chain[1:]):
+                if a == b or reaches(b, a):
+                    conflicts.append((
+                        path, line,
+                        f"lock-order manifest declares '{a}' < '{b}' "
+                        f"but '{b}' < '{a}' is already declared",
+                    ))
+                    continue
+                declared.setdefault(a, set()).add(b)
+        inversions: list[tuple[str, int, str]] = []
+        inverted_edges: set[tuple[str, str]] = set()
+        for (a, b), (path, line, how) in sorted(edges.items()):
+            if reaches(b, a):
+                inverted_edges.add((a, b))
+                inversions.append((
+                    path, line,
+                    f"acquiring '{b}' while holding '{a}' inverts the "
+                    f"declared lock order ('{b}' < '{a}'); via {how}",
+                ))
+        cycles = self._find_cycles(edges, inverted_edges)
+        self._analyzed = {
+            "inversions": inversions,
+            "cycles": cycles,
+            "conflicts": conflicts,
+            "hot": [
+                (path, line,
+                 f"call to hot-path '{fn}()' while holding {held}: a "
+                 "device dispatch under a lock serializes every other "
+                 "thread on device latency")
+                for path, line, fn, held in hot_sites
+            ],
+        }
+        return self._analyzed
+
+    def _find_cycles(self, edges, inverted_edges
+                     ) -> list[tuple[str, int, str]]:
+        """Cycles in the observed graph not already reported as
+        declared-order inversions."""
+        adj: dict[str, set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(sorted(adj[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(adj[w]))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        out: list[tuple[str, int, str]] = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            members = set(scc)
+            scc_edges = sorted(
+                (a, b) for (a, b) in edges
+                if a in members and b in members
+            )
+            if any(e in inverted_edges for e in scc_edges):
+                continue  # already reported as an inversion
+            a, b = scc_edges[0]
+            path, line, how = edges[(a, b)]
+            out.append((
+                path, line,
+                "lock-order cycle among "
+                f"{sorted(members)} (edge '{a}' -> '{b}' via {how}); "
+                "declare an order in the lock-order manifest or break "
+                "the nesting",
+            ))
+        return out
+
+    def check(self, mod: ParsedModule, ctx: RepoContext
+              ) -> Iterator[Finding | None]:
+        res = self._analyze()
+        for kind in ("conflicts", "inversions", "cycles", "hot"):
+            for path, line, msg in res[kind]:
+                if path != mod.path:
+                    continue
+                node = types.SimpleNamespace(lineno=line, col_offset=0)
+                yield self.finding(mod, node, msg)
+
+
+# ---------------------------------------------------------------------------
+# atomicity
+# ---------------------------------------------------------------------------
+
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "update", "add", "discard", "setdefault",
+    "reverse", "sort",
+})
+
+
+def _reads_field(node: ast.AST, field: str) -> bool:
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+            and n.attr == field
+            and isinstance(n.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+def _is_early_exit(body: list[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Break, ast.Continue, ast.Raise)
+    )
+
+
+class AtomicityChecker(Checker):
+    name = "atomicity"
+
+    def check(self, mod: ParsedModule, ctx: RepoContext
+              ) -> Iterator[Finding | None]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                fields = {
+                    f: arg
+                    for f, (kind, arg) in
+                    field_annotations(mod, node).items()
+                    if kind == "guarded-by"
+                }
+                if not fields:
+                    continue
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and item.name != "__init__":
+                        yield from self._check_method(mod, item, fields)
+
+    def _lock_blocks(self, fn, fields) -> list[tuple[str, ast.With]]:
+        """(lock_attr, with_node) for every `with self.<lock>:` block
+        over a lock that guards at least one annotated field."""
+        locks = set(fields.values())
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                d = dotted_name(item.context_expr)
+                if d and d.startswith("self.") \
+                        and d[len("self."):] in locks:
+                    out.append((d[len("self."):], node))
+        out.sort(key=lambda p: p[1].lineno)
+        return out
+
+    def _mutations(self, block: ast.With, field: str) -> list[int]:
+        lines = []
+        for n in ast.walk(block):
+            if isinstance(n, ast.Attribute) and isinstance(
+                n.value, ast.Name
+            ) and n.value.id == "self" and n.attr == field:
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    lines.append(n.lineno)
+        for n in ast.walk(block):
+            # self.F.<mutator>(...) and self.F[...] = ...
+            if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ) and n.func.attr in _MUTATORS:
+                base = n.func.value
+                d = dotted_name(base)
+                if d == f"self.{field}":
+                    lines.append(n.lineno)
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    n.targets if isinstance(n, ast.Assign)
+                    else [n.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        d = dotted_name(t.value)
+                        if d == f"self.{field}":
+                            lines.append(t.lineno)
+        return sorted(set(lines))
+
+    def _early_exit_checks(self, block: ast.With, fields, lock
+                           ) -> dict[str, int]:
+        """field -> line of a guarded early-exit test inside block."""
+        out: dict[str, int] = {}
+        for n in ast.walk(block):
+            if isinstance(n, (ast.If, ast.While)) \
+                    and _is_early_exit(n.body):
+                for f, l in fields.items():
+                    if l == lock and _reads_field(n.test, f):
+                        out.setdefault(f, n.lineno)
+        return out
+
+    def _escapes(self, block: ast.With, fields, lock) -> dict[str, str]:
+        """local var -> field it was derived from inside the block."""
+        out: dict[str, str] = {}
+        for n in ast.walk(block):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                for f, l in fields.items():
+                    if l == lock and _reads_field(n.value, f):
+                        out[n.targets[0].id] = f
+        return out
+
+    def _check_method(self, mod, fn, fields
+                      ) -> Iterator[Finding | None]:
+        blocks = self._lock_blocks(fn, fields)
+        if len(blocks) < 2:
+            return
+        reported: set[tuple[int, str]] = set()
+        for i, (lock_a, a) in enumerate(blocks):
+            checks = self._early_exit_checks(a, fields, lock_a)
+            escapes = self._escapes(a, fields, lock_a)
+            guarded_vars = set(escapes)
+            # Escape form: the escaped value's test guards a later
+            # same-lock block that mutates the field.
+            guard_ranges: list[tuple[int, int, str]] = []
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.If, ast.While)) \
+                        and n.lineno > a.lineno:
+                    used = {
+                        x.id for x in ast.walk(n.test)
+                        if isinstance(x, ast.Name)
+                    } & guarded_vars
+                    for v in used:
+                        guard_ranges.append((
+                            n.lineno,
+                            getattr(n, "end_lineno", n.lineno),
+                            escapes[v],
+                        ))
+            for lock_b, b in blocks[i + 1:]:
+                if lock_b != lock_a or b is a:
+                    continue
+                for f, check_line in checks.items():
+                    for line in self._mutations(b, f):
+                        key = (line, f)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        node = types.SimpleNamespace(
+                            lineno=line, col_offset=0
+                        )
+                        yield self.finding(
+                            mod, node,
+                            f"check-then-act on 'self.{f}': checked "
+                            f"under 'self.{lock_a}' at line "
+                            f"{check_line}, but the lock was released "
+                            "before this dependent mutation "
+                            "re-acquired it (the check can go stale "
+                            "in between)",
+                        )
+                for start, end, f in guard_ranges:
+                    if not (start <= b.lineno <= end):
+                        continue
+                    for line in self._mutations(b, f):
+                        key = (line, f)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        node = types.SimpleNamespace(
+                            lineno=line, col_offset=0
+                        )
+                        yield self.finding(
+                            mod, node,
+                            f"check-then-act on 'self.{f}': a value "
+                            f"read under 'self.{lock_a}' guards this "
+                            "mutation, but the lock was released "
+                            "between the read and the act",
+                        )

@@ -22,7 +22,6 @@ a structural reason the checker can't see carry a per-line
 from __future__ import annotations
 
 import ast
-import re
 from typing import Iterator
 
 from oryx_tpu.analysis.core import (
@@ -31,13 +30,8 @@ from oryx_tpu.analysis.core import (
     ParsedModule,
     RepoContext,
     dotted_name,
+    field_annotations,
 )
-
-# The declaration line must assign the field AND carry the marker in a
-# real comment (ParsedModule.comment_text — string literals quoting the
-# syntax don't count).
-_DECL_LINE_RE = re.compile(r"self\.(\w+)\s*(?::[^=#]+)?=")
-_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
 
 
 class LockDisciplineChecker(Checker):
@@ -53,21 +47,15 @@ class LockDisciplineChecker(Checker):
     def _guarded_fields(
         self, mod: ParsedModule, cls: ast.ClassDef
     ) -> dict[str, str]:
-        """field -> lock, from `# guarded-by:` comments on assignment
-        lines inside the class body."""
-        end = max(
-            (getattr(n, "end_lineno", cls.lineno) for n in ast.walk(cls)),
-            default=cls.lineno,
-        )
-        fields: dict[str, str] = {}
-        for line in range(cls.lineno, end + 1):
-            m = _GUARDED_RE.search(mod.comment_text(line))
-            if not m:
-                continue
-            decl = _DECL_LINE_RE.search(mod.line_text(line))
-            if decl:
-                fields[decl.group(1)] = m.group(1)
-        return fields
+        """field -> lock, from `# guarded-by:` comments on declaration
+        lines inside the class body (the shared annotation parser in
+        core.py; `# thread-owned:` fields are the runtime race
+        detector's, not this rule's)."""
+        return {
+            field: arg
+            for field, (kind, arg) in field_annotations(mod, cls).items()
+            if kind == "guarded-by"
+        }
 
     def _check_class(
         self, mod: ParsedModule, cls: ast.ClassDef

@@ -22,6 +22,7 @@ from oryx_tpu.analysis.core import (
 )
 from oryx_tpu.analysis.donation import UseAfterDonateChecker
 from oryx_tpu.analysis.hostsync import HostSyncChecker
+from oryx_tpu.analysis.lockorder import AtomicityChecker, LockOrderChecker
 from oryx_tpu.analysis.locks import LockDisciplineChecker
 from oryx_tpu.analysis.metric_names import MetricNameChecker
 from oryx_tpu.analysis.recompile import RecompileHazardChecker
@@ -29,12 +30,30 @@ from oryx_tpu.analysis.swallow import SwallowedExceptionChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     LockDisciplineChecker,
+    LockOrderChecker,
+    AtomicityChecker,
     UseAfterDonateChecker,
     HostSyncChecker,
     RecompileHazardChecker,
     MetricNameChecker,
     SwallowedExceptionChecker,
 )
+
+# Fixture prefix -> the rule module whose behavior it pins. A change to
+# EITHER invalidates the `--changed-only` fast path: a rule edit can
+# introduce findings in files that did not change, and a fixture edit
+# means the rule's contract moved — both must lint (and be tested
+# against) the whole tree.
+FIXTURE_RULE_MODULES: dict[str, str] = {
+    "lock": "locks.py",
+    "lockorder": "lockorder.py",
+    "atomicity": "lockorder.py",
+    "donate": "donation.py",
+    "hostsync": "hostsync.py",
+    "recompile": "recompile.py",
+    "metric": "metric_names.py",
+    "swallow": "swallow.py",
+}
 
 # Directories that are not our python (vendored assets, fixtures that
 # are DELIBERATELY dirty, caches).
@@ -56,9 +75,16 @@ def default_files(root: str) -> list[str]:
     return out
 
 
-def changed_files(root: str) -> list[str]:
+def changed_files(root: str) -> list[str] | None:
     """Working-tree python files touched vs HEAD (plus untracked) —
-    the `--changed-only` fast path for local pre-commit runs."""
+    the `--changed-only` fast path for local pre-commit runs.
+
+    Returns None ("check everything") when the change set invalidates
+    per-file checking: an edit to the linter itself
+    (oryx_tpu/analysis/*) or to a lint fixture (which pins a rule
+    module's contract, per FIXTURE_RULE_MODULES) can change findings
+    in files that did not change, so the fast path must widen to the
+    full tree instead of silently passing."""
     files: set[str] = set()
     for cmd in (
         ["git", "diff", "--name-only", "HEAD"],
@@ -70,11 +96,30 @@ def changed_files(root: str) -> list[str]:
                 timeout=30, check=True,
             )
         except (OSError, subprocess.SubprocessError):
-            return default_files(root)  # no git: fall back to full
+            return None  # no git: fall back to full
         files.update(
             line.strip() for line in res.stdout.splitlines()
             if line.strip().endswith(".py")
         )
+    rule_modules = set()
+    for f in files:
+        norm = f.replace(os.sep, "/")
+        base = os.path.basename(norm)
+        if "oryx_tpu/analysis/" in norm or norm.endswith(
+            "scripts/run_oryxlint.py"
+        ):
+            return None
+        if "lint_fixtures/" in norm:
+            prefix = base.removesuffix(".py")
+            for suffix in ("_pos", "_suppressed", "_clean"):
+                prefix = prefix.removesuffix(suffix)
+            rule_modules.add(
+                FIXTURE_RULE_MODULES.get(prefix, base)
+            )
+    if rule_modules:
+        # A fixture changed -> its rule module's contract changed ->
+        # same blast radius as editing the rule module itself.
+        return None
     allowed = set(default_files(root))
     return sorted(
         p
@@ -118,9 +163,12 @@ def main(argv: list[str] | None = None) -> int:
         prog="run_oryxlint.py",
         description=(
             "oryxlint: JAX-aware static analysis (lock-discipline, "
-            "use-after-donate, host-sync, recompile-hazard, "
-            "metric-name). Exits 1 on any finding; --strict (the CI "
-            "gate) additionally fails on files that don't parse."
+            "lock-order, atomicity, use-after-donate, host-sync, "
+            "recompile-hazard, metric-name, swallowed-exception). "
+            "Exits 1 on any finding; --strict (the CI gate) "
+            "additionally fails on files that don't parse; "
+            "--max-suppressions N fails when justified suppressions "
+            "exceed the recorded ratchet."
         ),
     )
     parser.add_argument(
@@ -153,6 +201,17 @@ def main(argv: list[str] | None = None) -> int:
         "--list-rules", action="store_true",
         help="print rule ids and exit",
     )
+    parser.add_argument(
+        "--max-suppressions", type=int, default=None, metavar="N",
+        help="fail (exit 1) when more than N findings are suppressed "
+        "via `# oryxlint: disable=` — the CI ratchet that keeps "
+        "justified escapes from silently accumulating",
+    )
+    parser.add_argument(
+        "--json-out", default=None, metavar="PATH",
+        help="also write the JSON report to PATH (the CI artifact; "
+        "stdout keeps whichever format --json selects)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -177,9 +236,12 @@ def main(argv: list[str] | None = None) -> int:
         # Findings only for changed files, but the scan pass must see
         # the WHOLE tree: the donation registry and metric kind map are
         # cross-module, and a changed caller of an unchanged donating
-        # callee must still lint correctly.
+        # callee must still lint correctly. changed_files returns None
+        # when the linter or a fixture changed — then the fast path
+        # widens to a full check.
         files = default_files(root)
-        check_only = set(changed_files(root))
+        changed = changed_files(root)
+        check_only = None if changed is None else set(changed)
     else:
         files = default_files(root)
 
@@ -187,8 +249,24 @@ def main(argv: list[str] | None = None) -> int:
         _sources(files), make_checkers(args.rules), check_only=check_only
     )
     print(render_json(result) if args.as_json else render_text(result))
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            f.write(render_json(result) + "\n")
+    rc = 0
     if result.findings:
-        return 1
+        rc = 1
     if args.strict and result.errors:
-        return 1
-    return 0
+        rc = 1
+    if (
+        args.max_suppressions is not None
+        and result.suppressed > args.max_suppressions
+    ):
+        print(
+            f"oryxlint: {result.suppressed} suppressions exceed the "
+            f"--max-suppressions ratchet ({args.max_suppressions}); "
+            "either fix the new site or consciously bump the ratchet "
+            "in scripts/check_tier1.sh with a justification",
+            file=sys.stderr,
+        )
+        rc = 1
+    return rc

@@ -65,6 +65,8 @@ from typing import Any
 
 import numpy as np
 
+from oryx_tpu.analysis import sanitizers
+from oryx_tpu.analysis.sanitizers import named_lock
 from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 
@@ -200,21 +202,35 @@ class EngineSupervisor(threading.Thread):
         self.scheduler = scheduler
         # The scheduler queues through an engine-death window only
         # while someone is committed to reviving it; submit() rejects
-        # on a dead engine otherwise.
-        scheduler.supervised = True
+        # on a dead engine otherwise. (set_supervised takes _cond —
+        # the flag is read by submit under the same lock.)
+        scheduler.set_supervised(True)
         self.poll_s = poll_s
         self.max_restarts = max_restarts
         self.window_s = window_s
-        self.gave_up = False
-        self._stop = threading.Event()
-        self._restart_times: list[float] = []
+        # Written by this thread at give-up, read by /readyz handler
+        # threads: an Event, not a bare bool.
+        self._gave_up = threading.Event()
+        # NOT named `_stop`: threading.Thread has a private _stop()
+        # METHOD that is_alive() calls internally — shadowing it with
+        # an Event makes is_alive() raise TypeError once the thread
+        # finishes (latent since PR 6; surfaced by the armed race
+        # detector calling is_alive() on prior accessor threads).
+        self._halt = threading.Event()
+        # Only the supervisor thread prunes/appends the restart
+        # window after construction.
+        self._restart_times: list[float] = []  # thread-owned: supervisor
+
+    @property
+    def gave_up(self) -> bool:
+        return self._gave_up.is_set()
 
     def stop(self) -> None:
-        self.scheduler.supervised = False
-        self._stop.set()
+        self.scheduler.set_supervised(False)
+        self._halt.set()
 
     def run(self) -> None:
-        while not self._stop.wait(self.poll_s):
+        while not self._halt.wait(self.poll_s):
             s = self.scheduler
             if s.stopping:
                 return  # deliberate shutdown/drain: nothing to revive
@@ -230,8 +246,8 @@ class EngineSupervisor(threading.Thread):
                 # (submit rejects once `supervised` clears), and fail
                 # every stranded request — a hung client is worse
                 # than a 503.
-                self.gave_up = True
-                s.supervised = False
+                self._gave_up.set()
+                s.set_supervised(False)
                 try:
                     s.fail_inflight(
                         "engine dead (supervisor gave up after "
@@ -330,7 +346,7 @@ class Batcher:
         self.pipe = pipe
         self.window = window
         self.max_batch = max_batch
-        self.device_lock = device_lock or threading.Lock()
+        self.device_lock = device_lock or threading.Lock()  # lock-name: server.stream_lock
         self.metrics = metrics or ServingMetrics()
         # Same span vocabulary as the continuous scheduler (queue_wait /
         # decode / emission in one "decode" window here), so /debug
@@ -600,6 +616,13 @@ def build_server(
             "--request-timeout requires --engine continuous (the "
             "window batcher does not enforce per-request deadlines)"
         )
+    # $ORYX_LOCK_SANITIZER=1 arms the lock-order sanitizer + race
+    # detector for this server (chaos/test runs). Armed BEFORE the
+    # metrics registry and scheduler are built so every named lock
+    # they create is instrumented; the registry is bound right after
+    # so the oryx_lock_{wait,hold}_seconds histograms flush into
+    # /metrics.
+    sanitizers.maybe_arm_from_env()
     metrics = ServingMetrics()
     metrics.set_info("build_info", {
         "revision": _git_revision(), "engine": engine,
@@ -607,6 +630,7 @@ def build_server(
     })
     if faults.armed():
         faults.bind_registry(metrics.registry)
+    sanitizers.bind_lock_metrics(metrics.registry)
     anomaly = AnomalyMonitor(
         source="serve",
         thresholds=AnomalyThresholds(
@@ -624,7 +648,9 @@ def build_server(
     # device, one program at a time) — streaming requests serialize with
     # each other and with the batcher through this lock. (Continuous
     # engine: the scheduler thread owns the device; no lock needed.)
-    stream_lock = threading.Lock()
+    # First in the declared lock order: it is held across whole decode
+    # streams, so nothing else may be held when taking it.
+    stream_lock = named_lock("server.stream_lock")
     batcher = scheduler = supervisor = None
     # Drain state shared across handler threads: set once by
     # begin_drain(), read by /readyz and every POST.

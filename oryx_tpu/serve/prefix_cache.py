@@ -163,9 +163,18 @@ class PagedPrefixCache:
     def __init__(self, allocator, *, metrics=None):
         self.allocator = allocator
         self.page_size = allocator.page_size
-        self.trie = TokenTrie(allocator.page_size)
+        # No locks BY DESIGN: the cache (trie + page accounting) is
+        # engine-thread-owned — admission splice, insert-at-donate,
+        # LRU eviction and clear all run on the engine loop. That
+        # ownership is not folklore: the `# thread-owned:` annotations
+        # are enforced by the armed race detector
+        # (analysis/sanitizers.py), which flags any touch from a
+        # second live thread. The supervisor/drain paths may rebuild
+        # the cache only once the engine thread is dead (thread death
+        # is the happens-before edge the detector honors).
+        self.trie = TokenTrie(allocator.page_size)  # thread-owned: engine
         self.metrics = metrics
-        self._pages = 0
+        self._pages = 0  # thread-owned: engine
         # Publish zeros now: a cache rebuilt after a pool reset must not
         # leave the gauges reporting the dead pool's values.
         self._gauges()

@@ -98,6 +98,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oryx_tpu.analysis.sanitizers import (
+    hot_dispatch,
+    named_lock,
+    race_exempt,
+)
 from oryx_tpu.models import generate as generate_lib
 from oryx_tpu.models import oryx, qwen2
 from oryx_tpu.ops import paged_kv
@@ -220,11 +225,16 @@ class _Request:
     # (replay steps included: eviction overhead is still cost), and
     # the pages-held x wall-time integral in page-seconds. Wall-time
     # phase attribution comes from the trace spans at finalization.
-    cost_prefill_tokens: int = 0
-    cost_cached_tokens: int = 0
-    cost_decode_steps: int = 0
-    cost_page_seconds: float = 0.0
-    pages_t: float = 0.0  # last page-seconds accrual (0 = never held)
+    # thread-owned: engine — after submit() hands the request to the
+    # queue, only the engine thread accumulates cost (the HTTP side
+    # reads the finalized dict in handle.debug["cost"], never these);
+    # the supervisor/drain paths touch them only once the engine
+    # thread is dead (the race detector's handoff rule).
+    cost_prefill_tokens: int = 0  # thread-owned: engine
+    cost_cached_tokens: int = 0  # thread-owned: engine
+    cost_decode_steps: int = 0  # thread-owned: engine
+    cost_page_seconds: float = 0.0  # thread-owned: engine
+    pages_t: float = 0.0  # last accrual (0 = never held) # thread-owned: engine
     # Span handles into `trace` for regions that outlive one method:
     # queue_wait opens at submit (and again at eviction), admission
     # opens when the request reaches the queue head. -1 = not open.
@@ -368,7 +378,7 @@ class ContinuousScheduler:
         # them happens under the condition's lock.
         self.slots: list[_Request | None] = [None] * S
         self._queue: deque[_Request] = deque()  # guarded-by: _cond
-        self._cond = threading.Condition()
+        self._cond = named_lock("scheduler._cond", kind="condition")
         self._shutdown = False  # guarded-by: _cond
         self._draining = False  # guarded-by: _cond
         self._admit_seq = 0
@@ -392,11 +402,13 @@ class ContinuousScheduler:
         self.restarts = 0
         # Dead-engine admission guard: once the loop has STARTED, a
         # dead thread with nobody to revive it (no EngineSupervisor —
-        # which sets `supervised` — or one that gave up and cleared
-        # it) must reject new work instead of queueing requests whose
-        # handles can never complete.
+        # which calls set_supervised(True) — or one that gave up and
+        # cleared it) must reject new work instead of queueing
+        # requests whose handles can never complete. Written by the
+        # supervisor's thread, read by submit(): under _cond on both
+        # sides.
         self._started = False
-        self.supervised = False
+        self.supervised = False  # guarded-by: _cond
         # Flight recorder of the last N requests (shared with the API
         # server's /debug endpoints when it passes its own tracer) plus
         # an optional stall watchdog: no decode chunk completing within
@@ -408,11 +420,31 @@ class ContinuousScheduler:
             self.watchdog = trace_lib.StallWatchdog(
                 self.tracer, stall_timeout, name="continuous-scheduler"
             ).start()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # The thread NAME is part of the concurrency model
+        # (oryx_tpu/concurrency.py): `# thread-owned: engine` state
+        # belongs to it, and the race detector's reports name it.
+        self._thread = threading.Thread(
+            target=self._run, name="oryx-engine", daemon=True
+        )
         if autostart:
             self._thread.start()
 
     # ---- public API ------------------------------------------------------
+
+    def set_supervised(self, value: bool) -> None:
+        """EngineSupervisor attach/detach. Under _cond like every other
+        reader/writer of the flag: a race between the supervisor's
+        give-up and a submit() would otherwise queue a request nobody
+        will ever complete."""
+        with self._cond:
+            self.supervised = value
+
+    def queue_len(self) -> int:
+        """Admission-queue depth, under the lock (tests and debug
+        endpoints must not peek at `_queue` bare — the race detector
+        enforces exactly that when armed)."""
+        with self._cond:
+            return len(self._queue)
 
     def start(self) -> None:
         if not self._thread.is_alive():
@@ -640,7 +672,9 @@ class ContinuousScheduler:
             "engine thread restarted (#%d): %d request(s) requeued "
             "for replay", self.restarts, len(live),
         )
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="oryx-engine", daemon=True
+        )
         self._thread.start()
 
     # ---- slot bookkeeping ------------------------------------------------
@@ -674,14 +708,18 @@ class ContinuousScheduler:
         """Every page is either free or exactly accounted to its holders
         (slot block tables + the prefix cache); raises RuntimeError with
         the offending page on leak/double-hold. Cheap enough to call
-        from tests after any workload."""
-        holders = [
-            [int(p) for p in self.bt[s] if p != self._sentinel]
-            for s in range(self.num_slots)
-        ]
-        if self.prefix_cache is not None:
-            holders.append(self.prefix_cache.held_pages())
-        self.allocator.check_invariant(holders)
+        from tests after any workload. Callers assert quiescence by
+        contract (tests between bursts, the engine between chunks), so
+        the cross-thread reads of engine-owned structures here are
+        declared exempt to the armed race detector."""
+        with race_exempt("pool-invariant check: caller asserts quiescence"):
+            holders = [
+                [int(p) for p in self.bt[s] if p != self._sentinel]
+                for s in range(self.num_slots)
+            ]
+            if self.prefix_cache is not None:
+                holders.append(self.prefix_cache.held_pages())
+            self.allocator.check_invariant(holders)
 
     def _held(self, s: int) -> int:
         return int((self.bt[s] != self._sentinel).sum())
@@ -1018,7 +1056,12 @@ class ContinuousScheduler:
                 req = self._queue[0]
             if req.handle.cancelled:
                 with self._cond:
-                    self._queue.popleft()
+                    # Safe check-then-act: the engine thread is the
+                    # queue's ONLY consumer (submit appends at the
+                    # tail; restart appendlefts only once this thread
+                    # is dead), so the head peeked above cannot have
+                    # changed.
+                    self._queue.popleft()  # oryxlint: disable=atomicity
                     depth = len(self._queue)
                     # Every pop must refresh the gauge: without this a
                     # pre-admission cancel left queue_depth one high
@@ -1101,7 +1144,9 @@ class ContinuousScheduler:
                         )
                 except Exception as e:
                     with self._cond:
-                        self._queue.popleft()
+                        # Single-consumer head pop (see the cancel
+                        # branch above).
+                        self._queue.popleft()  # oryxlint: disable=atomicity
                         depth = len(self._queue)
                         self.metrics.set_gauge("queue_depth", depth)
                     if self.anomaly is not None:
@@ -1131,7 +1176,8 @@ class ContinuousScheduler:
             if not self._splice_and_grow(s, req):
                 break
             with self._cond:
-                self._queue.popleft()
+                # Single-consumer head pop (see the cancel branch).
+                self._queue.popleft()  # oryxlint: disable=atomicity
                 depth = len(self._queue)
                 self.metrics.set_gauge("queue_depth", depth)
             if self.anomaly is not None:
@@ -1280,6 +1326,7 @@ class ContinuousScheduler:
         # Chaos site: prefill dispatch failure/stall. A raise here is
         # contained by _run's catch-all (requests errored, pool reset).
         faults.fault_point("prefill_dispatch")
+        hot_dispatch("scheduler._advance_prefill")
         B1 = np.newaxis
         off = req.prefill_pos
         L = req.length
@@ -1470,6 +1517,10 @@ class ContinuousScheduler:
         # (delay= -> the stall watchdog and per-request deadlines are
         # what bound it).
         faults.fault_point("decode_dispatch")
+        # Armed sanitizer: a decode dispatch entered while ANY lock is
+        # held would serialize submit()/scrapes/debug reads on device
+        # latency — the runtime twin of the static hot-path rule.
+        hot_dispatch("scheduler._step_chunk")
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
         with self.pipe._mesh_scope():

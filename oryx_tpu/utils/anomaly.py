@@ -55,6 +55,8 @@ import time
 from collections import deque
 from typing import Any
 
+from oryx_tpu.analysis.sanitizers import named_lock
+
 _LOG = logging.getLogger("oryx.anomaly")
 
 
@@ -161,7 +163,7 @@ class AnomalyMonitor:
         self.recent: deque[AnomalyEvent] = deque(maxlen=keep)
         self.counts: dict[str, int] = {}
         self.total = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("anomaly._lock")
         self._f = None
         if self.events_path:
             os.makedirs(os.path.dirname(self.events_path), exist_ok=True)

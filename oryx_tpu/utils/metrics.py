@@ -155,7 +155,7 @@ class Counter:
 
     def __init__(self, lock):
         self.value = 0.0
-        self._lock = lock
+        self._lock = lock  # lock-name: metrics.family
 
     def inc(self, n: float = 1) -> None:
         if n < 0:
@@ -171,7 +171,7 @@ class Gauge:
 
     def __init__(self, lock):
         self.value = 0.0
-        self._lock = lock
+        self._lock = lock  # lock-name: metrics.family
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -190,13 +190,13 @@ class Histogram:
     __slots__ = ("buckets", "counts", "total", "sum", "_lock")
 
     def __init__(self, buckets: tuple[float, ...], lock=None):
-        import threading
+        from oryx_tpu.analysis.sanitizers import named_lock
 
         self.buckets = tuple(sorted(buckets))
         self.counts = [0] * len(self.buckets)
         self.total = 0
         self.sum = 0.0
-        self._lock = lock or threading.Lock()
+        self._lock = lock or named_lock("metrics.family")
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -230,13 +230,13 @@ class MetricFamily:
                  labelnames: tuple[str, ...] = (),
                  buckets: tuple[float, ...] | None = None,
                  lock=None):
-        import threading
+        from oryx_tpu.analysis.sanitizers import named_lock
 
         self.name = name
         self.mtype = mtype
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(sorted(buckets)) if buckets else None
-        self._lock = lock or threading.Lock()
+        self._lock = lock or named_lock("metrics.family")
         self._children: dict[tuple[str, ...], Any] = {}
         if not self.labelnames:
             self._children[()] = self._make_child()
@@ -307,13 +307,13 @@ class Registry:
     sampler thread."""
 
     def __init__(self, prefix: str = ""):
-        import threading
+        from oryx_tpu.analysis.sanitizers import named_lock
 
         self.prefix = prefix
-        self._lock = threading.Lock()
-        self._families: dict[str, MetricFamily] = {}
-        self._info_names: set[str] = set()
-        self._collectors: list[Any] = []
+        self._lock = named_lock("registry._lock")
+        self._families: dict[str, MetricFamily] = {}  # guarded-by: _lock
+        self._info_names: set[str] = set()  # guarded-by: _lock
+        self._collectors: list[Any] = []  # guarded-by: _lock
 
     def full_name(self, name: str, raw_name: bool = False) -> str:
         return name if (raw_name or not self.prefix) \
@@ -427,6 +427,12 @@ PER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # shows whether chunked prefill is actually bounding admission work.
 PREFILL_CHUNK_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                          256.0, 512.0, 1024.0, 2048.0, 4096.0)
+# Lock wait/hold times for the LockOrderSanitizer's
+# oryx_lock_{wait,hold}_seconds{lock=} histograms: microseconds (the
+# healthy regime for every lock in the declared order) up to the one
+# second that would mean a lock is held across device work.
+LOCK_SECONDS_BUCKETS = (1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3,
+                        5e-3, 0.025, 0.1, 0.5, 1.0)
 
 # Per-request cost-ledger ladders (the `oryx_serving_request_*` families
 # the continuous scheduler observes when a request reaches any terminal

@@ -46,6 +46,8 @@ import uuid
 from collections import deque
 from typing import Any, Iterable, Iterator
 
+from oryx_tpu.analysis.sanitizers import named_lock
+
 # perf_counter anchored to the wall clock once at import: spans get the
 # monotonicity of perf_counter AND absolute unix-ns starts comparable
 # across processes and to xplane device timestamps.
@@ -111,7 +113,7 @@ class Trace:
         # access to the lock.
         self.spans: list[Span] = []  # guarded-by: _lock
         self._stack: list[int] = []  # open-span indices # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = named_lock("trace._lock")
 
     # ---- recording -------------------------------------------------------
 
@@ -254,7 +256,7 @@ class Tracer:
         # deque on the very first start_trace (and a recorder that
         # records nothing has no disable semantics worth supporting).
         self.capacity = max(1, capacity)
-        self._lock = threading.Lock()
+        self._lock = named_lock("tracer._lock")
         self._traces: deque[Trace] = deque(maxlen=self.capacity)  # guarded-by: _lock
         self._by_id: dict[str, Trace] = {}  # guarded-by: _lock
 
@@ -414,7 +416,7 @@ class StallWatchdog:
         self._last_beat = time.perf_counter()  # guarded-by: _lock
         self._active = False  # guarded-by: _lock
         self._armed = True  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = named_lock("watchdog._lock")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"stall-watchdog-{name}", daemon=True

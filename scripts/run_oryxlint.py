@@ -4,8 +4,12 @@
     python scripts/run_oryxlint.py                 # report, exit 1 on findings
     python scripts/run_oryxlint.py --strict        # CI gate (also fails on
                                                    # parse errors)
-    python scripts/run_oryxlint.py --changed-only  # fast local loop
+    python scripts/run_oryxlint.py --changed-only  # fast local loop (widens
+                                                   # to the full tree when the
+                                                   # linter/fixtures changed)
     python scripts/run_oryxlint.py --json path.py  # machine-readable
+    python scripts/run_oryxlint.py --max-suppressions 25 \
+        --json-out /tmp/oryxlint_report.json       # CI ratchet + artifact
 
 The linter is pure-AST and must start fast in images without the
 accelerator stack, so the real `oryx_tpu/__init__` (which imports jax)

@@ -300,3 +300,46 @@ def _opt_in_recompile_watchdog(request):
     budget = int(spec) if spec.isdigit() and int(spec) > 1 else 16
     with recompile_watchdog(budget=budget, action="raise"):
         yield
+
+
+# ---------------------------------------------------------------------------
+# Opt-in lock-order sanitizer + race detector for the concurrency
+# suites: ORYX_LOCK_SANITIZER=1 (same 0/off/false convention) arms
+# both for the scheduler/containment/trace/metrics/prefix-cache tests
+# — every named lock created during the test is instrumented (ordering
+# violations and guarded-field races raise at the faulty access), and
+# the fixture additionally fails the test if anything was RECORDED but
+# swallowed by failure containment (an engine-thread violation turns
+# into a contained request error; the assert here keeps it loud).
+# check_tier1.sh runs these files a second time with the variable set.
+# ---------------------------------------------------------------------------
+
+_LOCK_SAN_FILES = (
+    "test_scheduler.py",
+    "test_containment.py",
+    "test_trace.py",
+    "test_metrics_registry.py",
+    "test_prefix_cache.py",
+)
+
+
+@pytest.fixture(autouse=True)
+def _opt_in_lock_sanitizer(request):
+    spec = os.environ.get("ORYX_LOCK_SANITIZER", "").strip().lower()
+    if spec in ("", "0", "off", "false") or os.path.basename(
+        str(request.fspath)
+    ) not in _LOCK_SAN_FILES:
+        yield
+        return
+    from oryx_tpu.analysis.sanitizers import lock_sanitizer, race_violations
+
+    with lock_sanitizer(action="raise") as san:
+        yield
+        assert not san.stats.violations, (
+            "lock-order sanitizer recorded violations during this "
+            f"test: {san.stats.violations}"
+        )
+        assert not race_violations(), (
+            "race detector recorded violations during this test: "
+            f"{race_violations()}"
+        )

@@ -1,0 +1,25 @@
+"""atomicity clean fixture: the check and the act share one critical
+section (and a read-only second block is fine) — zero findings, zero
+suppressions."""
+
+import threading
+from collections import deque
+
+
+class Sched:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = deque()  # guarded-by: _cond
+
+    def check_and_act_atomically(self):
+        with self._cond:
+            if not self._queue:
+                return
+            self._queue.popleft()
+
+    def read_only_after_check(self):
+        with self._cond:
+            if not self._queue:
+                return
+        with self._cond:
+            return len(self._queue)

@@ -1,0 +1,33 @@
+"""atomicity positive fixture: check-then-act on guarded fields with
+the lock released between the check and the dependent mutation — the
+early-exit shape and the escaped-local shape."""
+
+import threading
+from collections import deque
+
+
+class Sched:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = deque()  # guarded-by: _cond
+
+    def early_exit_check_then_act(self):
+        with self._cond:
+            if not self._queue:
+                return
+        prep = len("prompt prep outside the lock")
+        with self._cond:
+            self._queue.popleft()  # expect: atomicity
+        return prep
+
+    def escaped_guard(self):
+        with self._cond:
+            depth = len(self._queue)
+        if depth > 4:
+            with self._cond:
+                self._queue.clear()  # expect: atomicity
+
+    def fine_same_block(self):
+        with self._cond:
+            if self._queue:
+                self._queue.popleft()

@@ -1,0 +1,34 @@
+"""lock-order clean fixture: declared-order-respecting nesting, a
+call chain that inherits the held set without inverting anything, and
+an unlocked hot-path dispatch — zero findings, zero suppressions."""
+
+from oryx_tpu.analysis.sanitizers import named_lock
+
+# lock-order: outer._lock < inner._lock < leaf._lock
+
+
+class Engine:
+    def __init__(self):
+        self._outer = named_lock("outer._lock")
+        self._inner = named_lock("inner._lock")
+        self._leaf = named_lock("leaf._lock")
+
+    def nested_in_order(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def call_inherits_held_set(self):
+        with self._inner:
+            self.take_leaf()
+
+    def take_leaf(self):
+        with self._leaf:
+            pass
+
+    # hot-path
+    def dispatch(self):
+        return 1
+
+    def unlocked_dispatch(self):
+        return self.dispatch()

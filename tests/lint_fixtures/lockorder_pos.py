@@ -1,0 +1,54 @@
+"""lock-order positive fixture: every `# expect:` line must yield
+exactly one finding — an inversion (direct and via a call), an
+unranked cycle, a hot-path dispatch under a lock, and a conflicting
+manifest declaration."""
+
+from oryx_tpu.analysis.sanitizers import named_lock
+
+# lock-order: alpha._lock < beta._lock < gamma._lock
+# lock-order: beta._lock < alpha._lock  # expect: lock-order
+
+
+class Engine:
+    def __init__(self):
+        self._alpha = named_lock("alpha._lock")
+        self._beta = named_lock("beta._lock")
+        self._gamma = named_lock("gamma._lock")
+        self._p = named_lock("p._lock")
+        self._q = named_lock("q._lock")
+
+    def fine(self):
+        with self._alpha:
+            with self._beta:
+                pass
+
+    def inverted(self):
+        with self._beta:
+            with self._alpha:  # expect: lock-order
+                pass
+
+    def inverted_via_call(self):
+        with self._gamma:
+            self.take_beta()  # expect: lock-order
+
+    def take_beta(self):
+        with self._beta:
+            pass
+
+    def cycle_one(self):
+        with self._p:
+            with self._q:  # expect: lock-order
+                pass
+
+    def cycle_two(self):
+        with self._q:
+            with self._p:
+                pass
+
+    # hot-path
+    def dispatch(self):
+        return 1
+
+    def locked_dispatch(self):
+        with self._alpha:
+            self.dispatch()  # expect: lock-order

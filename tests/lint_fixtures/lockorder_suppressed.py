@@ -1,0 +1,27 @@
+"""lock-order suppressed fixture: the inversion and the under-lock
+hot dispatch are real, but each site carries a justified per-line
+suppression — zero findings, nonzero suppressed count."""
+
+from oryx_tpu.analysis.sanitizers import named_lock
+
+# lock-order: one._lock < two._lock
+
+
+class Engine:
+    def __init__(self):
+        self._one = named_lock("one._lock")
+        self._two = named_lock("two._lock")
+
+    def inverted_but_justified(self):
+        # Fictional justification: startup-only path, single-threaded.
+        with self._two:
+            with self._one:  # oryxlint: disable=lock-order
+                pass
+
+    # hot-path
+    def dispatch(self):
+        return 1
+
+    def locked_dispatch(self):
+        with self._one:
+            self.dispatch()  # oryxlint: disable=lock-order

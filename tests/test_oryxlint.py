@@ -222,12 +222,143 @@ def test_cli_clean_fixture_exits_zero_and_json_shape():
     assert payload["files"] == 1
 
 
-def test_cli_list_rules_names_all_five():
+def test_lockorder_interprocedural_across_modules():
+    """The may-acquire-while-holding graph must cross module AND call
+    boundaries: holding s._cond while calling a method (of a typed
+    attribute, defined in another file) that acquires t._lock is an
+    inversion when the manifest says t._lock < s._cond."""
+    defs = (
+        "from oryx_tpu.analysis.sanitizers import named_lock\n"
+        "class Trace:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('t._lock')\n"
+        "    def finish(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    caller = (
+        "# lock-order: t._lock < s._cond\n"
+        "from oryx_tpu.analysis.sanitizers import named_lock\n"
+        "from defs import Trace\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._cond = named_lock('s._cond', kind='condition')\n"
+        "        self.trace = Trace()\n"
+        "    def run(self):\n"
+        "        with self._cond:\n"
+        "            self.trace.finish()\n"
+    )
+    res = lint_sources(
+        ("defs.py", defs), ("caller.py", caller), rules="lock-order"
+    )
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    f = res.findings[0]
+    assert f.path == "caller.py" and "inverts" in f.message
+    assert "t._lock" in f.message and "finish" in f.message
+    # Reordering the manifest legalizes the same nesting.
+    fixed = caller.replace(
+        "# lock-order: t._lock < s._cond",
+        "# lock-order: s._cond < t._lock",
+    )
+    res = lint_sources(
+        ("defs.py", defs), ("caller.py", fixed), rules="lock-order"
+    )
+    assert not res.findings, [f.format() for f in res.findings]
+
+
+def test_cli_list_rules_names_all_rules():
     out = _cli("--list-rules")
     assert out.returncode == 0
-    for rule in ("lock-discipline", "use-after-donate", "host-sync",
-                 "recompile-hazard", "metric-name"):
+    for rule in ("lock-discipline", "lock-order", "atomicity",
+                 "use-after-donate", "host-sync", "recompile-hazard",
+                 "metric-name", "swallowed-exception"):
         assert rule in out.stdout
+
+
+def test_cli_max_suppressions_ratchet(tmp_path):
+    """`--max-suppressions N` is the CI ratchet: a file whose
+    suppression count exceeds N exits 1 even with zero findings."""
+    path = FIXTURES / "atomicity_suppressed.py"
+    ok = _cli(str(path), "--max-suppressions", "5")
+    assert ok.returncode == 0, (ok.stdout, ok.stderr)
+    over = _cli(str(path), "--max-suppressions", "0")
+    assert over.returncode == 1
+    assert "exceed the --max-suppressions ratchet" in (
+        over.stdout + over.stderr
+    )
+
+
+def test_cli_json_out_writes_artifact(tmp_path):
+    """`--json-out` writes the machine-readable report (the CI
+    artifact) regardless of the stdout format."""
+    report = tmp_path / "report.json"
+    path = FIXTURES / "lockorder_pos.py"
+    out = _cli(str(path), "--json-out", str(report))
+    assert out.returncode == 1  # findings still fail the run
+    payload = json.loads(report.read_text())
+    assert payload["files"] == 1
+    assert {f["rule"] for f in payload["findings"]} == {"lock-order"}
+    assert "[lock-order]" in out.stdout  # stdout stayed human-readable
+
+
+def test_changed_files_widens_on_linter_or_fixture_change(monkeypatch):
+    """The --changed-only fast path must widen to a full check when a
+    rule module OR a lint fixture changed: either can move findings in
+    files that did not change (fixtures pin a rule's contract via
+    FIXTURE_RULE_MODULES)."""
+    from oryx_tpu.analysis import runner
+
+    def fake_run(changed: list[str]):
+        def run(cmd, **kw):
+            out = "\n".join(changed) if "diff" in cmd else ""
+            return subprocess.CompletedProcess(cmd, 0, stdout=out,
+                                               stderr="")
+        return run
+
+    # A plain source change keeps the fast path narrow.
+    monkeypatch.setattr(
+        runner.subprocess, "run",
+        fake_run(["oryx_tpu/utils/trace.py"]),
+    )
+    narrow = runner.changed_files(str(ROOT))
+    assert narrow == [str(ROOT / "oryx_tpu" / "utils" / "trace.py")]
+    # A rule-module change invalidates per-file checking entirely.
+    monkeypatch.setattr(
+        runner.subprocess, "run",
+        fake_run(["oryx_tpu/analysis/lockorder.py"]),
+    )
+    assert runner.changed_files(str(ROOT)) is None
+    # So does a fixture change — the mapped rule module's contract
+    # moved even though the module file itself didn't.
+    monkeypatch.setattr(
+        runner.subprocess, "run",
+        fake_run(["tests/lint_fixtures/atomicity_pos.py",
+                  "oryx_tpu/utils/trace.py"]),
+    )
+    assert runner.changed_files(str(ROOT)) is None
+    # And the CLI entry point itself.
+    monkeypatch.setattr(
+        runner.subprocess, "run",
+        fake_run(["scripts/run_oryxlint.py"]),
+    )
+    assert runner.changed_files(str(ROOT)) is None
+
+
+def test_fixture_rule_map_covers_every_fixture_prefix():
+    """Every fixture on disk maps to a real rule module — a new rule's
+    fixtures can't silently fall out of the dependency map."""
+    from oryx_tpu.analysis.runner import FIXTURE_RULE_MODULES
+
+    analysis_dir = ROOT / "oryx_tpu" / "analysis"
+    for p in FIXTURES.glob("*.py"):
+        prefix = p.stem
+        for suffix in ("_pos", "_suppressed", "_clean"):
+            prefix = prefix.removesuffix(suffix)
+        assert prefix in FIXTURE_RULE_MODULES, (
+            f"{p.name}: fixture prefix {prefix!r} missing from "
+            "FIXTURE_RULE_MODULES"
+        )
+        assert (analysis_dir / FIXTURE_RULE_MODULES[prefix]).exists()
 
 
 def test_cli_unknown_rule_errors():
