@@ -15,6 +15,12 @@ Threads
   * **stall-watchdog / telemetry scrapes / debug endpoints**: read the
     tracer's flight recorder and the metrics registry under their own
     locks; they never touch engine-owned state.
+  * **router handlers + router-prober** (serve/router.py, its own
+    process in production): the replica table's mutable fields and
+    the affinity trie are shared between the proxy handler threads
+    and the prober, always under `router._lock` — which is held only
+    for table/trie edits, never across network I/O. A router process
+    holds no engine locks, ever.
 
 Lock acquisition order
 ----------------------
@@ -37,7 +43,7 @@ from __future__ import annotations
 
 # The manifest: one declaration, read by the static rule from this
 # comment and by the runtime sanitizer from the tuple beneath it.
-# lock-order: server.stream_lock < scheduler._cond < anomaly._lock < trace._lock < tracer._lock < watchdog._lock < registry._lock < metrics.family
+# lock-order: server.stream_lock < scheduler._cond < anomaly._lock < trace._lock < tracer._lock < watchdog._lock < router._lock < registry._lock < metrics.family
 LOCK_ORDER: tuple[str, ...] = (
     "server.stream_lock",   # window-engine device lock (api_server)
     "scheduler._cond",      # admission queue + control flags
@@ -45,6 +51,10 @@ LOCK_ORDER: tuple[str, ...] = (
     "trace._lock",          # one request's span list
     "tracer._lock",         # the flight recorder of traces
     "watchdog._lock",       # stall-watchdog beat state
+    "router._lock",         # front-end router replica table + affinity
+                            # trie (serve/router.py; a router process
+                            # never holds engine locks, but its metric
+                            # bumps nest under this)
     "registry._lock",       # metric family declaration/lookup
     "metrics.family",       # one family's children (innermost:
                             # metrics are bumped under everything)

@@ -725,6 +725,7 @@ def generate_paged(
     start: jnp.ndarray | None = None,
     return_state: bool = False,
     prefill_chunk: int | None = None,
+    mesh=None,
 ):
     """`generate`, but over a paged KV cache in `chunk`-step compiled
     dispatches — the reference driver for the continuous-batching path
@@ -746,7 +747,23 @@ def generate_paged(
     (kv_cache/start); pass the state from the previous turn and prefill
     only the suffix embeds. prefill_chunk: prefill in bounded windows
     via `paged_prefill_chunks` (bit-identical to single-shot; requires a
-    uniform `start` across rows)."""
+    uniform `start` across rows).
+
+    mesh: tensor-parallel decode. A fresh page pool is placed with KV
+    heads sharded over the mesh's tp axis
+    (parallel/sharding.shard_paged_kv) and every dispatch runs inside
+    the mesh scope, so GSPMD partitions attention by heads against
+    tp-sharded params (builder.serving_param_shardings). Greedy token
+    ids stay bit-identical to the single-device paged path: each shard
+    computes its own heads' attention exactly as before, and the only
+    cross-shard reduction (o_proj over heads) is the contraction the
+    sharded dense path already proves. Callers passing a prior `state`
+    own its placement."""
+    from oryx_tpu.parallel.sharding import mesh_scope, shard_paged_kv
+
+    def scope():
+        return mesh_scope(mesh)  # fresh context manager per dispatch
+
     B, T, _ = inputs_embeds.shape
     if key is None:
         key = jax.random.key(0)
@@ -770,10 +787,13 @@ def generate_paged(
             alloc_probe = paged_kv_lib.PageAllocator(1, page_size)
             num_pages = sum(alloc_probe.pages_for(n) for n in row_tokens)
         allocator = paged_kv_lib.PageAllocator(num_pages, page_size)
+        kv_pages = qwen2.init_paged_kv_cache(
+            cfg, num_pages, page_size, dtype=dtype
+        )
+        if mesh is not None:
+            kv_pages = shard_paged_kv(kv_pages, mesh)
         state = PagedState(
-            kv_pages=qwen2.init_paged_kv_cache(
-                cfg, num_pages, page_size, dtype=dtype
-            ),
+            kv_pages=kv_pages,
             block_tables=np.full((B, max_pages), allocator.sentinel,
                                  np.int32),
             allocator=allocator,
@@ -802,18 +822,20 @@ def generate_paged(
             raise ValueError(
                 f"prefill_chunk needs one shared start, got {sorted(starts)}"
             )
-        state.kv_pages, tok, row_keys = paged_prefill_chunks(
-            params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
-            starts.pop(), row_keys, temp, top_p, top_k,
-            prefill_chunk=prefill_chunk, attn_impl=attn_impl,
-            compute_dtype=compute_dtype,
-        )
+        with scope():
+            state.kv_pages, tok, row_keys = paged_prefill_chunks(
+                params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
+                starts.pop(), row_keys, temp, top_p, top_k,
+                prefill_chunk=prefill_chunk, attn_impl=attn_impl,
+                compute_dtype=compute_dtype,
+            )
     else:
-        state.kv_pages, tok, row_keys = paged_prefill(
-            params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
-            start_vec, row_keys, temp, top_p, top_k,
-            attn_impl=attn_impl, compute_dtype=compute_dtype,
-        )
+        with scope():
+            state.kv_pages, tok, row_keys = paged_prefill(
+                params, cfg, inputs_embeds, lengths, bt, state.kv_pages,
+                start_vec, row_keys, temp, top_p, top_k,
+                attn_impl=attn_impl, compute_dtype=compute_dtype,
+            )
     stop_L = 0 if stop_sequences is None else stop_sequences.shape[1]
     recent = jnp.full((B, stop_L), -2, jnp.int32)
     finished = jnp.zeros((B,), bool)
@@ -823,13 +845,14 @@ def generate_paged(
     fin_out = np.ones((B, padded_new), bool)
     done = 0
     while done < max_new_tokens:
-        (state.kv_pages, tok, cur_len, finished, recent, row_keys,
-         toks, fin) = paged_decode_chunk(
-            params, cfg, state.kv_pages, bt, tok, cur_len, finished,
-            recent, row_keys, temp, top_p, top_k, stop_sequences,
-            chunk=chunk, eos=eos, attn_impl=attn_impl,
-            compute_dtype=compute_dtype,
-        )
+        with scope():
+            (state.kv_pages, tok, cur_len, finished, recent, row_keys,
+             toks, fin) = paged_decode_chunk(
+                params, cfg, state.kv_pages, bt, tok, cur_len, finished,
+                recent, row_keys, temp, top_p, top_k, stop_sequences,
+                chunk=chunk, eos=eos, attn_impl=attn_impl,
+                compute_dtype=compute_dtype,
+            )
         # The once-per-chunk harvest this loop exists to amortize (and
         # the early-exit below needs host booleans).
         # oryxlint: off=host-sync

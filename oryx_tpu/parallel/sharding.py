@@ -202,6 +202,47 @@ def cast_params_for_compute(params: Params, dtype, mode: str = "fsdp"):
     return jax.tree.unflatten(treedef, out)
 
 
+def paged_kv_spec(mesh) -> P | None:
+    """PartitionSpec for a paged KV pool leaf
+    ([layers, pages, page_size, kv_heads, head_dim]) on `mesh`:
+    sharded along KV HEADS over the tp axis, replicated otherwise.
+
+    Heads is the one KV axis tensor parallelism can split without
+    changing any reduction: each tp shard holds its own heads' pages
+    end to end (write, gather, attention), and the only cross-shard
+    collective is o_proj's existing contraction over heads — so paged
+    decode on a tp mesh stays bit-identical per head to the
+    single-device path. Pages/page_size must NOT shard: block tables
+    index pages globally and a page-axis split would turn every
+    table-addressed write into a cross-device scatter. Returns None
+    (replicate) when the mesh has no tp axis or tp == 1 — an fsdp-only
+    serving mesh gathers weights but keeps the pool whole."""
+    if mesh is None or "tp" not in mesh.axis_names:
+        return None
+    if mesh.shape["tp"] <= 1:
+        return None
+    return P(None, None, None, "tp", None)
+
+
+def shard_paged_kv(kv_pages, mesh, *, num_kv_heads: int | None = None):
+    """Place a paged KV pytree (qwen2.init_paged_kv_cache leaves) on
+    `mesh` with heads sharded over tp (see `paged_kv_spec`). No-op —
+    the same pytree back — when the mesh doesn't split heads or the
+    head count doesn't divide (a 2-kv-head model on tp=4 serves with a
+    replicated pool rather than failing)."""
+    spec = paged_kv_spec(mesh)
+    if spec is None:
+        return kv_pages
+    heads = num_kv_heads
+    if heads is None:
+        leaf = jax.tree_util.tree_leaves(kv_pages)[0]
+        heads = leaf.shape[3]
+    if heads % mesh.shape["tp"]:
+        return kv_pages
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), kv_pages)
+
+
 def ambient_mesh():
     """The ambient named mesh, across JAX versions: the abstract mesh
     (jax >= 0.5, set via `jax.sharding.set_mesh`) or the thread-local

@@ -565,18 +565,26 @@ def build_server(
     degraded_cooldown: float = 30.0,
     supervise: bool = True,
     faults_spec: str | None = None,
+    replica_id: str | None = None,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline.
 
     engine: "window" groups non-streaming requests that arrive within
     `batch_window` into one decode and runs streams solo (the legacy
-    batcher); "continuous" routes EVERYTHING — streaming and not —
-    through the continuous-batching scheduler (serve/scheduler.py):
+    batcher); any other name resolves through the Engine registry
+    (serve/engine.py) — "continuous" routes EVERYTHING — streaming and
+    not — through the continuous-batching scheduler (serve/scheduler.py):
     a fixed slot array over a paged KV cache, admission at chunk
-    boundaries, per-slot sampling. Both engines export GET /metrics;
-    GET /readyz says whether the engine loop is actually alive (and,
-    continuous engine, un-stalled per the watchdog beat) so load
-    balancers never have to probe with real completions.
+    boundaries, per-slot sampling; "sharded" is the same scheduler with
+    a tensor-parallel mesh REQUIRED (KV pool heads-sharded over tp).
+    Every engine exports GET /metrics; GET /readyz reports the
+    engine's own readiness() (loop alive, un-stalled, not draining) so
+    load balancers never have to probe with real completions.
+
+    replica_id: this backend's identity in a multi-replica deployment
+    — lands as the `replica` label on build_info so the router's
+    aggregated scrape (serve/router.py /metrics/aggregate) can
+    distinguish backends even before it injects its own labels.
 
     ttft_slo / queue_depth_slo arm the serving anomaly detectors
     (utils/anomaly.py): breaches increment oryx_anomaly_total{kind=}
@@ -599,21 +607,20 @@ def build_server(
     if faults_spec:
         faults.configure(faults_spec)
 
-    if engine != "continuous" and (ttft_slo or queue_depth_slo):
-        # Only the continuous scheduler feeds the SLO detectors; a
+    if engine == "window" and (ttft_slo or queue_depth_slo):
+        # Only scheduler-family engines feed the SLO detectors; a
         # window-engine server accepting these flags would look armed
         # while every breach went unobserved.
         raise ValueError(
-            "--ttft-slo/--queue-depth-slo require --engine continuous "
+            "--ttft-slo/--queue-depth-slo require a scheduler engine "
             "(the window batcher does not feed the SLO detectors)"
         )
-    if engine != "continuous" and request_timeout:
+    if engine == "window" and request_timeout:
         # Same fail-fast contract for the containment knob: deadlines
-        # are enforced by the continuous engine loop; accepting the
-        # flag on the window batcher would promise 504s that never
-        # fire.
+        # are enforced by the engine loop; accepting the flag on the
+        # window batcher would promise 504s that never fire.
         raise ValueError(
-            "--request-timeout requires --engine continuous (the "
+            "--request-timeout requires a scheduler engine (the "
             "window batcher does not enforce per-request deadlines)"
         )
     # $ORYX_LOCK_SANITIZER=1 arms the lock-order sanitizer + race
@@ -624,10 +631,16 @@ def build_server(
     # /metrics.
     sanitizers.maybe_arm_from_env()
     metrics = ServingMetrics()
-    metrics.set_info("build_info", {
+    build_labels = {
         "revision": _git_revision(), "engine": engine,
         "model": model_name,
-    })
+    }
+    if replica_id:
+        # Multi-replica identity: the router's aggregated scrape keys
+        # backends on this label (and stamps its own replica= on every
+        # series it re-exports).
+        build_labels["replica"] = replica_id
+    metrics.set_info("build_info", build_labels)
     if faults.armed():
         faults.bind_registry(metrics.registry)
     sanitizers.bind_lock_metrics(metrics.registry)
@@ -655,11 +668,19 @@ def build_server(
     # Drain state shared across handler threads: set once by
     # begin_drain(), read by /readyz and every POST.
     draining = threading.Event()
-    if engine == "continuous":
-        from oryx_tpu.serve.scheduler import ContinuousScheduler
+    if engine == "window":
+        batcher = Batcher(
+            pipe, window=batch_window, max_batch=max_batch,
+            device_lock=stream_lock, metrics=metrics, tracer=tracer,
+        )
+    else:
+        from oryx_tpu.serve import engine as engine_lib
 
-        scheduler = ContinuousScheduler(
-            pipe, num_slots=num_slots, page_size=page_size,
+        # Engine registry (serve/engine.py): "continuous", "sharded",
+        # and whatever later shapes register — all drop-in behind this
+        # server and the supervisor through the Engine protocol.
+        scheduler = engine_lib.create_engine(
+            engine, pipe, num_slots=num_slots, page_size=page_size,
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
@@ -669,38 +690,28 @@ def build_server(
         if supervise:
             supervisor = EngineSupervisor(scheduler)
             supervisor.start()
-    elif engine == "window":
-        batcher = Batcher(
-            pipe, window=batch_window, max_batch=max_batch,
-            device_lock=stream_lock, metrics=metrics, tracer=tracer,
-        )
-    else:
-        raise ValueError(f"unknown engine {engine!r} (window|continuous)")
 
     def _ready() -> tuple[bool, str]:
         """Readiness = the engine loop is genuinely able to make
-        progress: not draining, engine thread alive, and — when a
-        watchdog is armed — no in-flight stall. A load balancer
-        probing this never has to spend a real completion; routers
-        eject a draining or crash-looping replica on this signal."""
+        progress. The engine's own readiness() (Engine protocol)
+        answers for drain/death/stall; the server layers on the two
+        things only it knows — a server-level drain begun before the
+        engine saw it, and a supervisor that gave up reviving. A load
+        balancer probing this never has to spend a real completion;
+        routers eject a draining or crash-looping replica on it."""
         if draining.is_set():
             return False, "draining"
         if scheduler is not None:
-            if not scheduler.alive():
-                if supervisor is not None and supervisor.gave_up:
-                    return False, (
-                        "engine dead (supervisor gave up after "
-                        f"{supervisor.max_restarts} restarts in "
-                        f"{supervisor.window_s:g}s)"
-                    )
-                return False, "scheduler loop dead"
-            wd = scheduler.watchdog
-            if wd is not None and wd.stalled():
+            if (
+                not scheduler.alive()
+                and supervisor is not None and supervisor.gave_up
+            ):
                 return False, (
-                    f"scheduler stalled (no decode beat in "
-                    f"{wd.deadline_s:g}s)"
+                    "engine dead (supervisor gave up after "
+                    f"{supervisor.max_restarts} restarts in "
+                    f"{supervisor.window_s:g}s)"
                 )
-            return True, "ok"
+            return scheduler.readiness()
         if not batcher._thread.is_alive():
             return False, "batcher loop dead"
         return True, "ok"
@@ -1181,12 +1192,23 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--batch-window", type=float, default=0.02)
     ap.add_argument("--max-batch", type=int, default=8)
+    from oryx_tpu.serve.engine import engine_names
+
     ap.add_argument(
-        "--engine", choices=["window", "continuous"], default="window",
+        "--engine", choices=["window"] + engine_names(),
+        default="window",
         help="request batching engine: the window batcher (group within "
-        "--batch-window) or the continuous-batching scheduler over a "
+        "--batch-window), the continuous-batching scheduler over a "
         "paged KV cache (admission at chunk boundaries, per-slot "
-        "sampling, GET /metrics occupancy)",
+        "sampling, GET /metrics occupancy), or sharded — the same "
+        "scheduler with a tensor-parallel mesh required (--shard tp=N; "
+        "KV pool sharded along heads)",
+    )
+    ap.add_argument(
+        "--replica-id", default=None,
+        help="this backend's identity behind serve/router.py: lands as "
+        "the replica label on build_info so aggregated scrapes "
+        "distinguish backends",
     )
     ap.add_argument(
         "--num-slots", type=int, default=4,
@@ -1299,6 +1321,8 @@ def main(argv: list[str] | None = None) -> None:
     args = ap.parse_args(argv)
     if args.quantize and args.shard:
         ap.error("--quantize is single-chip serving; drop --shard")
+    if args.engine == "sharded" and not args.shard:
+        ap.error("--engine sharded requires --shard tp=N")
 
     from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
@@ -1330,6 +1354,7 @@ def main(argv: list[str] | None = None) -> None:
         request_timeout=args.request_timeout,
         supervise=not args.no_supervisor,
         faults_spec=args.faults or os.environ.get("ORYX_FAULTS"),
+        replica_id=args.replica_id,
     )
 
     def _drain_and_exit() -> None:

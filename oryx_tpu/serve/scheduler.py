@@ -354,9 +354,9 @@ class ContinuousScheduler:
             if prefix_cache else None
         )
         dtype = oryx.compute_dtype(self.cfg)
-        self.kv_pages = qwen2.init_paged_kv_cache(
+        self.kv_pages = self._place_kv(qwen2.init_paged_kv_cache(
             self.cfg.llm, self.num_pages, page_size, dtype=dtype
-        )
+        ))
         S = num_slots
         self._sentinel = self.allocator.sentinel
         self.bt = np.full((S, self.max_pages), self._sentinel, np.int32)
@@ -429,7 +429,51 @@ class ContinuousScheduler:
         if autostart:
             self._thread.start()
 
-    # ---- public API ------------------------------------------------------
+    # ---- public API (the Engine protocol surface, serve/engine.py) -------
+
+    def _place_kv(self, kv_pages):
+        """Tensor-parallel placement of the paged pool: KV heads
+        sharded over the pipe mesh's tp axis (a no-op off-mesh, on an
+        fsdp-only mesh, or when heads don't divide). Every dispatch
+        already runs under `pipe._mesh_scope()`, so with the pool AND
+        the params placed, GSPMD partitions paged prefill/decode by
+        heads — each shard runs its own heads bit-identically to the
+        single-device path, and only o_proj's contraction crosses
+        shards. Applied at construction and every `_reset_pool`."""
+        mesh = getattr(self.pipe, "mesh", None)
+        if mesh is None:
+            return kv_pages
+        from oryx_tpu.parallel.sharding import shard_paged_kv
+
+        return shard_paged_kv(
+            kv_pages, mesh, num_kv_heads=self.cfg.llm.num_kv_heads
+        )
+
+    def readiness(self) -> tuple[bool, str]:
+        """(ready, reason): this engine can make progress — not
+        draining, loop thread alive, and (when a watchdog is armed) no
+        in-flight stall. The /readyz signal routers eject on."""
+        if self.draining:
+            return False, "draining"
+        if not self.alive():
+            return False, "scheduler loop dead"
+        wd = self.watchdog
+        if wd is not None and wd.stalled():
+            return False, (
+                f"scheduler stalled (no decode beat in {wd.deadline_s:g}s)"
+            )
+        return True, "ok"
+
+    def cancel(self, handle: RequestHandle) -> None:
+        """Cancel a submitted request wherever it lives; the engine
+        loop frees its slot/pages at the next harvest or prefill step
+        (same path a client disconnect takes)."""
+        handle.cancelled = True
+
+    def stop(self) -> None:
+        """Engine-protocol spelling of close(): stop the loop without
+        waiting for resident requests (drain() is the graceful twin)."""
+        self.close()
 
     def set_supervised(self, value: bool) -> None:
         """EngineSupervisor attach/detach. Under _cond like every other
@@ -692,10 +736,10 @@ class ContinuousScheduler:
             self.prefix_cache = PagedPrefixCache(
                 self.allocator, metrics=self.metrics
             )
-        self.kv_pages = qwen2.init_paged_kv_cache(
+        self.kv_pages = self._place_kv(qwen2.init_paged_kv_cache(
             self.cfg.llm, self.num_pages, self.page_size,
             dtype=oryx.compute_dtype(self.cfg),
-        )
+        ))
         self.bt[:] = self._sentinel
         self.slots = [None] * self.num_slots
         self.finished[:] = True

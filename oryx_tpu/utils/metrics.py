@@ -523,6 +523,40 @@ def parse_prom_histogram(
     return bounds, counts, total, s
 
 
+def inject_exposition_label(text: str, label: str, value: str) -> str:
+    """Stamp `label="value"` onto every SAMPLE line of a Prometheus
+    text exposition (comment/TYPE lines pass through untouched).
+
+    The router's aggregation endpoint (serve/router.py
+    /metrics/aggregate) uses this to re-export each replica's scrape
+    with a `replica=` identity — the label plumbing that makes
+    `oryx_serving_*` series from N backends distinguishable in one
+    scrape without teaching every engine metric about replicas."""
+    import re
+
+    sample = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?( .+)$")
+    esc = _escape_label(str(value))
+    out = []
+    for line in text.splitlines():
+        m = sample.match(line) if line and line[0] != "#" else None
+        if m is None:
+            out.append(line)
+            continue
+        name, labels, rest = m.groups()
+        if labels:
+            if f'{label}="' in labels:
+                # The series already carries this label (a replica's
+                # own build_info): injecting again would produce a
+                # duplicate label name — malformed exposition.
+                out.append(line)
+                continue
+            labels = labels[:-1] + f',{label}="{esc}"}}'
+        else:
+            labels = f'{{{label}="{esc}"}}'
+        out.append(name + labels + rest)
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
 def sample_quantile(values: list[float], q: float) -> float:
     """Exact quantile of raw samples: linear interpolation between
     order statistics. NaN on an empty list."""

@@ -1,41 +1,62 @@
 """CI well-formedness gate for the serving observability surface.
 
-Boots a short-lived CPU server (tiny geometry, continuous engine),
-pushes one request through it, then checks:
+Runs one battery of endpoint checks against a serving TARGET — a bare
+replica (api_server) or the prefix-affinity router (serve/router.py)
+fronting several — detected from the target's own /metrics:
 
   * GET /healthz — 200 liveness;
-  * GET /readyz — 200 with ready:true while the scheduler loop is
-    alive (the load-balancer probe that replaces spending a real
-    completion);
+  * GET /readyz — 200 with ready:true while the target can serve (the
+    load-balancer probe that replaces spending a real completion);
   * GET /metrics — exact Prometheus content type
     (`text/plain; version=0.0.4`), every metric name carries the
-    `oryx_serving_` prefix (an unprefixed name would collide in any
-    shared Prometheus; the cross-source `oryx_anomaly_` family is the
-    one deliberate exception), the build_info gauge is present with
-    revision + engine labels, and the HBM gauges exist;
-  * GET /debug/requests — valid JSON, the request we sent is recorded;
-    ?limit= bounds the response, ?state=done returns only finished
-    requests and every one carries a COMPLETE per-request cost ledger
+    target's prefix (`oryx_serving_` on a replica, `oryx_router_` on
+    the router; the cross-source `oryx_anomaly_` family is the one
+    deliberate exception), the build_info gauge is present with
+    revision + engine labels. Replicas must expose the HBM gauges;
+    the router instead must expose `/metrics/aggregate` where every
+    replica sample line carries an injected `replica=` label
+    (including the HBM gauges, per backend);
+  * GET /debug/requests — valid JSON, the request we sent is recorded
+    (the router merges its replicas' flight recorders); ?limit=
+    bounds the response, ?state=done returns only finished requests
+    and every one carries a COMPLETE per-request cost ledger
     (utils/metrics.REQUEST_COST_KEYS), a bogus state is a 400;
-  * GET /debug/trace?id= — valid Chrome trace JSON with a non-empty
-    traceEvents list covering prefill and decode;
-  * the TTFT histogram read back through the SHARED quantile helpers
+  * GET /debug/trace?id= — valid Chrome trace JSON covering
+    queue_wait/prefill/decode_chunk (the router locates the replica
+    that served the id);
+  * a latency histogram read back through the SHARED quantile helpers
     (utils/metrics.parse_prom_histogram + histogram_quantile — the
     same math scripts/loadgen.py reports with): finite, positive,
-    ordered p50 <= p99;
-  * prefix cache under a shared-prefix burst — after several requests
-    carrying one long system prompt, the
-    `oryx_serving_prefix_cache_{hit,miss}_tokens_total` counters,
-    entries/pages gauges, eviction counter and the
-    `oryx_serving_prefill_chunk_tokens` histogram are present and
-    well-formed, and hit_tokens actually moved (the burst shared).
+    ordered p50 <= p99. Replica: `oryx_serving_ttft_seconds`; router:
+    `oryx_router_upstream_ttfb_seconds`;
+  * prefix cache under a shared-prefix burst — hit/miss counters,
+    entries/pages gauges, eviction counter and the prefill chunk-size
+    histogram present and well-formed, and hit_tokens actually moved
+    (summed across replicas through the aggregation endpoint when the
+    target is the router).
 
-Exit 0 = all good; nonzero prints what broke. Wired into
-scripts/check_tier1.sh after the pytest gate.
+Modes:
+
+    # self-boot a tiny CPU replica (the default; wired into
+    # scripts/check_tier1.sh)
+    python scripts/check_serving_endpoints.py
+
+    # the same gate against any live target — a bare replica or a
+    # router front-end
+    python scripts/check_serving_endpoints.py --base-url http://host:port
+
+    # 2-replica router smoke: boots two tiny replicas + a router,
+    # runs the full gate against the ROUTER, then asserts prefix
+    # AFFINITY — the shared-prefix burst must land on one replica
+    # (its oryx_serving_prefix_cache_hit_tokens_total dominates)
+    python scripts/check_serving_endpoints.py --router-smoke
+
+Exit 0 = all good; nonzero prints what broke.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import re
@@ -65,7 +86,13 @@ def fail(msg: str) -> None:
     raise SystemExit(1)
 
 
-def main() -> None:
+def _get(base: str, path: str, timeout: float = 30.0):
+    return urllib.request.urlopen(base + path, timeout=timeout)
+
+
+def boot_tiny_server(replica_id: str | None = None):
+    """One tiny-geometry continuous-engine CPU replica; returns the
+    (unstarted threads aside) live server."""
     import jax
 
     from oryx_tpu import config as cfg_lib
@@ -79,205 +106,342 @@ def main() -> None:
     srv = api_server.build_server(
         pipe, port=0, engine="continuous", num_slots=2, page_size=16,
         decode_chunk=4, max_ctx=512, prefill_chunk=32,
+        replica_id=replica_id,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
-    base = f"http://127.0.0.1:{srv.server_address[1]}"
-    try:
-        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
-            if json.load(r) != {"status": "ok"}:
-                fail("/healthz body is not {status: ok}")
-        with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
-            ready = json.load(r)
-            if r.status != 200 or ready.get("ready") is not True:
-                fail(f"/readyz with a live scheduler: want 200/true, "
-                     f"got {r.status} {ready}")
+    return srv
 
-        req = urllib.request.Request(
-            base + "/v1/chat/completions",
-            data=json.dumps({
-                "messages": [{"role": "user", "content": "hello there"}],
-                "max_tokens": 4,
-            }).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=300) as r:
-            rid = r.headers.get("X-Request-Id")
-            json.load(r)
-        if not rid:
-            fail("completion response missing X-Request-Id header")
 
-        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
-            ctype = r.headers.get("Content-Type")
-            metrics_text = r.read().decode()
-        if ctype != "text/plain; version=0.0.4":
-            fail(f"/metrics content type {ctype!r}, want the Prometheus "
-                 "text exposition type")
-        bad = [
-            line for line in metrics_text.splitlines()
-            if line and not line.startswith("#")
-            and not line.startswith(("oryx_serving_", "oryx_anomaly_"))
-        ]
-        if bad:
-            fail(f"unprefixed metric names: {bad[:5]}")
+def _base_of(srv) -> str:
+    return f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+SYSMSG = ("You are a careful assistant. Study the context and "
+          "answer briefly. " * 2)
+
+
+def _completion(base: str, messages, max_tokens: int = 4) -> str:
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({
+            "messages": messages, "max_tokens": max_tokens,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        rid = r.headers.get("X-Request-Id")
+        json.load(r)
+    return rid
+
+
+def _labeled_total(text: str, family: str) -> float:
+    """Sum of a family's samples across any labels (the aggregated
+    multi-replica view)."""
+    total = 0.0
+    for m in re.finditer(
+        rf"^{re.escape(family)}(?:\{{[^}}]*\}})? ([0-9.e+-]+)$",
+        text, re.M,
+    ):
+        total += float(m.group(1))
+    return total
+
+
+def run_checks(base: str) -> str:
+    """The full endpoint battery against `base`; returns the detected
+    target kind ("replica" | "router")."""
+    with _get(base, "/metrics") as r:
+        ctype = r.headers.get("Content-Type")
+        metrics_text = r.read().decode()
+    if ctype != "text/plain; version=0.0.4":
+        fail(f"/metrics content type {ctype!r}, want the Prometheus "
+             "text exposition type")
+    kind = (
+        "router" if "oryx_router_build_info" in metrics_text
+        else "replica"
+    )
+    prefixes = (
+        ("oryx_router_", "oryx_anomaly_") if kind == "router"
+        else ("oryx_serving_", "oryx_anomaly_")
+    )
+    info_family = (
+        "oryx_router_build_info" if kind == "router"
+        else "oryx_serving_build_info"
+    )
+
+    with _get(base, "/healthz") as r:
+        if json.load(r) != {"status": "ok"}:
+            fail("/healthz body is not {status: ok}")
+    with _get(base, "/readyz") as r:
+        ready = json.load(r)
+        if r.status != 200 or ready.get("ready") is not True:
+            fail(f"/readyz on a live target: want 200/true, "
+                 f"got {r.status} {ready}")
+
+    rid = _completion(
+        base, [{"role": "user", "content": "hello there"}]
+    )
+    if not rid:
+        fail("completion response missing X-Request-Id header")
+
+    # Prefix/build_info checks run against the BOOT-time scrape (those
+    # families exist before any traffic); the latency-histogram check
+    # below re-scrapes after the burst for its samples.
+    bad = [
+        line for line in metrics_text.splitlines()
+        if line and not line.startswith("#")
+        and not line.startswith(prefixes)
+    ]
+    if bad:
+        fail(f"unprefixed metric names for a {kind}: {bad[:5]}")
+    if not re.search(
+        rf'^{info_family}\{{[^}}]*engine="[^"]+"[^}}]*\}} 1$',
+        metrics_text, re.M,
+    ) or 'revision="' not in metrics_text:
+        fail(f"{info_family} gauge with engine+revision labels "
+             "missing from /metrics")
+    if kind == "replica":
         if "oryx_serving_hbm_live_bytes" not in metrics_text:
             fail("device-memory gauge oryx_serving_hbm_live_bytes "
                  "missing from /metrics")
+    else:
+        # The router has no HBM of its own; the fleet's shows through
+        # the aggregation endpoint, every sample line replica-labeled.
+        with _get(base, "/metrics/aggregate") as r:
+            agg = r.read().decode()
         if not re.search(
-            r'^oryx_serving_build_info\{[^}]*engine="[^"]+"[^}]*\} 1$',
-            metrics_text, re.M,
-        ) or 'revision="' not in metrics_text:
-            fail("oryx_serving_build_info gauge with engine+revision "
-                 "labels missing from /metrics")
-
-        with urllib.request.urlopen(
-            base + "/debug/requests", timeout=30
-        ) as r:
-            recorder = json.load(r)
-        ids = [e.get("id") for e in recorder.get("requests", [])]
-        if rid not in ids:
-            fail(f"/debug/requests does not list request {rid} "
-                 f"(got {ids})")
-
-        with urllib.request.urlopen(
-            base + f"/debug/trace?id={rid}", timeout=30
-        ) as r:
-            tracejs = json.load(r)
-        names = {
-            e.get("name") for e in tracejs.get("traceEvents", [])
-        }
-        for want in ("queue_wait", "prefill", "decode_chunk"):
-            if want not in names:
-                fail(f"/debug/trace missing span {want!r} (got "
-                     f"{sorted(names)})")
-
-        # Shared-prefix burst: several requests with one long system
-        # prompt must light up the prefix-cache metric family.
-        sysmsg = ("You are a careful assistant. Study the context and "
-                  "answer briefly. " * 2)
-        for i in range(3):
-            burst = urllib.request.Request(
-                base + "/v1/chat/completions",
-                data=json.dumps({
-                    "messages": [
-                        {"role": "system", "content": sysmsg},
-                        {"role": "user", "content": f"question {i}?"},
-                    ],
-                    "max_tokens": 3,
-                }).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(burst, timeout=300) as r:
-                json.load(r)
-        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
-            metrics_text = r.read().decode()
-        for fam in (
-            "oryx_serving_prefix_cache_hit_tokens_total",
-            "oryx_serving_prefix_cache_miss_tokens_total",
-            "oryx_serving_prefix_cache_evicted_pages_total",
-            "oryx_serving_prefix_cache_entries",
-            "oryx_serving_prefix_cache_pages",
-            "oryx_serving_prefill_tokens_total",
+            r'^oryx_serving_hbm_live_bytes\{[^}]*replica="[^"]+"',
+            agg, re.M,
         ):
+            fail("/metrics/aggregate missing replica-labeled "
+                 "oryx_serving_hbm_live_bytes")
+        unlabeled = [
+            line for line in agg.splitlines()
+            if line and not line.startswith("#")
+            and line.startswith("oryx_serving_")
+            and 'replica="' not in line
+        ]
+        if unlabeled:
+            fail("aggregated replica samples missing the replica= "
+                 f"label: {unlabeled[:5]}")
+
+    with _get(base, "/debug/requests") as r:
+        recorder = json.load(r)
+    ids = [e.get("id") for e in recorder.get("requests", [])]
+    if rid not in ids:
+        fail(f"/debug/requests does not list request {rid} (got {ids})")
+
+    with _get(base, f"/debug/trace?id={rid}") as r:
+        tracejs = json.load(r)
+    names = {e.get("name") for e in tracejs.get("traceEvents", [])}
+    for want in ("queue_wait", "prefill", "decode_chunk"):
+        if want not in names:
+            fail(f"/debug/trace missing span {want!r} (got "
+                 f"{sorted(names)})")
+
+    # Shared-prefix burst: several requests with one long system
+    # prompt must light up the prefix-cache metric family (and, on a
+    # router target, the affinity machinery keeps them on one
+    # replica — asserted separately by --router-smoke).
+    for i in range(3):
+        _completion(base, [
+            {"role": "system", "content": SYSMSG},
+            {"role": "user", "content": f"question {i}?"},
+        ], max_tokens=3)
+    with _get(base, "/metrics") as r:
+        metrics_text = r.read().decode()
+    if kind == "router":
+        with _get(base, "/metrics/aggregate") as r:
+            cache_text = r.read().decode()
+    else:
+        cache_text = metrics_text
+    for fam in (
+        "oryx_serving_prefix_cache_hit_tokens_total",
+        "oryx_serving_prefix_cache_miss_tokens_total",
+        "oryx_serving_prefix_cache_evicted_pages_total",
+        "oryx_serving_prefix_cache_entries",
+        "oryx_serving_prefix_cache_pages",
+        "oryx_serving_prefill_tokens_total",
+    ):
+        if not re.search(
+            rf"^{fam}(?:\{{[^}}]*\}})? ([0-9.e+-]+)$", cache_text, re.M
+        ):
+            fail(f"prefix-cache metric {fam} missing or malformed "
+                 "after the shared-prefix burst")
+    if not re.search(
+        r'^oryx_serving_prefill_chunk_tokens_bucket\{[^}]*le="\+Inf"[^}]*\} '
+        r"[1-9]", cache_text, re.M,
+    ):
+        fail("prefill chunk-size histogram did not record any dispatch")
+    hit = _labeled_total(
+        cache_text, "oryx_serving_prefix_cache_hit_tokens_total"
+    )
+    if hit <= 0:
+        fail("shared-prefix burst produced zero "
+             "prefix_cache_hit_tokens_total — the cache never hit")
+
+    # Latency quantiles through the SHARED bucket-interpolation
+    # helpers (the loadgen report uses the same math): the histogram
+    # must parse and produce finite, ordered quantiles. A replica's
+    # own TTFT ladder, or the router's upstream-TTFB ladder.
+    from oryx_tpu.utils.metrics import (
+        REQUEST_COST_KEYS,
+        histogram_quantile,
+        parse_prom_histogram,
+    )
+
+    lat_family = (
+        "oryx_router_upstream_ttfb_seconds" if kind == "router"
+        else "oryx_serving_ttft_seconds"
+    )
+    hist = parse_prom_histogram(metrics_text, lat_family)
+    if hist is None:
+        fail(f"{lat_family} histogram missing")
+    bounds, counts, total, _ = hist
+    if total < 4:
+        fail(f"{lat_family} recorded {total} < 4 requests")
+    p50 = histogram_quantile(0.5, bounds, counts, total)
+    p99 = histogram_quantile(0.99, bounds, counts, total)
+    if not (0 < p50 <= p99):
+        fail(f"{lat_family} quantiles malformed: p50={p50} p99={p99}")
+    if kind == "replica" and not re.search(
+        r"^oryx_serving_request_page_seconds_count [1-9]",
+        metrics_text, re.M,
+    ):
+        fail("oryx_serving_request_page_seconds histogram did not "
+             "record any finished request")
+
+    # /debug/requests filters: ?limit= bounds the response,
+    # ?state=done shows only finished requests — each carrying a
+    # complete cost ledger — and a bogus state is a 400 (propagated
+    # through the router's merge).
+    with _get(base, "/debug/requests?limit=1") as r:
+        lim = json.load(r)
+    if len(lim["requests"]) != 1 or lim["returned"] != 1:
+        fail(f"/debug/requests?limit=1 returned "
+             f"{len(lim['requests'])} entries")
+    if lim["total"] < 4:
+        fail(f"/debug/requests?limit=1 total={lim['total']}, "
+             "want >= 4 (the burst flowed through the recorder)")
+    with _get(base, "/debug/requests?state=done") as r:
+        done = json.load(r)
+    if not done["requests"]:
+        fail("/debug/requests?state=done is empty after the burst")
+    for rec in done["requests"]:
+        if not rec["done"]:
+            fail(f"?state=done returned in-flight request {rec['id']}")
+        cost = (rec.get("meta") or {}).get("cost")
+        missing = [
+            k for k in REQUEST_COST_KEYS
+            if not isinstance(cost, dict) or k not in cost
+        ]
+        if missing:
+            fail(f"finished request {rec['id']} cost ledger "
+                 f"missing {missing}")
+    try:
+        with _get(base, "/debug/requests?state=bogus") as r:
+            fail("/debug/requests?state=bogus did not 400")
+    except urllib.error.HTTPError as e:
+        if e.code != 400:
+            fail(f"/debug/requests?state=bogus -> {e.code}, want 400")
+        e.close()
+    return kind
+
+
+def _shutdown_replica(srv) -> None:
+    if srv.scheduler is not None:
+        srv.scheduler.close()
+    srv.shutdown()
+
+
+def run_router_smoke() -> None:
+    """Two tiny replicas + a router: the full gate against the ROUTER,
+    then the affinity assertion — the shared-prefix burst must
+    concentrate on one replica (its prefix_cache_hit_tokens_total
+    dominates the fleet total)."""
+    from oryx_tpu.serve.router import build_router
+
+    reps = [boot_tiny_server(replica_id=f"r{i}") for i in range(2)]
+    rsrv = build_router(
+        [(f"r{i}", _base_of(s)) for i, s in enumerate(reps)],
+        port=0, poll_s=0.1,
+    )
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    try:
+        kind = run_checks(_base_of(rsrv))
+        if kind != "router":
+            fail(f"router smoke detected target kind {kind!r}")
+        hits = []
+        for i, s in enumerate(reps):
+            with _get(_base_of(s), "/metrics") as r:
+                text = r.read().decode()
             m = re.search(
-                rf"^{fam} ([0-9.e+-]+)$", metrics_text, re.M
+                r"^oryx_serving_prefix_cache_hit_tokens_total "
+                r"([0-9.e+-]+)$", text, re.M,
             )
-            if not m:
-                fail(f"prefix-cache metric {fam} missing or malformed "
-                     "after the shared-prefix burst")
-        if not re.search(
-            r'^oryx_serving_prefill_chunk_tokens_bucket\{le="\+Inf"\} '
-            r"[1-9]", metrics_text, re.M,
-        ):
-            fail("prefill chunk-size histogram did not record any "
-                 "dispatch")
-        hit = float(re.search(
-            r"^oryx_serving_prefix_cache_hit_tokens_total ([0-9.e+-]+)$",
-            metrics_text, re.M,
-        ).group(1))
-        if hit <= 0:
-            fail("shared-prefix burst produced zero "
-                 "prefix_cache_hit_tokens_total — the cache never hit")
-
-        # TTFT quantiles through the SHARED bucket-interpolation
-        # helpers (the loadgen report uses the same math): the
-        # histogram must parse and produce finite, ordered quantiles.
-        from oryx_tpu.utils.metrics import (
-            REQUEST_COST_KEYS,
-            histogram_quantile,
-            parse_prom_histogram,
+            hits.append(float(m.group(1)) if m else 0.0)
+        total = sum(hits)
+        if total <= 0:
+            fail("router smoke: no prefix-cache hits anywhere — "
+                 f"affinity routed nothing usefully (hits={hits})")
+        if max(hits) < 0.8 * total:
+            fail("router smoke: shared-prefix burst did not "
+                 f"concentrate on one replica (hit tokens {hits}; "
+                 "want one replica >= 80% of the total)")
+        with _get(_base_of(rsrv), "/metrics") as r:
+            rt = r.read().decode()
+        m = re.search(
+            r"^oryx_router_affinity_hit_rate ([0-9.e+-]+)$", rt, re.M
         )
-
-        hist = parse_prom_histogram(
-            metrics_text, "oryx_serving_ttft_seconds"
-        )
-        if hist is None:
-            fail("oryx_serving_ttft_seconds histogram missing")
-        bounds, counts, total, _ = hist
-        if total < 4:
-            fail(f"ttft histogram recorded {total} < 4 requests")
-        p50 = histogram_quantile(0.5, bounds, counts, total)
-        p99 = histogram_quantile(0.99, bounds, counts, total)
-        if not (0 < p50 <= p99):
-            fail(f"ttft quantiles malformed: p50={p50} p99={p99}")
-        # The per-request cost-ledger families must render (at the
-        # request count) alongside the latency ladders.
-        if not re.search(
-            r"^oryx_serving_request_page_seconds_count [1-9]",
-            metrics_text, re.M,
-        ):
-            fail("oryx_serving_request_page_seconds histogram did not "
-                 "record any finished request")
-
-        # /debug/requests filters: ?limit= bounds the response,
-        # ?state=done shows only finished requests — each carrying a
-        # complete cost ledger — and a bogus state is a 400.
-        with urllib.request.urlopen(
-            base + "/debug/requests?limit=1", timeout=30
-        ) as r:
-            lim = json.load(r)
-        if len(lim["requests"]) != 1 or lim["returned"] != 1:
-            fail(f"/debug/requests?limit=1 returned "
-                 f"{len(lim['requests'])} entries")
-        if lim["total"] < 4:
-            fail(f"/debug/requests?limit=1 total={lim['total']}, "
-                 "want >= 4 (the burst flowed through the recorder)")
-        with urllib.request.urlopen(
-            base + "/debug/requests?state=done", timeout=30
-        ) as r:
-            done = json.load(r)
-        if not done["requests"]:
-            fail("/debug/requests?state=done is empty after the burst")
-        for rec in done["requests"]:
-            if not rec["done"]:
-                fail(f"?state=done returned in-flight request "
-                     f"{rec['id']}")
-            cost = (rec.get("meta") or {}).get("cost")
-            missing = [
-                k for k in REQUEST_COST_KEYS
-                if not isinstance(cost, dict) or k not in cost
-            ]
-            if missing:
-                fail(f"finished request {rec['id']} cost ledger "
-                     f"missing {missing}")
-        try:
-            with urllib.request.urlopen(
-                base + "/debug/requests?state=bogus", timeout=30
-            ) as r:
-                fail("/debug/requests?state=bogus did not 400")
-        except urllib.error.HTTPError as e:
-            if e.code != 400:
-                fail(f"/debug/requests?state=bogus -> {e.code}, "
-                     "want 400")
-            e.close()
+        if not m or float(m.group(1)) <= 0:
+            fail("oryx_router_affinity_hit_rate did not move")
+        print(f"router smoke OK: hit tokens per replica {hits}, "
+              f"affinity_hit_rate={m.group(1)}")
     finally:
-        if srv.scheduler is not None:
-            srv.scheduler.close()
-        srv.shutdown()
-    print("serving endpoints OK: /healthz + /readyz + /metrics "
-          "(content-type, prefix, build_info, hbm gauges) + "
-          "/debug/requests (+ limit/state filters, cost ledger) + "
-          "/debug/trace + prefix-cache family under a shared-prefix "
-          "burst + ttft quantiles via the shared histogram helper")
+        rsrv.stop_prober()  # before the replicas go: no eject noise
+        for s in reps:
+            _shutdown_replica(s)
+        rsrv.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="serving endpoint well-formedness gate "
+        "(see module docstring)"
+    )
+    ap.add_argument(
+        "--base-url", default=None,
+        help="live target (replica or router); omitted = boot a tiny "
+        "CPU replica in-process",
+    )
+    ap.add_argument(
+        "--router-smoke", action="store_true",
+        help="boot 2 tiny replicas + a router, run the gate against "
+        "the router, and assert shared-prefix affinity dominance",
+    )
+    args = ap.parse_args()
+    if args.router_smoke:
+        if args.base_url:
+            ap.error("--router-smoke self-boots; drop --base-url")
+        run_router_smoke()
+        return
+
+    srv = None
+    base = args.base_url
+    try:
+        if base is None:
+            srv = boot_tiny_server()
+            base = _base_of(srv)
+        kind = run_checks(base)
+    finally:
+        if srv is not None:
+            _shutdown_replica(srv)
+    print(f"serving endpoints OK ({kind}): /healthz + /readyz + "
+          "/metrics (content-type, prefix, build_info"
+          + (", aggregate replica labels" if kind == "router"
+             else ", hbm gauges")
+          + ") + /debug/requests (+ limit/state filters, cost ledger) "
+          "+ /debug/trace + prefix-cache family under a shared-prefix "
+          "burst + latency quantiles via the shared histogram helper")
 
 
 if __name__ == "__main__":

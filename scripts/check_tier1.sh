@@ -10,15 +10,14 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 500 = the 470 recorded at PR 7 plus the concurrency-correctness
-# suites added in PR 8 (lock-order/atomicity fixtures + interprocedural
-# units, suppression-ratchet/json-artifact/changed-only-widening CLI
-# tests, the LockOrderSanitizer + race-detector suite in
-# test_lock_sanitizer.py, armed supervisor-restart interplay and the
-# Thread._stop-shadowing regression in test_containment.py; 531
-# observed with a warm /tmp/jax_cache), with headroom for
-# load-dependent flakes (bench-supervisor probes on one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-500}
+# 520 = the 500 recorded at PR 8 plus the multi-replica serving-tier
+# suites added in PR 9 (prefix-affinity router: affinity/ejection/
+# drain/retry/merged-surfaces in tests/test_router.py;
+# tensor-parallel paged decode parity incl. prefix-cache splices and
+# eviction replay on a tp=2 CPU mesh in tests/test_tp_decode.py; 553
+# observed), with headroom for load-dependent flakes
+# (bench-supervisor probes on one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-520}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -69,6 +68,7 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     tests/test_scheduler.py tests/test_containment.py \
     tests/test_trace.py tests/test_metrics_registry.py \
     tests/test_prefix_cache.py tests/test_lock_sanitizer.py \
+    tests/test_router.py \
     -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "LOCK SANITIZER SUITE FAILED (a concurrency violation above)" >&2
@@ -84,6 +84,20 @@ echo "checking serving endpoints (/healthz, /readyz, /metrics, /debug/*)"
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/check_serving_endpoints.py; then
     echo "SERVING ENDPOINT CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- 2-replica router smoke --------------------------------------------------
+# Two tiny replicas behind the prefix-affinity router
+# (serve/router.py): the full endpoint gate runs against the ROUTER
+# (merged /debug, replica-labeled /metrics/aggregate, upstream-TTFB
+# quantiles), then a shared-prefix burst must show AFFINITY — one
+# replica's oryx_serving_prefix_cache_hit_tokens_total dominates the
+# fleet total.
+echo "checking 2-replica router smoke (affinity + merged endpoints)"
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_serving_endpoints.py --router-smoke; then
+    echo "ROUTER SMOKE FAILED" >&2
     exit 1
 fi
 
@@ -125,6 +139,21 @@ echo "checking capacity harness (loadgen.py --smoke)"
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/loadgen.py --smoke > /dev/null; then
     echo "LOADGEN CAPACITY CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- router capacity harness -------------------------------------------------
+# The same seeded sweep through a 2-replica prefix-affinity fleet:
+# schema + knee + zero SLO firings below it (summed across replicas),
+# per-replica goodput split recorded, router-level 503/retries
+# classified apart from backend errors, and the sweep-wide affinity
+# hit rate must clear 0.5 on the shared-prefix mix. (Knee-vs-single
+# comparison is recorded in the report; gating it needs multi-core
+# hosts — see docs/OBSERVABILITY.md.)
+echo "checking router capacity harness (loadgen.py --smoke --router 2)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/loadgen.py --smoke --router 2 > /dev/null; then
+    echo "ROUTER LOADGEN CHECK FAILED" >&2
     exit 1
 fi
 

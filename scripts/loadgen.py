@@ -137,6 +137,12 @@ def send_stream(base: str, body: dict, timeout: float) -> dict:
     rec: dict = {
         "status": None, "ok": False, "ttft_s": None, "per_token_s": None,
         "e2e_s": None, "tokens": 0, "cost": None, "error": None,
+        # Router-mode attribution (zero/absent against a bare replica):
+        # how many times the router retried this request onto another
+        # replica, which replica finally served it, and whether an
+        # error was ROUTER-generated (no healthy replica / draining)
+        # rather than a backend's own answer.
+        "router_retries": 0, "replica": None,
     }
     data = json.dumps(body).encode()
     req = urllib.request.Request(
@@ -149,6 +155,10 @@ def send_stream(base: str, body: dict, timeout: float) -> dict:
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             rec["status"] = r.status
+            rec["router_retries"] = int(
+                r.headers.get("X-Oryx-Router-Retries") or 0
+            )
+            rec["replica"] = r.headers.get("X-Oryx-Router-Replica")
             for raw in r:
                 line = raw.decode("utf-8", "replace").strip()
                 if not line.startswith("data: "):
@@ -178,7 +188,17 @@ def send_stream(base: str, body: dict, timeout: float) -> dict:
                     rec["cost"] = obj["oryx"].get("cost")
     except urllib.error.HTTPError as e:
         rec["status"] = e.code
-        rec["error"] = str(e.code)
+        hdrs = e.headers or {}
+        rec["router_retries"] = int(
+            hdrs.get("X-Oryx-Router-Retries") or 0
+        )
+        # A 503 the ROUTER generated (fleet exhausted / router drain)
+        # is a different incident from a backend's own 503 forwarded
+        # through — the X-Oryx-Router-Error tag splits them.
+        if e.code == 503 and hdrs.get("X-Oryx-Router-Error"):
+            rec["error"] = "router_503"
+        else:
+            rec["error"] = str(e.code)
         e.close()
         rec["e2e_s"] = time.monotonic() - t0
         return rec
@@ -261,9 +281,60 @@ def _dist(values: list[float]) -> dict:
     }
 
 
+def _counter_value(text: str, family: str) -> float:
+    """Value of one unlabeled counter/gauge/sum sample, 0 if absent."""
+    m = re.search(
+        rf"^{re.escape(family)} ([0-9.eE+-]+)$", text, re.M
+    )
+    return float(m.group(1)) if m else 0.0
+
+
+def replica_stage_split(r0: dict[str, str],
+                        r1: dict[str, str]) -> dict[str, dict]:
+    """Per-replica goodput attribution for one stage: the delta of
+    each replica's own counters between the stage's two direct
+    scrapes — completions served, prefix-cache hit tokens (the
+    affinity payoff), and decode steps (the request_decode_steps
+    histogram's sum, the device-work share)."""
+    out: dict[str, dict] = {}
+    total_completed = 0.0
+    for rid in sorted(r1):
+        completed = (
+            _counter_value(r1[rid], "oryx_serving_completed")
+            - _counter_value(r0.get(rid, ""), "oryx_serving_completed")
+        )
+        out[rid] = {
+            "completed": completed,
+            "prefix_hit_tokens": (
+                _counter_value(
+                    r1[rid], "oryx_serving_prefix_cache_hit_tokens_total"
+                ) - _counter_value(
+                    r0.get(rid, ""),
+                    "oryx_serving_prefix_cache_hit_tokens_total",
+                )
+            ),
+            "decode_steps": (
+                _counter_value(
+                    r1[rid], "oryx_serving_request_decode_steps_sum"
+                ) - _counter_value(
+                    r0.get(rid, ""),
+                    "oryx_serving_request_decode_steps_sum",
+                )
+            ),
+        }
+        total_completed += completed
+    for rid, row in out.items():
+        row["completed_share"] = round(
+            row["completed"] / total_completed, 4
+        ) if total_completed > 0 else None
+    return out
+
+
 def aggregate_stage(rate: float, duration: float, results: list[dict],
                     hung: int, m0: str, m1: str, slo_ttft: float,
-                    slo_per_token: float | None) -> dict:
+                    slo_per_token: float | None,
+                    replica_scrapes: tuple[dict, dict] | None = None,
+                    router: bool = False) -> dict:
     """One stage's record for the report. Goodput divides by the
     ARRIVAL window (`duration`), not the drain: open-loop capacity is
     tokens served per second of offered-load time. A hung request
@@ -282,12 +353,16 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
     ]
     errors = {"429": 0, "503": 0, "504": 0, "other_http": 0,
               "transport": 0, "stream_error": 0,
-              "harness_inflight_cap": 0}
+              "harness_inflight_cap": 0, "router_503": 0}
     for r in results:
         e = r["error"]
         if e is None:
             continue
-        if e in ("429", "503", "504"):
+        if e in ("429", "503", "504", "router_503"):
+            # router_503 = the ROUTER answered (no healthy replica /
+            # router drain), distinct from a backend 503 forwarded
+            # through — conflating them would blame backends for a
+            # routing-tier outage.
             errors[e] += 1
         elif e in ("transport", "stream_error", "harness_inflight_cap"):
             # harness_inflight_cap is a HARNESS-side shed, not a
@@ -296,14 +371,53 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
             errors[e] += 1
         else:
             errors["other_http"] += 1
-    a0, a1 = anomaly_counts(m0), anomaly_counts(m1)
+    if replica_scrapes is not None:
+        # Router target: the SLO detectors live on the replicas, not
+        # the router — the stage's anomaly delta is the fleet sum of
+        # each replica's own scrape pair.
+        r0s, r1s = replica_scrapes
+        anomalies = {
+            k: sum(
+                anomaly_counts(r1s[rid]).get(k, 0.0)
+                - anomaly_counts(r0s.get(rid, "")).get(k, 0.0)
+                for rid in r1s
+            )
+            for k in ANOMALY_KINDS
+        }
+    else:
+        a0, a1 = anomaly_counts(m0), anomaly_counts(m1)
+        anomalies = {k: a1[k] - a0.get(k, 0.0) for k in ANOMALY_KINDS}
     costs = [r["cost"] for r in results if r["cost"]]
     prefill = sum(c["prefill_tokens"] for c in costs)
     cached = sum(c["cached_tokens"] for c in costs)
     page_s = sum(c["page_seconds"] for c in costs)
     goodput = sum(r["tokens"] for r in good) / duration
     sent = len(results) + hung
-    return {
+    router_block = None
+    if router:
+        # Affinity across THIS stage: the delta of the router's own
+        # hit/miss counters between its two scrapes.
+        d_hits = (
+            _counter_value(m1, "oryx_router_affinity_hits_total")
+            - _counter_value(m0, "oryx_router_affinity_hits_total")
+        )
+        d_miss = (
+            _counter_value(m1, "oryx_router_affinity_misses_total")
+            - _counter_value(m0, "oryx_router_affinity_misses_total")
+        )
+        router_block = {
+            "retries": sum(r.get("router_retries") or 0 for r in results),
+            "router_503": errors["router_503"],
+            "affinity": {
+                "hits": d_hits,
+                "misses": d_miss,
+                "hit_rate": round(d_hits / (d_hits + d_miss), 4)
+                if d_hits + d_miss > 0 else None,
+            },
+            "per_replica": replica_stage_split(*replica_scrapes)
+            if replica_scrapes is not None else {},
+        }
+    out = {
         "offered_rps": rate,
         "sent": sent,
         "ok": len(ok),
@@ -322,12 +436,12 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
             if r["per_token_s"] is not None
         ]),
         "server_ttft_s": server_hist_quantiles(
-            m0, m1, "oryx_serving_ttft_seconds"
+            m0, m1,
+            "oryx_router_upstream_ttfb_seconds" if router
+            else "oryx_serving_ttft_seconds",
         ),
         "errors": errors,
-        "anomalies": {
-            k: a1[k] - a0.get(k, 0.0) for k in ANOMALY_KINDS
-        },
+        "anomalies": anomalies,
         "cost": {
             "requests_with_cost": len(costs),
             "prefill_tokens": prefill,
@@ -343,11 +457,16 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
             ) if page_s > 0 else None,
         },
     }
+    if router_block is not None:
+        out["router"] = router_block
+    return out
 
 
 def run_stage(base: str, rate: float, cfg: dict,
               rng: random.Random,
-              carryover: list | None = None) -> dict:
+              carryover: list | None = None,
+              replicas: dict[str, str] | None = None,
+              router: bool = False) -> dict:
     """Run one open-loop stage at `rate` req/s: the dispatcher sleeps
     to each pre-drawn arrival time and fires a daemon thread per
     request — completions never gate arrivals. A bounded in-flight cap
@@ -373,6 +492,9 @@ def run_stage(base: str, rate: float, cfg: dict,
             results.append(rec)
 
     m0 = scrape_metrics(base)
+    r0 = {
+        rid: scrape_metrics(u) for rid, u in (replicas or {}).items()
+    }
     t0 = time.monotonic()
     for off, body in zip(arrivals, bodies):
         delay = t0 + off - time.monotonic()
@@ -387,6 +509,7 @@ def run_stage(base: str, rate: float, cfg: dict,
                     "status": None, "ok": False, "ttft_s": None,
                     "per_token_s": None, "e2e_s": None, "tokens": 0,
                     "cost": None, "error": "harness_inflight_cap",
+                    "router_retries": 0, "replica": None,
                 })
             continue
         t = threading.Thread(target=worker, args=(body,), daemon=True)
@@ -398,6 +521,9 @@ def run_stage(base: str, rate: float, cfg: dict,
     hung = sum(t.is_alive() for t in threads)
     carry.extend(t for t in threads if t.is_alive())
     m1 = scrape_metrics(base)
+    r1 = {
+        rid: scrape_metrics(u) for rid, u in (replicas or {}).items()
+    }
     with lock:
         # Snapshot: hung daemon workers may still append after the
         # drain; aggregation must see one consistent list.
@@ -405,6 +531,8 @@ def run_stage(base: str, rate: float, cfg: dict,
     return aggregate_stage(
         rate, duration, snapshot, hung, m0, m1,
         cfg["slo_ttft"], cfg["slo_per_token"],
+        replica_scrapes=(r0, r1) if replicas else None,
+        router=router,
     )
 
 
@@ -492,12 +620,13 @@ def check_cost_ledger(base: str) -> list[str]:
         base + "/debug/requests?state=done", timeout=30
     ) as r:
         body = json.load(r)
-    if body.get("engine") != "continuous":
+    if body.get("engine") not in ("continuous", "router"):
         # The window batcher has no cost ledger (or SLO detectors):
-        # one clear reason beats N "missing every key" lines.
+        # one clear reason beats N "missing every key" lines. The
+        # router's merged recorder carries its replicas' ledgers.
         return [
-            "cost-ledger audit requires --engine continuous (server "
-            f"reports engine={body.get('engine')!r})"
+            "cost-ledger audit requires a scheduler engine or a "
+            f"router (server reports engine={body.get('engine')!r})"
         ]
     reqs = body.get("requests", [])
     if not reqs:
@@ -516,12 +645,49 @@ def check_cost_ledger(base: str) -> list[str]:
     return probs
 
 
-def evaluate_gate(report: dict, *, ledger_problems: list[str]) -> dict:
+def evaluate_gate(report: dict, *, ledger_problems: list[str],
+                  require_affinity: float | None = None,
+                  vs_single: bool = False) -> dict:
     """Pass/fail: schema valid, a knee exists, and ZERO SLO-detector
-    firings (and zero hung/transport casualties) at or below it."""
+    firings (and zero hung/transport casualties) at or below it.
+    Router sweeps add: the sweep-wide affinity hit rate must exceed
+    `require_affinity` (the shared-prefix mix must actually land hot),
+    and with `vs_single` the knee must sit at STRICTLY higher offered
+    load than the recorded single-replica baseline's."""
     reasons = list(validate_report(report))
     reasons += ledger_problems
     knee = report.get("knee")
+    if require_affinity is not None:
+        hits = sum(
+            (st.get("router") or {}).get("affinity", {}).get("hits") or 0
+            for st in report.get("stages", [])
+        )
+        misses = sum(
+            (st.get("router") or {}).get("affinity", {}).get("misses") or 0
+            for st in report.get("stages", [])
+        )
+        rate = hits / (hits + misses) if hits + misses > 0 else 0.0
+        report["affinity_hit_rate"] = round(rate, 4)
+        if rate <= require_affinity:
+            reasons.append(
+                f"affinity hit rate {rate:.3f} <= {require_affinity} "
+                "on the shared-prefix mix (routing is not preserving "
+                "cache locality)"
+            )
+    if vs_single:
+        single = (report.get("single_baseline") or {}).get("knee")
+        if single is None:
+            reasons.append(
+                "--gate-vs-single: no single-replica baseline knee "
+                "available to compare against"
+            )
+        elif knee is None or knee["offered_rps"] <= single["offered_rps"]:
+            got = None if knee is None else knee["offered_rps"]
+            reasons.append(
+                f"router knee at offered {got} rps is not strictly "
+                f"above the single-replica knee at "
+                f"{single['offered_rps']} rps"
+            )
     if knee is None:
         reasons.append(
             "saturated at the lowest offered load (no knee found)"
@@ -558,7 +724,8 @@ class _CharTokenizer:
         return "".join(chr(i) for i in ids if 0 < i < 500)
 
 
-def boot_tiny_server(args):
+def boot_tiny_server(args, *, replica_id: str | None = None,
+                     params=None, cfg=None):
     """In-process tiny-geometry continuous-engine server with the SLO
     detectors ARMED (they are the gate). Returns (srv, base_url)."""
     import jax
@@ -568,17 +735,49 @@ def boot_tiny_server(args):
     from oryx_tpu.serve import api_server
     from oryx_tpu.serve.pipeline import OryxInference
 
-    cfg = cfg_lib.oryx_tiny()
-    params = oryx.init_params(cfg, jax.random.key(0))
+    if cfg is None:
+        cfg = cfg_lib.oryx_tiny()
+    if params is None:
+        params = oryx.init_params(cfg, jax.random.key(0))
     pipe = OryxInference(_CharTokenizer(), params, cfg)
     srv = api_server.build_server(
         pipe, port=0, engine="continuous", num_slots=2, page_size=16,
         decode_chunk=4, max_ctx=512, prefill_chunk=32,
         ttft_slo=args.server_ttft_slo,
         queue_depth_slo=args.server_queue_depth_slo,
+        replica_id=replica_id,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def boot_tiny_fleet(args, n: int):
+    """N tiny replicas (shared tiny params — one compile, n engines)
+    behind a prefix-affinity router. Returns (replica_srvs, router_srv,
+    router_base, {rid: replica_base})."""
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx
+    from oryx_tpu.serve.router import build_router
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    servers, bases = [], {}
+    for i in range(n):
+        srv, base = boot_tiny_server(
+            args, replica_id=f"r{i}", params=params, cfg=cfg
+        )
+        servers.append(srv)
+        bases[f"r{i}"] = base
+    rsrv = build_router(
+        sorted(bases.items()), port=0, poll_s=0.2,
+    )
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    return (
+        servers, rsrv,
+        f"http://127.0.0.1:{rsrv.server_address[1]}", bases,
+    )
 
 
 def warmup(base: str, cfg: dict, rng: random.Random) -> None:
@@ -661,7 +860,25 @@ def run(argv=None) -> dict:
     ap.add_argument("--smoke", action="store_true",
                     help="CI mode: tiny self-boot server, short sweep, "
                     "hard gate + schema + cost-ledger audit")
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="multi-replica mode: boot N tiny replicas "
+                    "behind a prefix-affinity router (serve/router.py) "
+                    "and sweep THROUGH the router; the report gains "
+                    "per-stage per-replica goodput splits, affinity "
+                    "hit rate, and router-level retry/503 "
+                    "classification (self-boot only)")
+    ap.add_argument("--gate-vs-single", action="store_true",
+                    help="router mode: fail the gate unless the "
+                    "router sweep's knee sits at STRICTLY higher "
+                    "offered load than the single-replica knee "
+                    "recorded in the pre-existing --out report "
+                    "(meaningful on multi-core hosts; N replicas on "
+                    "one core share it)")
     args = ap.parse_args(argv)
+    if args.router and args.base_url:
+        ap.error("--router self-boots a fleet; drop --base-url")
+    if args.gate_vs_single and not args.router:
+        ap.error("--gate-vs-single only applies to --router sweeps")
     if args.smoke:
         args.base_url = None
         args.rates = "1,4"
@@ -670,6 +887,12 @@ def run(argv=None) -> dict:
         args.max_tokens_choices = "4,6"
         args.prompt_chars_choices = "32,64"
         args.gate = True
+        if args.router:
+            # The router smoke is the AFFINITY gate: emphasize the
+            # shared-prefix mix so the >0.5 hit-rate bar measures
+            # routing quality, not the unique-prompt fraction (a
+            # fully-unique request can never affinity-hit).
+            args.shared_prefix_frac = 0.75
 
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
     rng = random.Random(args.seed)
@@ -695,18 +918,57 @@ def run(argv=None) -> dict:
     }
 
     srv = None
+    fleet: list = []
+    rsrv = None
+    replica_bases: dict[str, str] | None = None
     base = args.base_url
     self_booted = base is None
+    # Router mode compares against the PRIOR single-replica report at
+    # --out (the same seeded sweep the single smoke just wrote): its
+    # knee becomes the baseline the multi-replica knee must beat.
+    single_baseline = None
+    if args.router and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            if not (prior.get("config") or {}).get("router_replicas"):
+                single_baseline = {
+                    "knee": prior.get("knee"),
+                    "rates_rps": (prior.get("config") or {}).get(
+                        "rates_rps"
+                    ),
+                }
+        except (OSError, ValueError):
+            single_baseline = None
     try:
-        if self_booted:
+        if args.router:
+            fleet, rsrv, base, replica_bases = boot_tiny_fleet(
+                args, args.router
+            )
+        elif self_booted:
             srv, base = boot_tiny_server(args)
         warmup(base, cfg, random.Random(args.seed + 2))
+        if replica_bases:
+            # The affinity router concentrates the warmup on one
+            # replica; touch every OTHER engine once directly so no
+            # replica meets its first request mid-measurement. (The
+            # XLA programs are already compiled — tiny replicas share
+            # one process-wide jit cache — this warms each engine
+            # thread's first-admission path.)
+            for rb in replica_bases.values():
+                send_stream(rb, {
+                    "messages": [{"role": "user", "content": "warm"}],
+                    "max_tokens": 2, "stream": True,
+                }, cfg["request_timeout"])
         stages = []
         stragglers: list = []  # live threads from earlier stages
         for rate in rates:
             print(f"stage: offered {rate:g} req/s for "
                   f"{args.duration:g}s ...", file=sys.stderr)
-            st = run_stage(base, rate, cfg, rng, carryover=stragglers)
+            st = run_stage(
+                base, rate, cfg, rng, carryover=stragglers,
+                replicas=replica_bases, router=bool(args.router),
+            )
             print(
                 f"  sent={st['sent']} ok={st['ok']} "
                 f"good_frac={st['slo_good_frac']} "
@@ -719,7 +981,10 @@ def run(argv=None) -> dict:
             "bench": "loadgen",
             "config": {
                 "gated": bool(args.gate),
-                "base_url": args.base_url or "self-boot tiny (cpu)",
+                "base_url": args.base_url or (
+                    f"self-boot router x{args.router} (cpu)"
+                    if args.router else "self-boot tiny (cpu)"
+                ),
                 "rates_rps": rates,
                 "duration_s": args.duration,
                 "seed": args.seed,
@@ -731,18 +996,33 @@ def run(argv=None) -> dict:
                 "shared_prefix_frac": args.shared_prefix_frac,
                 "shared_prefix_chars": args.shared_prefix_chars,
                 "smoke": args.smoke,
+                "router_replicas": args.router or None,
             },
             "stages": stages,
             "knee": knee,
             "gate": {},
         }
+        if args.router and single_baseline is not None:
+            report["single_baseline"] = single_baseline
         # Cost-ledger audit rides the same server session (the flight
-        # recorder still holds the sweep's requests).
+        # recorder still holds the sweep's requests; the router merges
+        # its replicas').
         ledger_problems = check_cost_ledger(base)
         report["gate"] = evaluate_gate(
-            report, ledger_problems=ledger_problems
+            report, ledger_problems=ledger_problems,
+            require_affinity=0.5
+            if args.router and args.shared_prefix_frac >= 0.5 else None,
+            vs_single=args.gate_vs_single,
         )
     finally:
+        if rsrv is not None:
+            rsrv.stop_prober()
+        for s in fleet:
+            if s.scheduler is not None:
+                s.scheduler.close()
+            s.shutdown()
+        if rsrv is not None:
+            rsrv.shutdown()
         if srv is not None:
             if srv.scheduler is not None:
                 srv.scheduler.close()

@@ -155,9 +155,9 @@ def test_window_engine_rejects_slo_flags():
     flags there would look armed while every breach went unobserved."""
     from oryx_tpu.serve import api_server
 
-    with pytest.raises(ValueError, match="continuous"):
+    with pytest.raises(ValueError, match="scheduler engine"):
         api_server.build_server(None, engine="window", ttft_slo=1.0)
-    with pytest.raises(ValueError, match="continuous"):
+    with pytest.raises(ValueError, match="scheduler engine"):
         api_server.build_server(None, engine="window", queue_depth_slo=4)
 
 

@@ -1,0 +1,132 @@
+"""The Engine interface (serve/engine.py): protocol conformance of the
+reference implementation, factory-registry error behavior, and the
+sharded engine's mesh invariants."""
+
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve.engine import (
+    Engine,
+    create_engine,
+    engine_names,
+    register_engine,
+)
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+def test_registry_names():
+    assert "continuous" in engine_names()
+    assert "sharded" in engine_names()
+
+
+def test_continuous_scheduler_satisfies_engine_protocol(pipe):
+    """The reference implementation must carry EVERY protocol member —
+    a scheduler refactor that sheds one (readiness, cancel, drain,
+    fail_inflight...) breaks the router/supervisor/API-server contract
+    and must fail here, not in production."""
+    sched = create_engine(
+        "continuous", pipe, num_slots=2, page_size=16, chunk=4,
+        max_ctx=512, autostart=False,
+    )
+    assert isinstance(sched, ContinuousScheduler)
+    assert isinstance(sched, Engine)
+    # readiness() before start: the loop thread is not alive.
+    ready, reason = sched.readiness()
+    assert ready is False and "dead" in reason
+    sched.start()
+    try:
+        assert sched.alive()
+        assert sched.readiness() == (True, "ok")
+        assert sched.queue_len() == 0
+        h = sched.submit({"question": "hello there"}, 3)
+        reply, why, usage = h.result(timeout=600)
+        assert reply and why in ("stop", "length")
+        # cancel() on a finished handle is a no-op flag flip.
+        sched.cancel(h)
+        assert h.cancelled
+    finally:
+        sched.stop()
+    assert not sched.alive()
+    assert sched.stopping
+
+
+def test_unknown_engine_name_fails_fast(pipe):
+    with pytest.raises(ValueError, match="unknown engine"):
+        create_engine("warp-drive", pipe)
+
+
+def test_duplicate_registration_rejected():
+    with pytest.raises(ValueError, match="already registered"):
+        register_engine("continuous")(lambda pipe, **kw: None)
+
+
+def test_sharded_engine_requires_tp_mesh(pipe):
+    """--engine sharded must never silently fall back to one chip:
+    no mesh, a tp-less mesh, and indivisible KV heads all refuse at
+    construction."""
+    with pytest.raises(ValueError, match="mesh absent"):
+        create_engine("sharded", pipe, autostart=False)
+    if jax.device_count() >= 2:
+        from oryx_tpu.config import MeshConfig
+        from oryx_tpu.parallel.mesh import build_mesh
+
+        cfg = pipe.cfg
+        fsdp_mesh = build_mesh(
+            MeshConfig(fsdp=2), devices=jax.devices()[:2]
+        )
+        meshed = OryxInference(
+            FakeTokenizer(), pipe.params, cfg, mesh=fsdp_mesh,
+            sharding_mode="fsdp",
+        )
+        with pytest.raises(ValueError, match="tp axis"):
+            create_engine("sharded", meshed, autostart=False)
+    if jax.device_count() >= 4:
+        from oryx_tpu.config import MeshConfig
+        from oryx_tpu.parallel.mesh import build_mesh
+
+        # tiny cfg has 2 KV heads; tp=4 cannot divide them.
+        mesh4 = build_mesh(MeshConfig(tp=4), devices=jax.devices()[:4])
+        meshed4 = OryxInference(
+            FakeTokenizer(), pipe.params, pipe.cfg, mesh=mesh4,
+        )
+        with pytest.raises(ValueError, match="do not divide"):
+            create_engine("sharded", meshed4, autostart=False)
+
+
+def test_sharded_engine_builds_on_tp_mesh(pipe):
+    if jax.device_count() < 2:
+        pytest.skip("needs multiple (CPU) devices")
+    from oryx_tpu.config import MeshConfig
+    from oryx_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    meshed = OryxInference(FakeTokenizer(), pipe.params, pipe.cfg,
+                           mesh=mesh, sharding_mode="tp")
+    eng = create_engine(
+        "sharded", meshed, num_slots=2, page_size=16, chunk=4,
+        max_ctx=512, autostart=False,
+    )
+    try:
+        assert isinstance(eng, Engine)
+        assert not eng.kv_pages["k"].sharding.is_fully_replicated
+    finally:
+        eng.close()

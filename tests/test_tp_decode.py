@@ -1,0 +1,230 @@
+"""Tensor-parallel paged decode parity (ROADMAP item 2a).
+
+The contract: putting the paged KV pool on a tp mesh — heads sharded
+over the tp axis (parallel/sharding.shard_paged_kv), params placed by
+the serving shardings, dispatches under the mesh scope — changes
+WHERE attention computes, never WHAT it computes. Each shard runs its
+own heads' pages exactly as the single-device path does, so greedy
+token ids are bit-identical across: mixed prompt lengths, chunked
+decode, prefix-cache splices, and eviction replay. Runs on the
+forced-8-CPU-device test platform (conftest), the same
+`--xla_force_host_platform_device_count` mechanism a dev box uses."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.config import MeshConfig
+from oryx_tpu.models import generate as gen_lib
+from oryx_tpu.models import oryx, qwen2
+from oryx_tpu.parallel.mesh import build_mesh
+from oryx_tpu.parallel.sharding import paged_kv_spec, shard_paged_kv
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils.metrics import ServingMetrics
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+def _tp_mesh(n: int = 2):
+    if jax.device_count() < n:
+        pytest.skip("needs multiple (CPU) devices")
+    return build_mesh(MeshConfig(tp=n), devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# Placement helpers
+# ---------------------------------------------------------------------------
+
+
+def test_paged_kv_spec_shapes():
+    mesh = _tp_mesh(2)
+    spec = paged_kv_spec(mesh)
+    assert spec is not None and spec[3] == "tp"
+    # No tp width -> replicate (an fsdp-only serving mesh keeps the
+    # pool whole).
+    fsdp_mesh = build_mesh(MeshConfig(fsdp=2), devices=jax.devices()[:2])
+    assert paged_kv_spec(fsdp_mesh) is None
+    assert paged_kv_spec(None) is None
+
+
+def test_shard_paged_kv_places_heads():
+    mesh = _tp_mesh(2)
+    cfg = cfg_lib.tiny_llm()
+    kv = qwen2.init_paged_kv_cache(cfg, 8, 16, dtype=jnp.float32)
+    placed = shard_paged_kv(kv, mesh)
+    assert not placed["k"].sharding.is_fully_replicated
+    # Indivisible heads fall back to replication instead of failing.
+    mesh4 = _tp_mesh(4) if jax.device_count() >= 4 else None
+    if mesh4 is not None:
+        mesh4 = build_mesh(MeshConfig(tp=4), devices=jax.devices()[:4])
+        odd = qwen2.init_paged_kv_cache(cfg, 8, 16, dtype=jnp.float32)
+        # tiny cfg has 2 kv heads: 2 % 4 != 0 -> same pytree back.
+        same = shard_paged_kv(odd, mesh4)
+        assert same is odd
+
+
+# ---------------------------------------------------------------------------
+# generate_paged parity on a tp mesh
+# ---------------------------------------------------------------------------
+
+
+def _embed(params, ids):
+    return params["embed"]["weight"][jnp.asarray(ids)]
+
+
+def _tp_llm_params(params, mesh):
+    """Place raw-LLM params by the serving tp shardings (head/mlp
+    columns split, embeddings replicated) — the same rules a meshed
+    pipeline serves under."""
+    from oryx_tpu.serve.builder import serving_param_shardings
+
+    sh = serving_param_shardings(mesh, {"llm": params}, "tp")["llm"]
+    return jax.tree.map(jax.device_put, params, sh)
+
+
+def test_generate_paged_tp_parity_mixed_lengths():
+    """Greedy paged decode on a tp=2 mesh (params sharded, KV pool
+    heads-sharded) is BIT-identical to the single-device paged path
+    over mixed prompt lengths."""
+    mesh = _tp_mesh(2)
+    cfg = cfg_lib.tiny_llm()
+    params = qwen2.init_params(cfg, jax.random.key(0))
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(0)
+    B, Tb, max_new, cache_len = 3, 16, 12, 32
+    lengths = np.array([5, 11, 16], np.int32)
+    ids = rng.integers(1, 128, size=(B, Tb)).astype(np.int32)
+    ref_toks, ref_num, ref_fin = gen_lib.generate_paged(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids),
+        lengths=lengths, max_new_tokens=max_new, page_size=8, chunk=4,
+        kv_capacity=cache_len,
+    )
+    params_tp = _tp_llm_params(params, mesh)
+    assert any(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree_util.tree_leaves(params_tp)
+    )
+    toks, num, fin, state = gen_lib.generate_paged(
+        params_tp, cfg, gcfg, inputs_embeds=_embed(params_tp, ids),
+        lengths=lengths, max_new_tokens=max_new, page_size=8, chunk=4,
+        kv_capacity=cache_len, mesh=mesh, return_state=True,
+    )
+    # The pool really decoded sharded (not silently replicated).
+    assert not state.kv_pages["k"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(ref_toks), np.asarray(toks))
+    np.testing.assert_array_equal(np.asarray(ref_num), np.asarray(num))
+    np.testing.assert_array_equal(np.asarray(ref_fin), np.asarray(fin))
+
+
+def test_generate_paged_tp_parity_chunked_prefill():
+    """Chunked prefill under the mesh: bounded prefill windows over a
+    heads-sharded pool still match the single-device single-shot."""
+    mesh = _tp_mesh(2)
+    cfg = cfg_lib.tiny_llm()
+    params = qwen2.init_params(cfg, jax.random.key(0))
+    gcfg = cfg_lib.GenerationConfig(temperature=0.0, eos_token_id=7)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 128, size=(2, 16)).astype(np.int32)
+    lengths = np.array([13, 16], np.int32)
+    ref = gen_lib.generate_paged(
+        params, cfg, gcfg, inputs_embeds=_embed(params, ids),
+        lengths=lengths, max_new_tokens=8, page_size=8, chunk=4,
+        kv_capacity=32,
+    )
+    got = gen_lib.generate_paged(
+        _tp_llm_params(params, mesh), cfg, gcfg,
+        inputs_embeds=_embed(params, ids),
+        lengths=lengths, max_new_tokens=8, page_size=8, chunk=4,
+        kv_capacity=32, prefill_chunk=8, mesh=mesh,
+    )
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler parity on a tp mesh: prefix-cache hits + eviction replay
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _run_all(sched, reqs):
+    handles = [sched.submit({"question": q}, cap) for q, cap in reqs]
+    sched.start()
+    results = [h.result(timeout=600) for h in handles]
+    sched.close()
+    return results
+
+
+def test_scheduler_tp_parity_with_prefix_cache(tiny_model):
+    """The continuous engine on a tp=2 pipe (KV pool heads-sharded by
+    _place_kv): shared-template prompts splice from the prefix cache
+    and every reply equals the UNSHARDED solo pipeline's — cache hits
+    over a sharded pool reuse KV bit-equal."""
+    mesh = _tp_mesh(2)
+    cfg, params = tiny_model
+    ref_pipe = OryxInference(FakeTokenizer(), params, cfg)
+    pipe = OryxInference(
+        FakeTokenizer(), params, cfg, mesh=mesh, sharding_mode="tp"
+    )
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    assert not sched.kv_pages["k"].sharding.is_fully_replicated
+    reqs = [("hello there", 5), ("hello there friend", 5),
+            ("hello there again, why?", 4)]
+    results = _run_all(sched, reqs)
+    for (q, cap), (reply, _, _) in zip(reqs, results):
+        assert reply == ref_pipe.chat(q, max_new_tokens=cap), q
+    # The shared template/prompt prefix actually hit the cache.
+    assert metrics.get("prefix_cache_hit_tokens_total") > 0
+
+
+def test_scheduler_tp_parity_eviction_replay(tiny_model):
+    """Page pressure on the SHARDED pool: the younger slot evicts,
+    replays deterministically, and both replies stay byte-identical to
+    the unsharded solo path (same bar as the single-device eviction
+    test — eviction bookkeeping is host-side and placement-blind)."""
+    import math
+
+    mesh = _tp_mesh(2)
+    cfg, params = tiny_model
+    ref_pipe = OryxInference(FakeTokenizer(), params, cfg)
+    pipe = OryxInference(
+        FakeTokenizer(), params, cfg, mesh=mesh, sharding_mode="tp"
+    )
+    q1, q2 = "hello there", "tell me more"
+    chunk, ps = 4, 16
+    ids1 = len(pipe._prepare_request({"question": q1})[0])
+    ids2 = len(pipe._prepare_request({"question": q2})[0])
+    admit1 = math.ceil((ids1 + chunk) / ps)
+    admit2 = math.ceil((ids2 + chunk) / ps)
+    cap = (admit1 * ps - ids1) + ps  # forces one extra page per row
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
+        num_pages=admit1 + admit2 + 1, metrics=metrics, autostart=False,
+        prefix_cache=False,
+    )
+    results = _run_all(sched, [(q1, cap), (q2, cap)])
+    assert metrics.get("evicted") >= 1
+    for q, (reply, _, usage) in zip((q1, q2), results):
+        assert reply == ref_pipe.chat(q, max_new_tokens=cap), q
+        assert usage[1] == cap
