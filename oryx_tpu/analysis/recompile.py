@@ -148,6 +148,65 @@ class RecompileHazardChecker(Checker):
             for d in fn.decorator_list
         )
 
+    @staticmethod
+    def _dynamic_names(expr: ast.expr) -> list[ast.Name]:
+        """Name loads in `expr` whose VALUE flows into the result —
+        excluding occurrences that are static under trace: bases of
+        .shape/.ndim/.dtype/.size attribute chains and len() operands
+        (array lengths are shape components)."""
+        static_ids: set[int] = set()
+        for node in ast.walk(expr):
+            sub = None
+            if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "dtype", "size",
+            ):
+                sub = node.value
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len"
+            ):
+                sub = node.args[0] if node.args else None
+            if sub is not None:
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Name):
+                        static_ids.add(id(n))
+        return [
+            n for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and id(n) not in static_ids
+        ]
+
+    def _tainted_locals(
+        self, fn: ast.FunctionDef, traced: set[str]
+    ) -> set[str]:
+        """Locals DERIVED from traced parameters (the packed-buffer
+        idiom hazard: `num_live = (~finished).sum()` then
+        `if num_live:` branches Python on a tracer just as surely as
+        branching on the parameter itself). Conservative dataflow:
+        single-name assignments whose value reads a traced/tainted name
+        outside a static (.shape/len) context taint the target; run to
+        fixpoint so chains (`a = x; b = a`) and loop back-edges
+        resolve."""
+        tainted: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    continue
+                tgt = node.targets[0].id
+                if tgt in tainted:
+                    continue
+                names = {n.id for n in self._dynamic_names(node.value)}
+                if names & (traced | tainted):
+                    tainted.add(tgt)
+                    changed = True
+        return tainted
+
     def _check_tracer_branches(
         self, mod: ParsedModule, fn: ast.FunctionDef, statics: set[str]
     ) -> Iterator[Finding | None]:
@@ -156,11 +215,13 @@ class RecompileHazardChecker(Checker):
             for a in list(fn.args.args) + list(fn.args.kwonlyargs)
             if a.arg not in statics and a.arg != "self"
         }
+        tainted = self._tainted_locals(fn, traced)
 
         def value_dependent_names(test: ast.expr) -> list[ast.Name]:
-            """Direct value tests on a traced parameter name."""
+            """Direct value tests on a traced parameter name or a local
+            derived from one."""
             if isinstance(test, ast.Name):
-                return [test] if test.id in traced else []
+                return [test] if test.id in traced | tainted else []
             if isinstance(test, ast.UnaryOp) and isinstance(
                 test.op, ast.Not
             ):
@@ -180,7 +241,7 @@ class RecompileHazardChecker(Checker):
                 for side in [test.left, *test.comparators]:
                     if (
                         isinstance(side, ast.Name)
-                        and side.id in traced
+                        and side.id in traced | tainted
                     ):
                         out.append(side)
                 return out
@@ -190,12 +251,19 @@ class RecompileHazardChecker(Checker):
             if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
                 continue
             for name in value_dependent_names(node.test):
+                what = (
+                    f"traced argument '{name.id}'"
+                    if name.id in traced
+                    else f"'{name.id}' (derived from a traced argument)"
+                )
                 yield self.finding(
                     mod,
                     name,
-                    f"Python branch on traced argument '{name.id}' "
-                    f"inside jitted '{fn.name}' — use jnp.where/"
-                    "lax.cond, or mark it static",
+                    f"Python branch on {what} inside jitted "
+                    f"'{fn.name}' — use jnp.where/lax.cond, mark it "
+                    "static, or hoist the decision to host state (the "
+                    "packed-buffer idiom: shape-class selection happens "
+                    "OUTSIDE the jitted ragged step)",
                 )
 
     def _check_static_operands(

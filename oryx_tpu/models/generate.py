@@ -651,6 +651,202 @@ def paged_decode_chunk(
     return carry + (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1))
 
 
+# ---------------------------------------------------------------------------
+# Ragged fused prefill+decode step (one dispatch per engine step)
+# ---------------------------------------------------------------------------
+
+
+def pack_prefill_window(
+    embeds_np: "np.ndarray",  # [1, T, H] HOST prompt embeds
+    off: int,
+    width: int,
+) -> "np.ndarray":
+    """Host-side packing helper: the [1, width, H] prefill window at
+    logical offset `off` of a prompt whose embeds live on the HOST,
+    zero-padded past the prompt end. The window — not the whole prompt
+    — is the ragged dispatch's operand, so the dispatch shape is STATIC
+    regardless of prompt length (the split path's `slice_embeds`
+    compiles one device slicer per (T, width) pair instead; here the
+    slice is free numpy)."""
+    T, H = embeds_np.shape[1], embeds_np.shape[2]
+    out = np.zeros((1, width, H), embeds_np.dtype)
+    n = max(0, min(width, T - off))
+    if n:
+        out[0, :n] = embeds_np[0, off:off + n]
+    return out
+
+
+def unpack_ragged_rows(
+    toks: "np.ndarray",  # [S, chunk] harvested decode tokens
+    live: list[int],
+) -> dict[int, list[int]]:
+    """Host-side unpacking helper: per-slot token streams from the
+    ragged harvest, restricted to the slots that were live DURING the
+    dispatch (a slot activated after harvest must not consume this
+    dispatch's frozen rows)."""
+    return {s: [int(t) for t in toks[s]] for s in live}
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "chunk", "pf_width", "eos", "attn_impl", "compute_dtype",
+    ),
+    donate_argnames=("kv_pages",),
+)
+def paged_ragged_step(
+    params,
+    cfg: LLMConfig,
+    kv_pages: dict,  # donated
+    block_tables: jnp.ndarray,  # [S, max_pages] int32
+    tok: jnp.ndarray,  # [S] next token to feed per slot
+    lengths: jnp.ndarray,  # [S] kv tokens held per slot (frozen on finish)
+    finished: jnp.ndarray,  # [S] bool (True for finished AND empty slots)
+    recent: jnp.ndarray,  # [S, stop_L] rolling stop window (-2 init)
+    keys: jax.Array,  # [S] per-slot PRNG keys
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    stop_sequences: jnp.ndarray | None,  # [Sq, L] (shared, static)
+    pf_embeds: jnp.ndarray,  # [1, chunk*pf_width, H] prefill window
+    pf_slot: jnp.ndarray,  # [] int32 slot the prefill belongs to
+    pf_off: jnp.ndarray,  # [] int32 logical offset of the window start
+    pf_len: jnp.ndarray,  # [] int32 total prompt length (incl. prefix)
+    pf_active: jnp.ndarray,  # [] bool — a prefill rides this dispatch
+    pf_key: jax.Array,  # [1] the admitting request's key0
+    pf_temp: jnp.ndarray,  # [1]
+    pf_top_p: jnp.ndarray,  # [1]
+    pf_top_k: jnp.ndarray,  # [1]
+    *,
+    chunk: int,
+    pf_width: int,
+    eos: int,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+):
+    """ONE device dispatch for a mixed prefill+decode engine step — the
+    fusion of `paged_prefill` (chunked) and `paged_decode_chunk`.
+
+    Each of the `chunk` scan iterations runs a single packed forward
+    over R = S + pf_width query rows: rows 0..S-1 are the decode lanes
+    (one token per slot, exactly `paged_decode_chunk`'s step semantics
+    — finished/empty slots ride masked), rows S.. are `pf_width`
+    consecutive suffix tokens of the one admitting slot's prompt, so a
+    dispatch advances the prefill by chunk*pf_width tokens while every
+    resident stream decodes `chunk` tokens. The packed buffer's shape
+    is STATIC: which slot is admitting, where its window starts, and
+    how much of it is real are all traced scalars
+    (`recompile_watchdog`-proven — varying live/prefill mixes share one
+    compiled program per pf_width shape class).
+
+    Bit-parity contract: decode lanes reproduce `paged_decode_chunk`
+    exactly (same per-row math, same RNG stream); the prefill lanes
+    reproduce `paged_prefill_chunks` (every window implicitly seeded
+    with the request's own key0, only the window containing the prompt
+    's final token samples tok0 ~ split(key0)[1], advanced key
+    split(key0)[0]) — so an engine step through this program emits the
+    same tokens as the split prefill-then-decode step pair.
+
+    Returns (kv_pages, tok, lengths, finished, recent, keys,
+    toks [S, chunk], fin [S, chunk], pf_tok0 [] int32, pf_key_next [1]).
+    With pf_width=0 this is a pure packed decode step (the shape class
+    dispatched when no admission is in flight)."""
+    from oryx_tpu.parallel.sharding import constrain
+
+    S = tok.shape[0]
+    W = pf_width
+
+    def stop_hit(recent):
+        if stop_sequences is None:
+            return jnp.zeros((recent.shape[0],), bool)
+        m = (stop_sequences[None] == -1) | (
+            recent[:, None, :] == stop_sequences[None]
+        )
+        return jnp.any(jnp.all(m, axis=-1), axis=-1)
+
+    def embed(ids):
+        # The exact lookup `forward(input_ids=...)` performs, so decode
+        # lanes stay bit-identical to the split path's embeds.
+        e = constrain(params["embed"]["weight"], None, None)[ids]
+        return e.astype(compute_dtype) if compute_dtype is not None else e
+
+    def step(carry, i):
+        kv_pages, tok, cur_len, finished, recent, keys, pf_tok0 = carry
+        pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        dec_emb = embed(tok)  # [S, H]
+        seg = jnp.arange(S, dtype=jnp.int32)
+        pos = cur_len
+        wm = ~finished
+        if W:
+            pf_win = jax.lax.dynamic_slice_in_dim(
+                pf_embeds, i * W, W, axis=1
+            )[0]
+            pf_pos = pf_off + i * W + jnp.arange(W, dtype=jnp.int32)
+            emb = jnp.concatenate(
+                [dec_emb, pf_win.astype(dec_emb.dtype)], axis=0
+            )
+            pos = jnp.concatenate([pos, pf_pos])
+            seg = jnp.concatenate(
+                [seg, jnp.full((W,), 1, jnp.int32) * pf_slot]
+            )
+            # Prefill lanes write whenever a prefill rides the dispatch
+            # (window overshoot past the prompt writes the same
+            # never-read-before-overwritten garbage the split chunked
+            # prefill writes — parity includes the pool bytes).
+            wm = jnp.concatenate(
+                [wm, jnp.broadcast_to(pf_active, (W,))]
+            )
+        else:
+            emb = dec_emb
+        logits, kv_pages = qwen2.forward(
+            params, cfg,
+            inputs_embeds=emb[None], positions=pos[None],
+            kv_cache=kv_pages, block_tables=block_tables,
+            q_segments=seg[None], write_mask=wm[None],
+            attn_impl=attn_impl, compute_dtype=compute_dtype,
+        )
+        lg = logits[0]  # [R, V]
+        nxt = sample_token_rows(
+            lg[:S], pair[:, 1],
+            temperature=temperature, top_p=top_p, top_k=top_k,
+        )
+        if recent.shape[1]:
+            recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
+        finished = finished | (tok == eos) | stop_hit(recent)
+        nxt = jnp.where(finished, eos, nxt)
+        cur_len = cur_len + (~finished).astype(jnp.int32)
+        if W:
+            # Did the prompt's final real token land in THIS window?
+            pf_pair = jax.vmap(lambda k: jax.random.split(k, 2))(pf_key)
+            j = pf_len - 1 - pf_off - i * W
+            present = pf_active & (j >= 0) & (j < W)
+            row = jax.lax.dynamic_index_in_dim(
+                lg, S + jnp.clip(j, 0, W - 1), axis=0, keepdims=True
+            )  # [1, V]
+            cand = sample_token_rows(
+                row, pf_pair[:, 1],
+                temperature=pf_temp, top_p=pf_top_p, top_k=pf_top_k,
+            )[0]
+            pf_tok0 = jnp.where(present, cand, pf_tok0)
+        return (
+            kv_pages, nxt, cur_len, finished, recent, pair[:, 0], pf_tok0
+        ), (tok, finished)
+
+    carry, (toks, fin) = jax.lax.scan(
+        step,
+        (kv_pages, tok, lengths, finished, recent, keys,
+         jnp.zeros((), jnp.int32)),
+        jnp.arange(chunk, dtype=jnp.int32),
+    )
+    kv_pages, tok, lengths, finished, recent, keys, pf_tok0 = carry
+    pf_key_next = jax.vmap(lambda k: jax.random.split(k, 2))(pf_key)[:, 0]
+    return (
+        kv_pages, tok, lengths, finished, recent, keys,
+        jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1),
+        pf_tok0, pf_key_next,
+    )
+
+
 @dataclasses.dataclass
 class PagedState:
     """Host half of a paged decode: the device page pool plus the
@@ -726,11 +922,20 @@ def generate_paged(
     return_state: bool = False,
     prefill_chunk: int | None = None,
     mesh=None,
+    ragged: bool = False,
 ):
     """`generate`, but over a paged KV cache in `chunk`-step compiled
     dispatches — the reference driver for the continuous-batching path
     (the scheduler runs the same `paged_prefill`/`paged_decode_chunk`
     programs with slots owned by different requests).
+
+    ragged: route every decode chunk through `paged_ragged_step` — the
+    PACKED one-dispatch program (all rows ride one [1, B] query buffer
+    with per-token segments instead of a [B, 1] batch) the continuous
+    engine uses to fuse prefill and decode. Greedy token ids are
+    bit-identical to ragged=False (per-row math is batch-layout
+    independent); this is the standalone parity hook for the fused
+    serving path (tests/test_ragged_attention.py).
 
     Greedy token ids are bit-identical to `generate` when `kv_capacity`
     matches the dense call's `cache_len` (identical fp32 reductions;
@@ -843,16 +1048,39 @@ def generate_paged(
     eos = gen_cfg.eos_token_id
     toks_out = np.full((B, padded_new), eos, np.int32)
     fin_out = np.ones((B, padded_new), bool)
+    H = inputs_embeds.shape[2]
+    ragged_blanks = dict(
+        pf_embeds=jnp.zeros((1, 0, H), inputs_embeds.dtype),
+        pf_slot=jnp.asarray(0, jnp.int32),
+        pf_off=jnp.asarray(0, jnp.int32),
+        pf_len=jnp.asarray(0, jnp.int32),
+        pf_active=jnp.asarray(False),
+        pf_temp=jnp.zeros((1,), jnp.float32),
+        pf_top_p=jnp.ones((1,), jnp.float32),
+        pf_top_k=jnp.zeros((1,), jnp.int32),
+    )
     done = 0
     while done < max_new_tokens:
         with scope():
-            (state.kv_pages, tok, cur_len, finished, recent, row_keys,
-             toks, fin) = paged_decode_chunk(
-                params, cfg, state.kv_pages, bt, tok, cur_len, finished,
-                recent, row_keys, temp, top_p, top_k, stop_sequences,
-                chunk=chunk, eos=eos, attn_impl=attn_impl,
-                compute_dtype=compute_dtype,
-            )
+            if ragged:
+                (state.kv_pages, tok, cur_len, finished, recent,
+                 row_keys, toks, fin, _, _) = paged_ragged_step(
+                    params, cfg, state.kv_pages, bt, tok, cur_len,
+                    finished, recent, row_keys, temp, top_p, top_k,
+                    stop_sequences, pf_key=row_keys[:1],
+                    **ragged_blanks,
+                    chunk=chunk, pf_width=0, eos=eos,
+                    attn_impl=attn_impl, compute_dtype=compute_dtype,
+                )
+            else:
+                (state.kv_pages, tok, cur_len, finished, recent,
+                 row_keys, toks, fin) = paged_decode_chunk(
+                    params, cfg, state.kv_pages, bt, tok, cur_len,
+                    finished, recent, row_keys, temp, top_p, top_k,
+                    stop_sequences,
+                    chunk=chunk, eos=eos, attn_impl=attn_impl,
+                    compute_dtype=compute_dtype,
+                )
         # The once-per-chunk harvest this loop exists to amortize (and
         # the early-exit below needs host booleans).
         # oryxlint: off=host-sync

@@ -210,6 +210,7 @@ def _block(
     block_tables: jnp.ndarray | None = None,
     write_mask: jnp.ndarray | None = None,
     kv_lengths: jnp.ndarray | None = None,
+    q_segments: jnp.ndarray | None = None,
     attn_impl: str = "xla",
 ):
     """One decoder block. h: [B, T, H]. Returns (h, new_k, new_v)."""
@@ -225,7 +226,37 @@ def _block(
     k = checkpoint_name(k, "attn_k")
     v = checkpoint_name(v, "attn_v")
 
-    if cache_k is not None and block_tables is not None:
+    if cache_k is not None and block_tables is not None and (
+        q_segments is not None
+    ):
+        # Packed RAGGED paged mode (one dispatch, mixed query lengths):
+        # the T axis is a PACKED buffer of rows from many sequences —
+        # block_tables is [num_slots, max_pages] (not per batch row) and
+        # each token routes by (q_segments, positions). write_mask here
+        # is PER TOKEN [B, T]. See ops/paged_kv.write_pages_packed /
+        # ragged_paged_attention and models/generate.paged_ragged_step.
+        from oryx_tpu.ops import paged_kv
+
+        seg = q_segments[0]
+        pos = positions[0]
+        wm = None if write_mask is None else write_mask[0]
+        cache_k = paged_kv.write_pages_packed(
+            cache_k, k[0], block_tables, seg, pos, write_mask=wm
+        )
+        cache_v = paged_kv.write_pages_packed(
+            cache_v, v[0], block_tables, seg, pos, write_mask=wm
+        )
+        if attn_impl == "pallas":
+            from oryx_tpu.ops.pallas import paged_attention as _ppa
+
+            attn_out = _ppa.ragged_paged_attention(
+                q[0], cache_k, cache_v, block_tables, seg, pos
+            )[None]
+        else:
+            attn_out = paged_kv.ragged_paged_attention(
+                q[0], cache_k, cache_v, block_tables, seg, pos
+            )[None]
+    elif cache_k is not None and block_tables is not None:
         # Paged cache: this layer's K/V pool is [P, page, Hk, D] and the
         # row's logical stream is addressed through its block table.
         from oryx_tpu.ops import paged_kv
@@ -301,6 +332,7 @@ def forward(
     block_tables: jnp.ndarray | None = None,
     write_mask: jnp.ndarray | None = None,
     kv_lengths: jnp.ndarray | None = None,
+    q_segments: jnp.ndarray | None = None,
     remat: bool | str = False,
     attn_impl: str = "xla",
     mesh=None,
@@ -334,6 +366,18 @@ def forward(
         cache writes (finished/empty serving slots). kv_lengths [B] (valid
         kv count incl. the current token) enables the in-place Pallas
         ragged decode kernel for single-token steps under attn_impl=pallas.
+      q_segments: packed RAGGED paged mode ([B=1, T] int32, requires
+        block_tables): the T axis is a packed buffer of query rows from
+        many sequences with MIXED query lengths — q_segments names each
+        token's owning slot, `positions` its absolute position, and
+        block_tables is [num_slots, max_pages]. Every token writes its
+        K/V through its own slot's table and attends that slot's pages
+        causally at its own position (ops/paged_kv.write_pages_packed /
+        ragged_paged_attention; Pallas twin under attn_impl=pallas).
+        write_mask is then PER TOKEN [1, T]; kv_mask/kv_lengths are
+        unused (the causal mask at each row's position IS the validity
+        mask). This is the one-dispatch mixed prefill+decode serving
+        path (models/generate.paged_ragged_step).
       segment_ids: [B, T] int32 SAMPLE ids for sequence-packed training
         (0 = pad): attention is causal in SLOT order and masked on
         segment equality, so samples packed into one row never attend
@@ -375,6 +419,16 @@ def forward(
             "segment_ids (packed training) requires attn_impl xla|pallas "
             "and no kv_cache"
         )
+    if q_segments is not None:
+        if block_tables is None or kv_cache is None:
+            raise ValueError(
+                "q_segments (packed ragged serving) requires a paged "
+                "kv_cache with block_tables"
+            )
+        if B != 1:
+            raise ValueError(
+                f"q_segments packs many sequences into ONE row; got B={B}"
+            )
 
     # NOTE for new attn impls: every branch's implementation must tag its
     # output `checkpoint_name(out, "flash_out")` (plus "flash_lse" where a
@@ -446,6 +500,7 @@ def forward(
             block_tables=block_tables,
             write_mask=write_mask,
             kv_lengths=kv_lengths,
+            q_segments=q_segments,
             attn_impl=attn_impl,
         )
         h = constrain(h, *hs_spec)

@@ -30,6 +30,16 @@ Three pieces live here:
     exactly 0 probability either way). The Pallas twin
     (`ops/pallas/paged_attention.py`) reads pages in place through the
     block table instead of gathering.
+  * `write_pages_packed` / `ragged_paged_attention` — the PACKED
+    (ragged) twins: one query buffer of R rows drawn from many
+    sequences with MIXED query lengths (decode rows contribute one
+    token, a chunked-prefill suffix contributes many), addressed per
+    row by (segment, position) instead of per batch row by (start, T).
+    This is what lets the serving engine run prefill suffixes and
+    decode steps for every live slot in ONE dispatch
+    (models/generate.paged_ragged_step; arXiv 2604.15464). The
+    reference here is the CPU bit-parity anchor; the Pallas twin walks
+    the block tables in place.
 """
 
 from __future__ import annotations
@@ -296,3 +306,90 @@ def ragged_decode_attention(
         scale=scale,
     )
     return out[:, 0] if squeezed else out
+
+
+# ---------------------------------------------------------------------------
+# Packed ragged mode: mixed query lengths, one buffer, one dispatch
+# ---------------------------------------------------------------------------
+
+
+def write_pages_packed(
+    cache_layer: jnp.ndarray,  # [P, page_size, Hk, D]
+    new: jnp.ndarray,  # [R, Hk, D] packed new K or V rows
+    block_tables: jnp.ndarray,  # [S, max_pages] int32 (sentinel = P)
+    q_segments: jnp.ndarray,  # [R] int32 owning slot per packed row
+    q_positions: jnp.ndarray,  # [R] int32 logical slot index per row
+    *,
+    write_mask: jnp.ndarray | None = None,  # [R] bool rows that may write
+) -> jnp.ndarray:
+    """Write R packed tokens into the page pool, each routed through its
+    OWN sequence's block table: row r lands at logical slot
+    q_positions[r] of sequence q_segments[r]. The packed twin of
+    `write_pages` (whose rows are per-sequence and contiguous): here a
+    decode token and a prefill-chunk token of two different sequences
+    sit side by side in one buffer and one scatter places both. Rows
+    with write_mask False — and any slot routed through the sentinel —
+    drop, exactly as in `write_pages`."""
+    P, ps, Hk, D = cache_layer.shape
+    S, maxp = block_tables.shape
+    seg = jnp.clip(q_segments.astype(jnp.int32), 0, S - 1)
+    pos = q_positions.astype(jnp.int32)
+    # Page index clamps into the row's own table (matching the
+    # take_along_axis OOB clamp of the per-sequence writer); the
+    # sentinel page then routes the write off the pool end -> dropped.
+    page = block_tables[seg, jnp.clip(pos // ps, 0, maxp - 1)]  # [R]
+    flat = page * ps + pos % ps
+    if write_mask is not None:
+        flat = jnp.where(write_mask, flat, P * ps)
+    pool = cache_layer.reshape(P * ps, Hk, D)
+    pool = pool.at[flat].set(new.astype(pool.dtype), mode="drop")
+    return pool.reshape(P, ps, Hk, D)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [R, Hq, D] packed query rows
+    k_pages: jnp.ndarray,  # [P, page_size, Hk, D]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [S, max_pages] int32
+    q_segments: jnp.ndarray,  # [R] int32 owning slot per packed row
+    q_positions: jnp.ndarray,  # [R] int32 absolute position per row
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Pure-JAX reference for packed RAGGED paged attention — the one
+    semantics both engine paths must agree on bit-for-bit.
+
+    Each packed row r attends to the KV prefix of its own sequence
+    q_segments[r], addressed through that sequence's block table,
+    causally masked at its own position: logical slot j is visible iff
+    j <= q_positions[r]. A decode step (one row at position len-1) and
+    a chunked-prefill suffix (one row per suffix token, consecutive
+    positions) are THE SAME case under this mask — which is exactly
+    what makes one dispatch serve a mixed batch. Returns [R, Hq, D].
+
+    Bit-parity contract (tests/test_ragged_attention.py): for a decode
+    row this reproduces `ragged_decode_attention` exactly (the causal
+    mask at position len-1 equals its kv_lengths mask), and for a
+    prefill row it reproduces the row's logits from the per-sequence
+    chunked prefill (same masked set, same fp32 reductions per row).
+    """
+    from oryx_tpu.parallel.sharding import constrain
+
+    R = q.shape[0]
+    S, maxp = block_tables.shape
+    seg = jnp.clip(q_segments.astype(jnp.int32), 0, S - 1)
+    k_all = gather_pages(k_pages, block_tables)  # [S, K, Hk, D]
+    v_all = gather_pages(v_pages, block_tables)
+    # On a tp mesh the pool is heads-sharded (sharding.paged_kv_spec);
+    # pin the gathered per-row view to the same head split so GSPMD
+    # never reshards the packed buffer's KV (no-op off-mesh).
+    k_r = constrain(k_all[seg], None, None, "tp", None)  # [R, K, Hk, D]
+    v_r = constrain(v_all[seg], None, None, "tp", None)
+    out = attention(
+        q[:, None], k_r, v_r,
+        causal=True,
+        q_positions=q_positions[:, None].astype(jnp.int32),
+        kv_positions=None,  # arange over logical slots == absolute positions
+        scale=scale,
+    )
+    return out[:, 0]

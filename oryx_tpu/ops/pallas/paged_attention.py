@@ -1,12 +1,27 @@
-"""Pallas TPU paged decode attention (ragged KV through block tables).
+"""Pallas TPU paged attention (ragged KV through block tables).
 
-The TPU twin of `ops.paged_kv.ragged_decode_attention`: one decode step
-attends over a sequence's pages IN PLACE — the block table is a
-scalar-prefetch operand, so each kv tile's DMA source address is
-computed from it before the tile runs, and no [B, max_len] contiguous
-copy of the cache is ever materialized (the XLA reference gathers one
-per layer per step; at 7B serving shapes that gather IS the decode
-bandwidth bill).
+Two kernels share one skeleton here:
+
+  * `ragged_decode_attention` — the original single-token decode twin
+    of `ops.paged_kv.ragged_decode_attention`: [B, 1] queries, one
+    sequence per batch row.
+  * `ragged_paged_attention` — the PACKED ragged kernel (arXiv
+    2604.15464): R query rows drawn from many sequences with MIXED
+    query lengths (decode steps and chunked-prefill suffix tokens side
+    by side), each walking its OWN sequence's block table via
+    scalar-prefetched (segment, position) metadata and causally masked
+    at its own position. This is the kernel behind the serving
+    engine's one-dispatch-per-step path
+    (models/generate.paged_ragged_step); its grid/tile parameters come
+    from a (head_dim, page_size)-keyed grid table that is autotuned
+    once per shape class and cached (`ragged_grid_config`).
+
+The TPU win in both: attention over a sequence's pages happens IN
+PLACE — the block table is a scalar-prefetch operand, so each kv
+tile's DMA source address is computed from it before the tile runs,
+and no [B, max_len] contiguous copy of the cache is ever materialized
+(the XLA reference gathers one per layer per step; at 7B serving
+shapes that gather IS the decode bandwidth bill).
 
 Shares the flash-attention kernel skeleton (ops/pallas/
 flash_attention.py): grid (B, Hk, num_pages_per_seq) with the page
@@ -191,3 +206,329 @@ def ragged_decode_attention(
     )
     out = out.reshape(B, Hq, D)
     return out if squeezed else out[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Packed ragged kernel: mixed query lengths, one grid, per-row block tables
+# ---------------------------------------------------------------------------
+#
+# Grid (R, Hk // HB, maxp): packed row outermost, kv-head tile, pages
+# innermost and sequential so the online-softmax scratch carries across
+# a row's page walk. Each grid step DMAs ONE page tile of HB kv heads
+# ([1, ps, HB, D], contiguous in the pool) and issues HB [G, ps] logit
+# matmuls. Raggedness per packed row r (seg = q_segments[r],
+# pos = q_positions[r]):
+#   * tiles wholly past pos skip compute AND DMA (index map clamps dead
+#     page ids onto the last live page; Pallas elides the repeat DMA);
+#   * the tail tile masks slots > pos to -inf before the softmax —
+#     the causal mask and the validity mask are the SAME mask here,
+#     which is what lets decode rows (pos = len-1) and prefill-suffix
+#     rows (consecutive pos) share the kernel;
+#   * sentinel block-table entries clip into the pool for address
+#     safety (only reachable masked).
+
+# The grid table: (head_dim, page_size) -> tile parameters. HB
+# (kv heads per tile) trades DMA count against VMEM residency:
+# doubling HB halves page-walk DMAs but doubles the kv tile and the
+# scratch footprint, so the sweet spot moves with head_dim x page_size
+# bytes. Seeded with VMEM-budget defaults; `autotune_ragged_grid`
+# measures the candidates once on real TPU and the winner is cached
+# per shape class for the life of the process (the serving engine
+# compiles one program per shape class, so the choice must be stable
+# — autotune ONCE, never per call).
+_RAGGED_GRID_CACHE: dict[tuple[int, int], dict] = {}
+
+# Keep the double-buffered kv tile (2 * ps * HB * D * 4B fp32) within a
+# conservative slice of VMEM alongside q/out/scratch.
+_RAGGED_KV_TILE_BUDGET = 1 << 21  # 2 MiB
+
+
+def _default_heads_per_block(head_dim: int, page_size: int) -> int:
+    """VMEM-budget default, honoring the $ORYX_RPA_HEADS_PER_BLOCK
+    operator pin (every cache-seeding path must route through this, or
+    a pinned tile size would be silently discarded for the life of the
+    process)."""
+    import os
+
+    env = os.environ.get("ORYX_RPA_HEADS_PER_BLOCK")
+    if env:
+        return max(1, int(env))
+    hb = 1
+    while (
+        hb < 8
+        and 2 * page_size * (hb * 2) * head_dim * 4
+        <= _RAGGED_KV_TILE_BUDGET
+    ):
+        hb *= 2
+    return hb
+
+
+def ragged_grid_config(
+    head_dim: int, page_size: int, num_kv_heads: int
+) -> dict:
+    """Tile parameters for the ragged kernel, keyed by shape class.
+
+    Resolution order: process-lifetime cache (autotuned or first-use
+    default) -> $ORYX_RPA_HEADS_PER_BLOCK override -> VMEM-budget
+    default. The returned heads_per_block always divides num_kv_heads
+    (clamped by gcd at use, so a cached choice from one model geometry
+    stays safe for another)."""
+    import math
+
+    key = (int(head_dim), int(page_size))
+    cfg = _RAGGED_GRID_CACHE.get(key)
+    if cfg is None:
+        cfg = {
+            "heads_per_block": _default_heads_per_block(
+                head_dim, page_size
+            ),
+            "autotuned": False,
+        }
+        _RAGGED_GRID_CACHE[key] = cfg
+    hb = math.gcd(cfg["heads_per_block"], int(num_kv_heads))
+    return {**cfg, "heads_per_block": max(1, hb)}
+
+
+def autotune_ragged_grid(
+    head_dim: int, page_size: int, num_kv_heads: int,
+    *, candidates=(1, 2, 4, 8), trials: int = 3,
+) -> dict:
+    """Time the heads_per_block candidates once on the real backend and
+    cache the winner for this (head_dim, page_size) shape class. On a
+    non-TPU backend (or if timing fails) the VMEM-budget default is
+    cached instead — the point is a STABLE choice per shape class, not
+    a per-call search."""
+    import math
+    import time as _time
+
+    key = (int(head_dim), int(page_size))
+    cached = _RAGGED_GRID_CACHE.get(key)
+    if cached is not None and cached.get("autotuned"):
+        return ragged_grid_config(head_dim, page_size, num_kv_heads)
+    if jax.default_backend() != "tpu":
+        _RAGGED_GRID_CACHE[key] = {
+            "heads_per_block": _default_heads_per_block(
+                head_dim, page_size
+            ),
+            "autotuned": False,
+        }
+        return ragged_grid_config(head_dim, page_size, num_kv_heads)
+    # Tiny synthetic problem in the target shape class.
+    R, S, maxp, P = 16, 8, 8, 64
+    Hk = int(num_kv_heads)
+    key_ = jax.random.key(0)
+    q = jax.random.normal(key_, (R, Hk * 2, head_dim), jnp.float32)
+    kp = jax.random.normal(key_, (P, page_size, Hk, head_dim), jnp.float32)
+    bt = jnp.tile(jnp.arange(maxp, dtype=jnp.int32)[None], (S, 1))
+    seg = jnp.arange(R, dtype=jnp.int32) % S
+    pos = jnp.full((R,), maxp * page_size - 1, jnp.int32)
+    best, best_dt, skipped = None, None, []
+    for hb in candidates:
+        if math.gcd(hb, Hk) != hb:
+            continue
+        try:
+            fn = lambda: ragged_paged_attention(  # noqa: E731
+                q, kp, kp, bt, seg, pos, heads_per_block=hb,
+                interpret=False,
+            ).block_until_ready()
+            fn()  # compile
+            t0 = _time.perf_counter()
+            for _ in range(trials):
+                fn()
+            dt = _time.perf_counter() - t0
+        except Exception as e:
+            # An untunable candidate (VMEM overflow, lowering limit)
+            # is a skipped data point, not a fatal error — but it is
+            # recorded so the cached choice is explainable.
+            skipped.append((hb, f"{type(e).__name__}: {e}"))
+            continue
+        if best_dt is None or dt < best_dt:
+            best, best_dt = hb, dt
+    _RAGGED_GRID_CACHE[key] = {
+        "heads_per_block": best or _default_heads_per_block(
+            head_dim, page_size
+        ),
+        "autotuned": best is not None,
+        "skipped": skipped,
+    }
+    return ragged_grid_config(head_dim, page_size, num_kv_heads)
+
+
+def _ragged_kernel(
+    bt_ref,  # [S, maxp] SMEM (scalar prefetch)
+    seg_ref,  # [R] SMEM
+    pos_ref,  # [R] SMEM
+    q_ref,  # [1, HB, G, D]
+    k_ref,  # [1, ps, HB, D]
+    v_ref,
+    o_ref,  # [1, HB, G, D]
+    m_scr, l_scr, acc_scr,  # [HB*Gp, ...]
+    *,
+    scale: float,
+    page_size: int,
+    num_groups: int,
+    heads_per_block: int,
+):
+    r, ik = pl.program_id(0), pl.program_id(2)
+    nk = pl.num_programs(2)
+    G, HB = num_groups, heads_per_block
+    Gp = m_scr.shape[0] // HB
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = pos_ref[r] + 1  # visible kv count for this packed row
+    run = ik * page_size < length
+
+    @pl.when(run)
+    def _step():
+        slot = ik * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        for h in range(HB):  # static unroll over the kv-head tile
+            q = q_ref[0, h]  # [G, D]
+            k = k_ref[0, :, h, :]  # [ps, D]
+            v = v_ref[0, :, h, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, ps] fp32
+            # Causal == validity: slots past this row's own position
+            # are invisible, whether they belong to its future tokens
+            # (prefill-suffix packing) or to nobody yet (decode).
+            s = jnp.where(slot < length, s, NEG)
+            lo = h * Gp
+            m_prev = m_scr[lo:lo + G, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)  # [G, ps] fp32
+            l_new = l_scr[lo:lo + G, :1] * alpha + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            m_scr[lo:lo + G, :] = jnp.broadcast_to(
+                m_new, (G, m_scr.shape[1])
+            )
+            l_scr[lo:lo + G, :] = jnp.broadcast_to(
+                l_new, (G, l_scr.shape[1])
+            )
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[lo:lo + G, :] = acc_scr[lo:lo + G, :] * alpha + pv
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        for h in range(HB):
+            lo = h * Gp
+            l = l_scr[lo:lo + G, :1]
+            out = acc_scr[lo:lo + G, :] / jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, h] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "page_size", "heads_per_block", "interpret"),
+)
+def _ragged_paged(
+    q,  # [R, Hk, G, D]
+    k_pages,  # [P, ps, Hk, D]
+    v_pages,
+    block_tables,  # [S, maxp] int32
+    q_segments,  # [R] int32
+    q_positions,  # [R] int32
+    *,
+    scale: float,
+    page_size: int,
+    heads_per_block: int,
+    interpret: bool,
+):
+    R, Hk, G, D = q.shape
+    P = k_pages.shape[0]
+    S, maxp = block_tables.shape
+    HB = heads_per_block
+
+    def kv_map(r, hb, ik, bt_ref, seg_ref, pos_ref):
+        # Clamp dead tiles onto the row's last live page (DMA elision)
+        # and sentinel entries into the pool; the segment picks WHICH
+        # sequence's table this row walks.
+        s = jnp.clip(seg_ref[r], 0, S - 1)
+        last = jnp.maximum(pos_ref[r], 0) // page_size
+        page = bt_ref[s, jnp.minimum(ik, last)]
+        return (jnp.minimum(page, P - 1), 0, hb, 0)
+
+    grid = (R, Hk // HB, maxp)
+    Gp = max(G, 8)  # scratch sublane floor
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel, scale=scale, page_size=page_size,
+            num_groups=G, heads_per_block=HB,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, HB, G, D), lambda r, hb, ik, *_: (r, hb, 0, 0)
+                ),
+                pl.BlockSpec((1, page_size, HB, D), kv_map),
+                pl.BlockSpec((1, page_size, HB, D), kv_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, HB, G, D), lambda r, hb, ik, *_: (r, hb, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((HB * Gp, 128), jnp.float32),
+                pltpu.VMEM((HB * Gp, 128), jnp.float32),
+                pltpu.VMEM((HB * Gp, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((R, Hk, G, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), q_segments.astype(jnp.int32),
+      q_positions.astype(jnp.int32), q, k_pages, v_pages)
+    return out
+
+
+def ragged_paged_attention(
+    q,  # [R, Hq, D] packed query rows
+    k_pages,  # [P, page_size, Hk, D]
+    v_pages,
+    block_tables,  # [S, max_pages] int32 (sentinel >= P for unallocated)
+    q_segments,  # [R] owning slot per packed row
+    q_positions,  # [R] absolute position per packed row
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+    heads_per_block: int | None = None,
+):
+    """Drop-in for ops.paged_kv.ragged_paged_attention (same contract):
+    R packed query rows with mixed query lengths, each reading its own
+    sequence's pages in place through the block table. Tile parameters
+    come from the (head_dim, page_size) grid table unless pinned."""
+    R, Hq, D = q.shape
+    Hk = k_pages.shape[2]
+    assert Hq % Hk == 0, f"GQA requires Hq % Hk == 0, got {Hq=} {Hk=}"
+    G = Hq // Hk
+    if scale is None:
+        scale = D**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if heads_per_block is None:
+        heads_per_block = ragged_grid_config(
+            D, int(k_pages.shape[1]), Hk
+        )["heads_per_block"]
+    import math
+
+    heads_per_block = max(1, math.gcd(int(heads_per_block), Hk))
+    # h = hk * G + g (the repo's GQA head order: h // G == hk).
+    qg = q.reshape(R, Hk, G, D)
+    out = _ragged_paged(
+        qg, k_pages, v_pages, block_tables, q_segments, q_positions,
+        scale=float(scale), page_size=int(k_pages.shape[1]),
+        heads_per_block=int(heads_per_block), interpret=bool(interpret),
+    )
+    return out.reshape(R, Hq, D)

@@ -212,7 +212,12 @@ def paged_kv_spec(mesh) -> P | None:
     end to end (write, gather, attention), and the only cross-shard
     collective is o_proj's existing contraction over heads — so paged
     decode on a tp mesh stays bit-identical per head to the
-    single-device path. Pages/page_size must NOT shard: block tables
+    single-device path. The packed RAGGED path inherits this for free:
+    `write_pages_packed` scatters and `ragged_paged_attention` gathers
+    along the (unsharded) page axis with the head axis untouched, and
+    the reference pins its gathered per-row view to the same head
+    split (ops/paged_kv.py) so one fused mixed prefill+decode dispatch
+    partitions by heads exactly like the split dispatches did. Pages/page_size must NOT shard: block tables
     index pages globally and a page-axis split would turn every
     table-addressed write into a cross-device scatter. Returns None
     (replicate) when the mesh has no tp axis or tp == 1 — an fsdp-only

@@ -555,6 +555,7 @@ def build_server(
     max_ctx: int = 2048,
     prefill_chunk: int | None = None,
     prefix_cache: bool = True,
+    ragged: bool = False,
     stall_timeout: float | None = None,
     flight_recorder_size: int = 256,
     ttft_slo: float | None = None,
@@ -614,6 +615,11 @@ def build_server(
         raise ValueError(
             "--ttft-slo/--queue-depth-slo require a scheduler engine "
             "(the window batcher does not feed the SLO detectors)"
+        )
+    if engine == "window" and ragged:
+        raise ValueError(
+            "--ragged requires a scheduler engine (the window batcher "
+            "has no paged dispatch to fuse)"
         )
     if engine == "window" and request_timeout:
         # Same fail-fast contract for the containment knob: deadlines
@@ -684,6 +690,7 @@ def build_server(
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            ragged=ragged,
             max_queue=max_queue, request_timeout=request_timeout,
             degraded_cooldown=degraded_cooldown,
         )
@@ -1236,6 +1243,14 @@ def main(argv: list[str] | None = None) -> None:
         "admission; 0 = prefill each prompt in one dispatch)",
     )
     ap.add_argument(
+        "--ragged", action="store_true",
+        help="continuous engine: fuse chunked prefill and decode into "
+        "ONE ragged paged-attention dispatch per engine step (a packed "
+        "query buffer mixing every live slot's decode token with the "
+        "admitting prompt's suffix chunk; requires --prefill-chunk). "
+        "Greedy outputs are bit-identical to the split path.",
+    )
+    ap.add_argument(
         "--no-prefix-cache", action="store_true",
         help="continuous engine: disable the shared-prefix KV cache "
         "(copy-on-write paged pool reuse of repeated system/media "
@@ -1323,6 +1338,8 @@ def main(argv: list[str] | None = None) -> None:
         ap.error("--quantize is single-chip serving; drop --shard")
     if args.engine == "sharded" and not args.shard:
         ap.error("--engine sharded requires --shard tp=N")
+    if args.ragged and not args.prefill_chunk:
+        ap.error("--ragged requires a nonzero --prefill-chunk")
 
     from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
@@ -1345,6 +1362,7 @@ def main(argv: list[str] | None = None) -> None:
         max_ctx=args.max_ctx,
         prefill_chunk=args.prefill_chunk or None,
         prefix_cache=not args.no_prefix_cache,
+        ragged=args.ragged,
         stall_timeout=args.stall_timeout or None,
         flight_recorder_size=args.flight_recorder_size,
         ttft_slo=args.ttft_slo,

@@ -29,6 +29,18 @@ stop STRINGS and per-row max_tokens are enforced host-side at harvest,
 with the same trim/stable-prefix text rules as `chat_stream` — a
 request's reply through this engine is byte-identical to `pipe.chat`.
 
+Ragged fused path (`ragged=True`, docs/DESIGN.md "Ragged paged
+attention"): `_prefill_step` + `_step_chunk` fuse into `_ragged_step`
+— ONE device dispatch per engine step runs a packed query buffer
+mixing every live slot's decode token with up to `prefill_chunk`
+suffix tokens of the one admitting prompt
+(models/generate.paged_ragged_step; per-token (segment, position)
+routing through ops/paged_kv.write_pages_packed /
+ragged_paged_attention). The dispatch shape is STATIC (two compiled
+shape classes: prefill lanes present/absent, selected by host state);
+greedy and seeded outputs stay byte-identical to the split path, and
+oryx_serving_dispatches_total{kind=} is the observable proof.
+
 Prefix cache + chunked prefill (serve/prefix_cache.py): admission looks
 up the longest page-aligned cached prefix of the prompt's token ids and
 SPLICES those pages into the new slot's block table — full pages shared
@@ -113,6 +125,7 @@ from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyMonitor
 from oryx_tpu.utils.metrics import (
+    DISPATCH_ROWS_BUCKETS,
     PAGE_SECONDS_BUCKETS,
     PREFILL_CHUNK_BUCKETS,
     REQUEST_SECONDS_BUCKETS,
@@ -198,6 +211,14 @@ class _Request:
     embeds: Any = None
     length: int = 0
     key0: Any = None
+    # Ragged mode: host copy of `embeds` made once at first prefill, so
+    # each fused dispatch's fixed-shape prefill window is a free numpy
+    # slice (the dispatch operand shape never depends on prompt length).
+    embeds_np: Any = None  # thread-owned: engine
+    # Ragged mode: the admission-constant prefill operands (slot, len,
+    # active flag, key0, sampling scalars), built once per PLACEMENT at
+    # _place — only the window and its offset change per fused step.
+    pf_consts: Any = None  # thread-owned: engine
     # Prefix-cache key: the prompt's token ids for text-only requests
     # (token ids == logical KV stream). None = uncacheable (multimodal
     # prompts key visual slots positionally; they bypass the cache).
@@ -271,6 +292,7 @@ class ContinuousScheduler:
         request_timeout: float | None = None,
         degraded_cooldown: float = 30.0,
         degraded_clamp_tokens: int = 64,
+        ragged: bool = False,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -298,6 +320,12 @@ class ContinuousScheduler:
             )
         if max_ctx % page_size:
             raise ValueError(f"{max_ctx=} not a multiple of {page_size=}")
+        if ragged and prefill_chunk is None:
+            raise ValueError(
+                "ragged=True fuses chunked prefill into the decode "
+                "dispatch; set prefill_chunk (the per-step prompt "
+                "budget that sizes the packed buffer's prefill lanes)"
+            )
         # Optional SLO watcher (utils/anomaly.py): TTFT and queue-depth
         # breaches fire oryx_anomaly_total{kind=} + events.jsonl.
         self.anomaly = anomaly
@@ -319,6 +347,27 @@ class ContinuousScheduler:
                 max_ctx,
             )
         self.prefill_chunk = prefill_chunk
+        # Ragged mode (docs/DESIGN.md "Ragged paged attention"): one
+        # fused dispatch per engine step — `chunk` packed forwards,
+        # each carrying every decode slot (1 token) plus `pf_width`
+        # prefill-suffix tokens of the one admitting slot, so a
+        # dispatch advances the admission by ~prefill_chunk tokens
+        # while residents decode `chunk` tokens. Two compiled shape
+        # classes total (prefill lanes present / absent), both static.
+        self.ragged = bool(ragged)
+        self.pf_width = (
+            -(-prefill_chunk // chunk) if ragged else 0
+        )
+        if ragged and prefill_chunk % chunk:
+            # The prefill lanes advance chunk*pf_width tokens per fused
+            # step — ceil-rounding silently raises the configured
+            # per-step admission budget, so say so once.
+            _LOG.warning(
+                "ragged: prefill_chunk=%d is not a multiple of "
+                "chunk=%d; the fused step advances admission by %d "
+                "tokens per step (rounded up)",
+                prefill_chunk, chunk, self.pf_width * chunk,
+            )
         self.metrics = metrics or ServingMetrics()
         # Pre-register the prefix-cache + prefill families so the full
         # ladder renders (at zero) from the first scrape.
@@ -330,6 +379,12 @@ class ContinuousScheduler:
         reg.gauge("prefix_cache_pages")
         reg.counter("prefill_tokens_total")
         reg.histogram("prefill_chunk_tokens", PREFILL_CHUNK_BUCKETS)
+        # Dispatch accounting: how many device dispatches each engine
+        # step pays (the ragged path's whole claim is kind="ragged"
+        # only, one per step) and the packed-buffer occupancy each one
+        # carried (docs/OBSERVABILITY.md).
+        reg.counter("dispatches_total", ("kind",))
+        reg.histogram("dispatch_rows", DISPATCH_ROWS_BUCKETS)
         # Containment families, pre-registered so dashboards render
         # them at zero before the first incident.
         reg.counter("admission_rejected_total", ("reason",))
@@ -372,6 +427,25 @@ class ContinuousScheduler:
         )
         self.recent = np.full((S, stop_L), -2, np.int32)
         self.keys = jax.random.split(jax.random.key(seed), S)
+        self._ragged_blanks = None
+        if self.ragged:
+            # The pure-decode shape class's constant prefill operands,
+            # built ONCE: _ragged_step is hot-path and would otherwise
+            # pay ~8 fresh host->device constants per steady-state
+            # step. (The dummy key only feeds the discarded
+            # pf_key_next; any fixed key is correct.)
+            self._ragged_blanks = (
+                jnp.zeros((1, 0, self.cfg.llm.hidden_size),
+                          oryx.compute_dtype(self.cfg)),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(False),
+                jax.random.split(jax.random.key(0), 1),
+                jnp.zeros((1,), np.float32),
+                jnp.ones((1,), np.float32),
+                jnp.zeros((1,), np.int32),
+            )
         # `slots`/`bt`/`lengths`/... are engine-thread-only; the ONLY
         # state shared with the HTTP submit threads is the queue and
         # the shutdown flag, and oryxlint enforces that every touch of
@@ -943,18 +1017,25 @@ class ContinuousScheduler:
                 self._update_degraded()
                 self._enforce_deadlines()
                 self._admit()
-                # Chunked admission interleaves with decode: each engine
-                # step advances the in-flight admission by at most one
-                # prefill chunk, then runs one decode chunk for the
-                # resident streams — a long prompt never stalls decode
-                # for more than one prefill dispatch. (Unchunked
-                # prefills completed inside _admit; this is a no-op.)
-                self._prefill_step()
-                if any(
-                    r is not None and r.activated for r in self.slots
-                ):
-                    self._ensure_capacity()
-                    self._step_chunk()
+                if self.ragged:
+                    # Fused path: prefill lanes and decode lanes ride
+                    # ONE dispatch (docs/DESIGN.md "Ragged paged
+                    # attention").
+                    self._ragged_step()
+                else:
+                    # Chunked admission interleaves with decode: each
+                    # engine step advances the in-flight admission by at
+                    # most one prefill chunk, then runs one decode chunk
+                    # for the resident streams — a long prompt never
+                    # stalls decode for more than one prefill dispatch.
+                    # (Unchunked prefills completed inside _admit; this
+                    # is a no-op.)
+                    self._prefill_step()
+                    if any(
+                        r is not None and r.activated for r in self.slots
+                    ):
+                        self._ensure_capacity()
+                        self._step_chunk()
             except Exception as e:  # surface to every in-flight client
                 msg = f"{type(e).__name__}: {e}"
                 for s, req in enumerate(self.slots):
@@ -1333,6 +1414,26 @@ class ContinuousScheduler:
         self.finished[s] = True
         self.lengths[s] = 0
         self.tok[s] = 0
+        if self.ragged:
+            if req.embeds_np is None:
+                # One host copy per admission (NOT per step): every
+                # fused dispatch's prefill window is then a free numpy
+                # slice of it, and the dispatch operand keeps its fixed
+                # [1, chunk*pf_width, H] shape for any prompt length.
+                req.embeds_np = np.asarray(req.embeds)
+            # Admission-constant dispatch operands, built once per
+            # placement (the slot can change across evictions, so per
+            # PLACEMENT, not per request): the hot fused step then
+            # ships only the window and its offset.
+            req.pf_consts = (
+                jnp.asarray(s, jnp.int32),
+                jnp.asarray(req.length, jnp.int32),
+                jnp.asarray(True),
+                req.key0[np.newaxis],
+                jnp.asarray([req.temp], np.float32),
+                jnp.asarray([req.topp], np.float32),
+                jnp.asarray([req.topk], np.int32),
+            )
         # Eviction ordering needs an age the moment pages are held.
         req.admit_seq = self._admit_seq
         self._admit_seq += 1
@@ -1423,6 +1524,12 @@ class ContinuousScheduler:
         self.metrics.observe(
             "prefill_chunk_tokens", end - off,
             buckets=PREFILL_CHUNK_BUCKETS,
+        )
+        self.metrics.inc(
+            "dispatches_total", labels={"kind": "prefill"}
+        )
+        self.metrics.observe(
+            "dispatch_rows", end - off, buckets=DISPATCH_ROWS_BUCKETS
         )
         if self.watchdog is not None:
             # A completed prefill chunk is progress too — without this,
@@ -1585,32 +1692,46 @@ class ContinuousScheduler:
                 attn_impl=self.cfg.attn_impl,
                 compute_dtype=oryx.compute_dtype(self.cfg),
             )
-        # Host copies BLOCK on the device result — measure dt after
-        # them, or async dispatch makes the window (and the per-token
-        # histogram) cover only dispatch time, and the span<->xplane
-        # join would land the decode ops outside every window. This is
-        # the engine's ONE deliberate sync point per chunk (the harvest
-        # the chunk exists to amortize) — anything else host-syncing in
-        # this function is a regression the host-sync rule catches.
-        # oryxlint: off=host-sync
-        self.tok = np.asarray(tok).copy()
-        self.lengths = np.asarray(lengths).copy()
-        self.finished = np.asarray(finished).copy()
-        self.recent = np.asarray(recent).copy()
-        toks, fin = np.asarray(toks), np.asarray(fin)
-        # oryxlint: on=host-sync
+        toks, fin = self._harvest_chunk(
+            tok, lengths, finished, recent, toks, fin
+        )
         dt = time.monotonic() - t0
+        live = [
+            s for s, r in enumerate(self.slots)
+            if r is not None and r.activated
+        ]
+        self._finish_dispatch("decode", len(live), live, toks, t0_ns, dt)
+        self._occupancy_gauge()
+
+    def _finish_dispatch(
+        self, kind: str, rows: int, live: list[int], toks, t0_ns, dt,
+    ) -> None:
+        """Post-dispatch accounting shared by the split decode chunk
+        and the fused ragged step — ONE definition so the split-vs-
+        ragged metric A/B can never drift: beat bookkeeping, dispatch
+        metrics, the per-slot harvest/billing loop, and the decode-step
+        utilization counters. The decode-side numbers (TPOT, the
+        decode_steps family) are skipped when NO slot decoded during
+        the dispatch: a prefill-only fused step produces zero output
+        tokens, and billing its dead decode lanes would skew TPOT and
+        the wasted-step fraction against the ragged engine for a
+        structural reason the utilization metric doesn't track (the
+        split engine simply runs no decode dispatch in that state)."""
         self.chunks_run += 1
         self.metrics.inc("chunks")
+        self.metrics.inc("dispatches_total", labels={"kind": kind})
         self.metrics.observe(
-            "time_per_output_token_seconds", dt / max(1, self.chunk)
+            "dispatch_rows", rows, buckets=DISPATCH_ROWS_BUCKETS
         )
         if self.watchdog is not None:
             self.watchdog.beat()
         useful = 0
-        for s, req in enumerate(self.slots):
-            if req is None or not req.activated:
-                continue  # empty, or still prefilling (device-finished)
+        for s, tokens in generate_lib.unpack_ragged_rows(
+            toks, live
+        ).items():
+            req = self.slots[s]
+            if req is None:
+                continue
             # The same device window lands on every live request: decode
             # chunks are shared dispatches, and per-request attribution
             # is exactly what makes occupancy problems visible in a
@@ -1625,11 +1746,168 @@ class ContinuousScheduler:
             # fresh while neighbors splice and release shared pages.
             req.cost_decode_steps += self.chunk
             self._accrue_page_seconds(s)
-            useful += self._advance(s, [int(t) for t in toks[s]])
-        total = self.num_slots * self.chunk
-        self.metrics.inc("decode_steps_total", total)
-        self.metrics.inc("decode_steps_useful", useful)
-        self.metrics.inc("decode_steps_wasted", total - useful)
+            useful += self._advance(s, tokens)
+        if live:
+            self.metrics.observe(
+                "time_per_output_token_seconds", dt / max(1, self.chunk)
+            )
+            total = self.num_slots * self.chunk
+            self.metrics.inc("decode_steps_total", total)
+            self.metrics.inc("decode_steps_useful", useful)
+            self.metrics.inc("decode_steps_wasted", total - useful)
+
+    # hot-path
+    def _harvest_chunk(self, tok, lengths, finished, recent, toks, fin):
+        """Blocking host copies of a dispatch's outputs, shared by the
+        split and fused step paths. Host copies BLOCK on the device
+        result — callers measure dt AFTER this, or async dispatch makes
+        the window (and the per-token histogram) cover only dispatch
+        time, and the span<->xplane join would land the decode ops
+        outside every window. This is the engine's ONE deliberate sync
+        point per chunk (the harvest the chunk exists to amortize) —
+        anything else host-syncing on the step paths is a regression
+        the host-sync rule catches."""
+        # oryxlint: off=host-sync
+        self.tok = np.asarray(tok).copy()
+        self.lengths = np.asarray(lengths).copy()
+        self.finished = np.asarray(finished).copy()
+        self.recent = np.asarray(recent).copy()
+        out = np.asarray(toks), np.asarray(fin)
+        # oryxlint: on=host-sync
+        return out
+
+    # hot-path
+    def _ragged_step(self) -> None:
+        """The fused engine step (ragged mode): ONE device dispatch
+        (`generate.paged_ragged_step`) advances the in-flight admission
+        by up to chunk*pf_width prefill tokens AND decodes `chunk`
+        tokens for every resident stream — replacing the
+        `_prefill_step` + `_step_chunk` dispatch pair. Host state
+        machinery (admission, eviction, harvest, activation, the cost
+        ledger) is unchanged; only the device-call structure fuses.
+        A slot whose prefill completes activates AFTER the harvest and
+        joins the next dispatch (token streams are identical either
+        way — per-row math never depends on dispatch grouping)."""
+        # Mid-admission cancels first (same invariant as _prefill_step:
+        # a hung-up client's prefill must not ride the dispatch and its
+        # pages — including spliced shares — return now).
+        for s, req in enumerate(self.slots):
+            if req is None or req.activated:
+                continue
+            if req.handle.cancelled:
+                self.metrics.inc("cancelled")
+                cost = self._finalize_cost(s, req)
+                self._clear_slot(s)
+                req.trace.finish(cancelled=True, cost=cost)
+                _LOG.info(
+                    "request %s cancelled mid-prefill", req.trace.id
+                )
+        if any(r is not None and r.activated for r in self.slots):
+            self._ensure_capacity()  # may evict — recompute live below
+        live = [
+            s for s, r in enumerate(self.slots)
+            if r is not None and r.activated
+        ]
+        pf_s, pf_req = None, None
+        for s, req in enumerate(self.slots):
+            if req is not None and not req.activated:
+                # `_admit` holds further admission while one chunked
+                # prefill is in flight, so at most one slot admits.
+                pf_s, pf_req = s, req
+                break
+        if pf_req is None and not live:
+            return
+        # Chaos sites: the fused dispatch is both the admission's
+        # prefill work and the residents' decode beat, so both named
+        # fault sites keep their meaning in ragged mode.
+        if pf_req is not None:
+            faults.fault_point("prefill_dispatch")
+        faults.fault_point("decode_dispatch")
+        hot_dispatch("scheduler._ragged_step")
+        W = self.pf_width
+        dtype = oryx.compute_dtype(self.cfg)
+        pf_span = -1
+        pf_off = pf_len = 0
+        if pf_req is not None:
+            pf_off, pf_len = pf_req.prefill_pos, pf_req.length
+            window = generate_lib.pack_prefill_window(
+                pf_req.embeds_np, pf_off, self.chunk * W
+            )
+            pf_span = pf_req.trace.begin(
+                "prefill", slot=pf_s, start=pf_off,
+                tokens=min(self.chunk * W, pf_len - pf_off),
+                cached=pf_req.spliced > 0, replay=pf_req.replay > 0,
+                ragged=True,
+            )
+            pfw = W
+            slot_c, len_c, active_c, key_c, temp_c, topp_c, topk_c = (
+                pf_req.pf_consts
+            )
+            pf_args = (
+                jnp.asarray(window),
+                slot_c,
+                jnp.asarray(pf_off, jnp.int32),
+                len_c,
+                active_c,
+                key_c,
+                temp_c,
+                topp_c,
+                topk_c,
+            )
+        else:
+            # Pure-decode shape class: zero prefill lanes (pf_width=0
+            # is STATIC, so this is the second — and last — compiled
+            # program; host branching on engine state here is exactly
+            # what keeps traced state out of Python control flow). The
+            # constant blank operands were built once at construction.
+            pfw = 0
+            pf_args = self._ragged_blanks
+        t0 = time.monotonic()
+        t0_ns = trace_lib.now_ns()
+        with self.pipe._mesh_scope():
+            (self.kv_pages, tok, lengths, finished, recent, self.keys,
+             toks, fin, pf_tok0, pf_key) = generate_lib.paged_ragged_step(
+                self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
+                jnp.asarray(self.bt),
+                jnp.asarray(self.tok),
+                jnp.asarray(self.lengths),
+                jnp.asarray(self.finished),
+                jnp.asarray(self.recent),
+                self.keys,
+                jnp.asarray(self.temp),
+                jnp.asarray(self.top_p),
+                jnp.asarray(self.top_k),
+                self.stop_sequences,
+                *pf_args,
+                chunk=self.chunk, pf_width=pfw,
+                eos=self.cfg.generation.eos_token_id,
+                attn_impl=self.cfg.attn_impl,
+                compute_dtype=dtype,
+            )
+        toks, fin = self._harvest_chunk(
+            tok, lengths, finished, recent, toks, fin
+        )
+        dt = time.monotonic() - t0
+        # Decode billing covers only slots live DURING the dispatch —
+        # a slot activated below joins the next dispatch, and its toks
+        # row this time was frozen filler.
+        rows = len(live) + (
+            min(W, pf_len - pf_off) if pf_req is not None else 0
+        )
+        self._finish_dispatch("ragged", rows, live, toks, t0_ns, dt)
+        # Prefill bookkeeping + activation (after harvest by design).
+        if pf_req is not None:
+            pf_req.trace.end(pf_span)
+            advanced = min(self.chunk * W, pf_len - pf_off)
+            pf_req.prefill_pos = pf_off + advanced
+            pf_req.cost_prefill_tokens += advanced
+            self.metrics.inc("prefill_tokens_total", advanced)
+            self.metrics.observe(
+                "prefill_chunk_tokens", advanced,
+                buckets=PREFILL_CHUNK_BUCKETS,
+            )
+            if pf_req.prefill_pos >= pf_len:
+                self._activate(pf_s, pf_req, pf_tok0[np.newaxis], pf_key)
         self._occupancy_gauge()
 
     def _occupancy_gauge(self) -> None:
@@ -1695,6 +1973,21 @@ class ContinuousScheduler:
             )
             if finish is None or n <= finish[1]:
                 finish = ("stop", n)
+        if finish is not None:
+            # Wasted-step honesty: a stop STRING is detected host-side,
+            # so the token loop above consumed (and billed as useful)
+            # every token up to the chunk end or an EOS — but tokens
+            # past the one that completed the finish did nothing for
+            # the client. Clamp useful to the finish point in
+            # CONSUMED-token space (finish[1] counts completion tokens;
+            # chunk_start is where this chunk's consumption began —
+            # this also covers an EOS consumed after a stop completed,
+            # which was billed but never appended to `emitted`).
+            # Without this the wasted-step fraction under-counts
+            # whenever a slot finishes mid-chunk on a stop string
+            # (scripts/bench_serving_sched.py's A/B depends on this
+            # number being honest).
+            useful = min(useful, finish[1] - chunk_start)
         if finish is not None:
             # Flush the held-back tail (stable_text_prefix may have
             # withheld whitespace / a stop-string prefix) exactly as

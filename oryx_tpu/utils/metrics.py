@@ -427,6 +427,14 @@ PER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # shows whether chunked prefill is actually bounding admission work.
 PREFILL_CHUNK_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                          256.0, 512.0, 1024.0, 2048.0, 4096.0)
+# Valid query rows per device dispatch (the occupancy of the packed
+# ragged buffer, or the live-row count of a split prefill/decode
+# dispatch): powers of two up to the largest plausible packed buffer
+# (num_slots + prefill lanes). A fused path that is working shows this
+# distribution shifted right vs the split path at equal load —
+# prefill and decode rows ride the SAME dispatch.
+DISPATCH_ROWS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                         256.0, 512.0, 1024.0, 2048.0, 4096.0)
 # Lock wait/hold times for the LockOrderSanitizer's
 # oryx_lock_{wait,hold}_seconds{lock=} histograms: microseconds (the
 # healthy regime for every lock in the declared order) up to the one

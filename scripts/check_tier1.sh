@@ -10,14 +10,14 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 520 = the 500 recorded at PR 8 plus the multi-replica serving-tier
-# suites added in PR 9 (prefix-affinity router: affinity/ejection/
-# drain/retry/merged-surfaces in tests/test_router.py;
-# tensor-parallel paged decode parity incl. prefix-cache splices and
-# eviction replay on a tp=2 CPU mesh in tests/test_tp_decode.py; 553
-# observed), with headroom for load-dependent flakes
+# 540 = the 520 recorded at PR 9 plus the ragged paged-attention
+# suites added in PR 10 (packed-reference/Pallas/driver/engine
+# bit-parity, zero-recompile-across-mixes, dispatch metrics in
+# tests/test_ragged_attention.py; the wasted-step stop-string billing
+# pin in test_scheduler.py; taint-propagation recompile-hazard units;
+# 574 observed), with headroom for load-dependent flakes
 # (bench-supervisor probes on one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-520}
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-540}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -68,7 +68,7 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     tests/test_scheduler.py tests/test_containment.py \
     tests/test_trace.py tests/test_metrics_registry.py \
     tests/test_prefix_cache.py tests/test_lock_sanitizer.py \
-    tests/test_router.py \
+    tests/test_router.py tests/test_ragged_attention.py \
     -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "LOCK SANITIZER SUITE FAILED (a concurrency violation above)" >&2
@@ -110,6 +110,20 @@ echo "checking prefix-cache perf (bench_prefix_cache.py --smoke)"
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/bench_prefix_cache.py --smoke > /dev/null; then
     echo "PREFIX CACHE PERF CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- ragged paged-attention gate ---------------------------------------------
+# The fused one-dispatch engine path (--ragged) against the split
+# path: dispatches/step must be EXACTLY 1 on the ragged engine (the
+# oryx_serving_dispatches_total{kind=} counters are the proof), zero
+# recompiles after warmup under recompile_watchdog (static dispatch
+# shape across live-slot mixes), and replies byte-identical split vs
+# ragged.
+echo "checking ragged paged attention (bench_paged_attention.py --smoke)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/bench_paged_attention.py --smoke > /dev/null; then
+    echo "RAGGED PAGED ATTENTION CHECK FAILED" >&2
     exit 1
 fi
 

@@ -320,6 +320,7 @@ _LOCK_SAN_FILES = (
     "test_trace.py",
     "test_metrics_registry.py",
     "test_prefix_cache.py",
+    "test_ragged_attention.py",
 )
 
 

@@ -19,3 +19,15 @@ def caller(x, bucketed_mode):
     a = step(x, True, mode="fast")
     b = step(x, False, mode=bucketed_mode)
     return a, b
+
+
+@partial(jax.jit, static_argnames=("pf_width",))
+def ragged_step(tok, finished, *, pf_width):
+    # Shape-derived locals and static-arg branches stay legal; traced
+    # state is consumed with jnp.where, never Python control flow.
+    rows = tok.shape[0]
+    width = len(finished)
+    if pf_width and rows > width:
+        tok = tok[:width]
+    live = jnp.where(finished, 0, 1)
+    return tok + live.sum()

@@ -27,6 +27,25 @@ def bare(x, flag):
     return x if flag else -x  # expect: recompile-hazard
 
 
+@partial(jax.jit, static_argnames=("pf_width",))
+def ragged_step(tok, finished, *, pf_width):
+    # The packed-buffer idiom hazard: locals DERIVED from traced
+    # params are tracers too — branching Python on them recompiles (or
+    # traces-errors) exactly like branching on the param itself.
+    num_live = (~finished).sum()
+    num_prefill = num_live + 1
+    if num_live:  # expect: recompile-hazard
+        tok = tok + 1
+    while num_prefill > 0:  # expect: recompile-hazard
+        tok = tok - 1
+    if pf_width:  # static shape-class selector: fine
+        tok = tok * 2
+    rows = tok.shape[0]
+    if rows > 4:  # derived from .shape only: static, fine
+        tok = tok[:4]
+    return tok
+
+
 def caller(x):
     a = step(x, False, mode={"lr": 0.1})  # expect: recompile-hazard
     b = step(x, False, mode=f"bucket_{x.shape[0]}")  # expect: recompile-hazard

@@ -549,3 +549,61 @@ def test_page_seconds_accrual_is_refcount_weighted(pipe):
     sched.bt[:] = sched.allocator.sentinel
     sched.slots = [None, None]
     sched.close()
+
+
+def test_stop_string_mid_chunk_not_billed_useful(pipe):
+    """Bugfix pin: a slot that finishes mid-chunk on a stop STRING
+    (detected host-side, so the token loop consumed the whole chunk)
+    must re-bill the steps past the stop completion as wasted —
+    without this, bench_serving_sched.py's wasted-step fraction
+    under-counts exactly when stop strings end rows early, flattering
+    whichever engine wastes more."""
+    import time as time_lib
+
+    from oryx_tpu.serve.scheduler import RequestHandle, _Request
+
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=1, page_size=16, chunk=8, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    h = RequestHandle()
+    tr = sched.tracer.start_trace("request")
+    h.trace = tr
+    h.request_id = tr.id
+    req = _Request(
+        request={}, max_new=100, sampling={}, handle=h,
+        submit_time=time_lib.monotonic(), stops=["c"], trace=tr,
+    )
+    req.length = 4
+    req.activated = True
+    sched.slots[0] = req
+    sched.lengths[0] = req.length
+    # Device chunk decodes "abcde": the stop "c" completes at token 3;
+    # tokens 4-5 did nothing for the client.
+    useful = sched._advance(0, [ord(ch) for ch in "abcde"])
+    assert h.done.is_set() and h.finish_reason == "stop"
+    assert h.usage == (4, 3)
+    assert useful == 3, f"steps past the stop billed useful: {useful}"
+
+    # EOS consumed AFTER the stop completed: it is billed by the token
+    # loop but never appended to `emitted` — the clamp must count it
+    # wasted too (consumed-token space, not emitted-token space).
+    h2 = RequestHandle()
+    tr2 = sched.tracer.start_trace("request")
+    h2.trace = tr2
+    h2.request_id = tr2.id
+    req2 = _Request(
+        request={}, max_new=100, sampling={}, handle=h2,
+        submit_time=time_lib.monotonic(), stops=["a"], trace=tr2,
+    )
+    req2.length = 4
+    req2.activated = True
+    sched.slots[0] = req2
+    sched.lengths[0] = req2.length
+    eos = sched.cfg.generation.eos_token_id
+    useful = sched._advance(0, [ord("a"), ord("b"), eos, ord("d")])
+    assert h2.done.is_set() and h2.finish_reason == "stop"
+    assert h2.usage == (4, 1)
+    assert useful == 1, f"EOS after the stop billed useful: {useful}"
+    sched.close()
