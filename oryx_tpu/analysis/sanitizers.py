@@ -639,7 +639,14 @@ class _RaceField:
 
     def _check(self, obj: Any, write: bool) -> None:
         det = self.det
-        if getattr(det._exempt, "depth", 0):
+        # Exemption is MODULE-global (thread-local), not per-detector:
+        # descriptors can outlive the detector epoch that installed
+        # them (build_server's maybe_arm_from_env arms process-wide
+        # and a later re-arming skips already-instrumented fields), so
+        # a per-detector flag would ignore race_exempt() taken under
+        # the CURRENT detector — the pool-invariant check then raises
+        # from a stale descriptor despite being declared exempt.
+        if getattr(_EXEMPT, "depth", 0):
             return
         t = threading.current_thread()
         with det._mu:
@@ -758,7 +765,6 @@ class RaceDetector:
         # one `lock_stats().violations` assertion covers both halves.
         self._sink = stats_sink
         self._mu = threading.Lock()
-        self._exempt = threading.local()
         self._installed: list[tuple[type, str, Any]] = []
 
     def _violation(self, msg: str) -> None:
@@ -870,20 +876,25 @@ def hot_dispatch(name: str) -> None:
         )
 
 
+# Thread-local race-exemption depth, shared by EVERY detector epoch's
+# descriptors (see _RaceField._check: descriptors can outlive the
+# detector that installed them, so the flag cannot live on a detector).
+_EXEMPT = threading.local()
+
+
 @contextlib.contextmanager
 def race_exempt(reason: str = "") -> Iterator[None]:
     """Mark the current thread's annotated-field accesses as
     externally synchronized for the duration (e.g. the pool-invariant
-    check, which callers only run quiesced). No-op disarmed."""
-    det = _RACE
-    if det is None:
-        yield
-        return
-    det._exempt.depth = getattr(det._exempt, "depth", 0) + 1
+    check, which callers only run quiesced). The mark applies to ANY
+    installed race descriptor — including one from an earlier arming
+    epoch still instrumenting a class (process-wide arming via
+    $ORYX_LOCK_SANITIZER has no disarm point). No-op disarmed."""
+    _EXEMPT.depth = getattr(_EXEMPT, "depth", 0) + 1
     try:
         yield
     finally:
-        det._exempt.depth -= 1
+        _EXEMPT.depth -= 1
 
 
 def arm_lock_sanitizer(

@@ -56,6 +56,40 @@ def sample_token(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def truncate_logits_rows(
+    logits: jnp.ndarray,  # [S, V]
+    *,
+    temperature: jnp.ndarray,  # [S] float (0 => greedy for that row)
+    top_p: jnp.ndarray,  # [S] float
+    top_k: jnp.ndarray,  # [S] int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row temperature scale + top-k + top-p truncation — the
+    distribution-shaping half of `sample_token_rows`, factored out so
+    speculative verification (`spec_verify_rows`) accepts and resamples
+    against EXACTLY the distribution the non-speculative sampler draws
+    from. Returns (truncated logits [S, V] with -inf outside the
+    nucleus, is_greedy [S] bool). Greedy rows pass through at t=1 (the
+    caller overrides them with argmax, as `sample_token_rows` does)."""
+    V = logits.shape[-1]
+    is_greedy = temperature <= 0.0
+    t = jnp.where(is_greedy, 1.0, temperature)[:, None]
+    l = logits / t
+    tk = jnp.clip(top_k.astype(jnp.int32), 0, V)
+    srt = jnp.sort(l, axis=-1)  # ascending
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(V - tk, 0, V - 1)[:, None], axis=-1
+    )
+    l = jnp.where((tk > 0)[:, None] & (l < kth), -jnp.inf, l)
+    srt_d = jnp.sort(l, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt_d, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Smallest prefix with cumulative prob >= top_p (keeps the top token).
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(srt_d, cutoff_idx[:, None], axis=-1)
+    l = jnp.where((top_p < 1.0)[:, None] & (l < cutoff), -jnp.inf, l)
+    return l, is_greedy
+
+
 def sample_token_rows(
     logits: jnp.ndarray,  # [S, V]
     keys: jax.Array,  # [S] per-row PRNG keys
@@ -73,22 +107,9 @@ def sample_token_rows(
     ONE compiled decode."""
     V = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    is_greedy = temperature <= 0.0
-    t = jnp.where(is_greedy, 1.0, temperature)[:, None]
-    l = logits / t
-    tk = jnp.clip(top_k.astype(jnp.int32), 0, V)
-    srt = jnp.sort(l, axis=-1)  # ascending
-    kth = jnp.take_along_axis(
-        srt, jnp.clip(V - tk, 0, V - 1)[:, None], axis=-1
+    l, is_greedy = truncate_logits_rows(
+        logits, temperature=temperature, top_p=top_p, top_k=top_k
     )
-    l = jnp.where((tk > 0)[:, None] & (l < kth), -jnp.inf, l)
-    srt_d = jnp.sort(l, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(srt_d, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # Smallest prefix with cumulative prob >= top_p (keeps the top token).
-    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)
-    cutoff = jnp.take_along_axis(srt_d, cutoff_idx[:, None], axis=-1)
-    l = jnp.where((top_p < 1.0)[:, None] & (l < cutoff), -jnp.inf, l)
     # Per-row Gumbel-max with per-row keys (categorical over one shared
     # key would couple a row's draw to its batch position).
     u = jax.vmap(lambda k: jax.random.uniform(k, (V,)))(keys)
@@ -844,6 +865,363 @@ def paged_ragged_step(
         kv_pages, tok, lengths, finished, recent, keys,
         jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1),
         pf_tok0, pf_key_next,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding: self-drafted multi-token steps, verified in one
+# packed dispatch (docs/DESIGN.md "Speculative decoding")
+# ---------------------------------------------------------------------------
+
+
+class Drafter:
+    """Pluggable draft-token proposer for speculative decoding.
+
+    `propose(context, k)` returns UP TO `k` token ids predicted to
+    continue `context` (the request's own confirmed stream: prompt ids
+    + device-confirmed reply tokens + the pending fed token). Fewer —
+    or zero — proposals are always legal: unproposed lanes of the
+    verify dispatch ride masked, and a zero-draft step degenerates to
+    the plain one-token decode. Implementations MUST be deterministic
+    functions of `context` (eviction replay re-proposes from the same
+    context and must re-derive the same accept pattern, or the replayed
+    sample stream diverges from what the client already saw).
+
+    The reference implementation is `NgramDrafter` (self-drafting — no
+    second model); a small draft MODEL slots in by implementing this
+    same method (propose = draft-model decode of k tokens).
+
+    `window` (None = unbounded) declares how much context TAIL the
+    drafter actually reads: the scheduler then materializes only that
+    suffix per step instead of concatenating the full prompt + reply
+    history — without a bound, proposal cost grows O(context) per slot
+    per engine step, eroding the sequential-latency win speculation
+    exists to buy. A fixed tail is still a deterministic function of
+    the context, so replay stability is unaffected."""
+
+    window: int | None = None
+
+    def propose(self, context, k: int) -> list[int]:
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup / n-gram self-drafting (arXiv 2605.25645's cheap
+    lever for repetitive serving workloads — code, RAG, chat with
+    quoting): find the MOST RECENT earlier occurrence of the longest
+    suffix n-gram of the context and propose the tokens that followed
+    it. No second model, no extra device work — the proposal is a pure
+    host-side lookup against the request's own tokens, and the packed
+    verify dispatch prices every proposal at one extra lane."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 window: int | None = 2048):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram=} {max_ngram=}"
+            )
+        if window is not None and window < max_ngram + 1:
+            raise ValueError(
+                f"window must cover at least one n-gram + continuation "
+                f"(>= max_ngram + 1), got {window=} {max_ngram=}"
+            )
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        # Lookup window (tokens of context tail searched): bounds the
+        # per-step host cost at O(window) regardless of prompt/reply
+        # length. Deterministic — replay sees the same tail at the
+        # same confirmed position.
+        self.window = window
+
+    def propose(self, context, k: int) -> list[int]:
+        a = np.asarray(context, np.int64).reshape(-1)
+        if self.window is not None and a.shape[0] > self.window:
+            a = a[-self.window:]
+        n_ctx = int(a.shape[0])
+        if k <= 0 or n_ctx < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_ctx - 1), self.min_ngram - 1,
+                       -1):
+            suf = a[-n:]
+            w = n_ctx - n  # candidate starts 0..w-1 (w == the suffix itself)
+            m = np.ones(w, bool)
+            for j in range(n):
+                m &= a[j: j + w] == suf[j]
+            idx = np.nonzero(m)[0]
+            if idx.size:
+                i = int(idx[-1])  # most recent earlier occurrence
+                cont = a[i + n: i + n + k]
+                if cont.size:
+                    return [int(x) for x in cont]
+        return []
+
+
+def spec_verify_rows(
+    lg: jnp.ndarray,  # [S, k+1, V] verify-lane logits
+    tok: jnp.ndarray,  # [S] fed token per slot (lane 0's input)
+    drafts: jnp.ndarray,  # [S, k] proposed tokens (garbage past draft_len)
+    draft_len: jnp.ndarray,  # [S] real proposals per slot (0..k)
+    keys: jax.Array,  # [S] per-slot PRNG keys
+    *,
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    eos: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jax.Array]:
+    """Accept/resample core of speculative decoding over verify-lane
+    logits — pure math, shared by `paged_spec_step` and the
+    distribution tests. Returns (acc [S], cand [S], keys_next [S]).
+
+    Lane j's logits lg[s, j] are the model's distribution for the token
+    at position len_s+j+1 (after feeding [tok, d_0..d_{k-1}]); drafts
+    [s, j] is the proposal for that same position. Acceptance is the
+    longest matching prefix:
+
+      * greedy rows (temperature <= 0): d_j accepted iff it EQUALS the
+        raw argmax target — accepted tokens are bit-identical to what
+        sequential decode would have produced, which is the whole
+        byte-parity claim.
+      * sampled rows: point-mass rejection sampling. The drafter is
+        deterministic, so the proposal distribution is q = delta(d_j);
+        accept d_j with probability p'(d_j) where p' is the TRUNCATED
+        target (same temperature/top-k/top-p shaping as
+        `sample_token_rows`, via `truncate_logits_rows`); on rejection
+        the bonus token is drawn from the residual max(p' - q, 0)/Z —
+        for a point mass that is p' with d_j masked out, renormalized —
+        so the marginal of the emitted token at every position is
+        EXACTLY p' (the spec-vs-plain distribution test pins this).
+
+    `acc` counts accepted drafts, truncated at the first accepted EOS
+    (tokens "accepted" past an EOS never existed — the sequential path
+    would have frozen the row) and forced to 0 when the fed token is
+    itself EOS. `cand` is the bonus token at lane `acc` — the model's
+    own next token at the first mismatch (or after all accepts), which
+    becomes the next step's fed token. Key consumption is a FIXED
+    2k+3 split per slot per step regardless of the accept pattern, so
+    a row's RNG stream depends only on its own step count — the same
+    per-row independence contract as `sample_token_rows`."""
+    S, k = drafts.shape
+    lanes = k + 1
+    V = lg.shape[-1]
+    tgt = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [S, lanes] raw greedy
+    rep = lambda x: jnp.repeat(x, lanes)  # noqa: E731 — slot-major repeat
+    l_t, _ = truncate_logits_rows(
+        lg.reshape(S * lanes, V),
+        temperature=rep(temperature), top_p=rep(top_p), top_k=rep(top_k),
+    )
+    l_t = l_t.reshape(S, lanes, V)
+    is_greedy = temperature <= 0.0
+    ks = jax.vmap(lambda key: jax.random.split(key, 2 * k + 3))(keys)
+    if k:
+        # Accept draws: one uniform per draft lane (ks[:, 2j]).
+        u = jax.vmap(
+            jax.vmap(lambda key: jax.random.uniform(key, ()))
+        )(ks[:, 0:2 * k:2])  # [S, k]
+        p = jax.nn.softmax(l_t[:, :k], axis=-1)
+        p_d = jnp.take_along_axis(
+            p, drafts[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]  # [S, k]
+        ok = jnp.where(
+            is_greedy[:, None], drafts == tgt[:, :k], u < p_d
+        )
+        jr = jnp.arange(k, dtype=jnp.int32)[None, :]
+        ok = ok & (jr < draft_len[:, None])
+        cum = jnp.cumprod(ok.astype(jnp.int32), axis=1)  # leading accepts
+        # Truncate at the first ACCEPTED eos (inclusive): lanes after it
+        # would extend a row the sequential path already froze.
+        hit_eos = cum * (drafts == eos).astype(jnp.int32)
+        eos_before = jnp.cumsum(hit_eos, axis=1) - hit_eos
+        acc = jnp.sum(cum * (eos_before == 0), axis=1).astype(jnp.int32)
+    else:
+        acc = jnp.zeros_like(tok)
+    acc = jnp.where(tok == eos, 0, acc)
+    # Bonus lane b = acc: the model's own token at the first mismatch
+    # (or the free extra token after a full accept).
+    b = acc
+    l_sel = jnp.take_along_axis(l_t, b[:, None, None], axis=1)[:, 0]
+    tgt_sel = jnp.take_along_axis(tgt, b[:, None], axis=1)[:, 0]
+    # Residual for a point-mass rejection: mask the rejected draft out
+    # of the bonus draw (only when lane b actually carried a proposal).
+    d_pad = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.full((S, 1), -1, jnp.int32)], axis=1
+    )
+    d_b = jnp.take_along_axis(d_pad, b[:, None], axis=1)[:, 0]
+    rejected = b < draft_len
+    l_res = jnp.where(
+        rejected[:, None]
+        & (jnp.arange(V, dtype=jnp.int32)[None] == d_b[:, None]),
+        -jnp.inf, l_sel,
+    )
+    key_sel = jax.vmap(lambda row, i: row[i])(ks, 2 * b + 1)
+    u2 = jax.vmap(lambda key: jax.random.uniform(key, (V,)))(key_sel)
+    g = -jnp.log(-jnp.log(jnp.maximum(u2, jnp.finfo(jnp.float32).tiny)))
+    cand_sample = jnp.argmax(l_res + g, axis=-1).astype(jnp.int32)
+    cand = jnp.where(is_greedy, tgt_sel, cand_sample)
+    return acc, cand, ks[:, -1]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "k", "pf_width", "eos", "attn_impl", "compute_dtype",
+    ),
+    donate_argnames=("kv_pages",),
+)
+def paged_spec_step(
+    params,
+    cfg: LLMConfig,
+    kv_pages: dict,  # donated
+    block_tables: jnp.ndarray,  # [S, max_pages] int32
+    tok: jnp.ndarray,  # [S] next token to feed per slot
+    lengths: jnp.ndarray,  # [S] kv tokens held per slot (frozen on finish)
+    finished: jnp.ndarray,  # [S] bool (True for finished AND empty slots)
+    keys: jax.Array,  # [S] per-slot PRNG keys
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    drafts: jnp.ndarray,  # [S, k] proposed draft tokens
+    draft_len: jnp.ndarray,  # [S] real proposals per slot
+    pf_embeds: jnp.ndarray,  # [1, pf_width, H] prefill window
+    pf_slot: jnp.ndarray,  # [] int32 slot the prefill belongs to
+    pf_off: jnp.ndarray,  # [] int32 logical offset of the window start
+    pf_len: jnp.ndarray,  # [] int32 total prompt length (incl. prefix)
+    pf_active: jnp.ndarray,  # [] bool — a prefill rides this dispatch
+    pf_key: jax.Array,  # [1] the admitting request's key0
+    pf_temp: jnp.ndarray,  # [1]
+    pf_top_p: jnp.ndarray,  # [1]
+    pf_top_k: jnp.ndarray,  # [1]
+    *,
+    k: int,
+    pf_width: int,
+    eos: int,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+):
+    """ONE device dispatch for a SPECULATIVE mixed prefill+decode
+    engine step: every live slot contributes 1+k packed verify lanes
+    (its fed token plus k self-drafted continuations at consecutive
+    positions) and the one admitting slot contributes `pf_width`
+    prefill-suffix lanes — the whole fleet's drafts verified in a
+    single packed forward through the SAME (segment, position) ragged
+    kernel as `paged_ragged_step` (drafts are just extra packed rows;
+    ops/paged_kv.spec_lane_metadata builds the routing).
+
+    Unlike `paged_ragged_step`'s chunk-iteration scan, this is a single
+    forward: the drafter is HOST-side (it needs the token history the
+    device never holds), so each engine step proposes, verifies in one
+    dispatch, and harvests — a slot advances 1..k+1 tokens per
+    sequential step instead of 1, which is the whole latency lever
+    (arXiv 2605.25645: interactive SLOs are bound by sequential steps,
+    not per-step cost).
+
+    KV discipline: all 1+k lanes write KV at positions len..len+k —
+    always into the slot's EXCLUSIVELY-OWNED pages (the COW-at-splice
+    invariant: shared prefix pages end strictly below the prompt, the
+    partial boundary page is copy-on-written at admission, and finish-
+    time donation is capped at the device-confirmed length — so a
+    "scratch" region past cur_len needs no extra pages). Accepted
+    drafts splice by advancing cur_len over KV already written;
+    rejected drafts leave dead bytes past cur_len that causal masking
+    never reads and the next real token overwrites before its first
+    read. Rollback therefore frees nothing and copies nothing.
+
+    The dispatch shape is STATIC per (S, k, pf_width) class — two
+    compiled programs total (prefill lanes present/absent), exactly the
+    ragged engine's contract; drafts/draft_len are traced operands.
+
+    Returns (kv_pages, nxt, lengths, finished, keys, toks [S, k+1],
+    n_new [S], acc [S], pf_tok0, pf_key_next): toks[s, :n_new[s]] are
+    the tokens slot s emitted this step (fed token + accepted drafts,
+    EOS-fill past n_new); nxt is the bonus token each slot feeds next
+    step. Greedy rows are bit-identical to running `paged_ragged_step`
+    n_new times (accept == argmax match, bonus == the argmax the
+    sequential path would sample); see `spec_verify_rows` for the
+    temperature>0 rejection-sampling contract."""
+    from oryx_tpu.parallel.sharding import constrain
+
+    S = tok.shape[0]
+    lanes = k + 1
+    W = pf_width
+
+    def embed(ids):
+        e = constrain(params["embed"]["weight"], None, None)[ids]
+        return e.astype(compute_dtype) if compute_dtype is not None else e
+
+    ids = jnp.concatenate(
+        [tok[:, None], drafts.astype(jnp.int32)], axis=1
+    )  # [S, lanes]
+    dec_emb = embed(ids.reshape(S * lanes))
+    seg, pos = paged_kv_lib.spec_lane_metadata(lengths, k)
+    lane_j = jnp.tile(jnp.arange(lanes, dtype=jnp.int32), (S,))
+    wm = (
+        jnp.repeat(~finished, lanes)
+        & (lane_j <= jnp.repeat(draft_len.astype(jnp.int32), lanes))
+    )
+    if W:
+        pf_pos = pf_off + jnp.arange(W, dtype=jnp.int32)
+        emb = jnp.concatenate(
+            [dec_emb, pf_embeds[0].astype(dec_emb.dtype)], axis=0
+        )
+        pos = jnp.concatenate([pos, pf_pos])
+        seg = jnp.concatenate(
+            [seg, jnp.full((W,), 1, jnp.int32) * pf_slot]
+        )
+        wm = jnp.concatenate([wm, jnp.broadcast_to(pf_active, (W,))])
+    else:
+        emb = dec_emb
+    logits, kv_pages = qwen2.forward(
+        params, cfg,
+        inputs_embeds=emb[None], positions=pos[None],
+        kv_cache=kv_pages, block_tables=block_tables,
+        q_segments=seg[None], write_mask=wm[None],
+        attn_impl=attn_impl, compute_dtype=compute_dtype,
+    )
+    lg_all = logits[0]
+    lg = lg_all[: S * lanes].reshape(S, lanes, -1)
+    acc, cand, keys_next = spec_verify_rows(
+        lg, tok, drafts, draft_len, keys,
+        temperature=temperature, top_p=top_p, top_k=top_k, eos=eos,
+    )
+    jr = jnp.arange(k, dtype=jnp.int32)[None, :]
+    accepted = jr < acc[:, None]
+    out_toks = jnp.concatenate(
+        [tok[:, None], jnp.where(accepted, drafts, eos)], axis=1
+    )
+    acc_eos = jnp.any(accepted & (drafts == eos), axis=1)
+    fed_eos = tok == eos
+    new_finished = finished | fed_eos | acc_eos
+    n_new = jnp.where(finished, 0, 1 + acc)
+    # cur_len counts confirmed non-EOS KV tokens, mirroring the
+    # sequential step's `cur_len + ~finished` (EOS never increments).
+    inc = jnp.where(
+        finished | fed_eos, 0, 1 + acc - acc_eos.astype(jnp.int32)
+    )
+    nxt = jnp.where(new_finished, eos, cand)
+    if W:
+        # Prefill-lane sampling: the exact `paged_ragged_step` contract
+        # (window seeded with the request's key0; only the window
+        # containing the prompt's final token samples tok0).
+        pf_pair = jax.vmap(lambda key: jax.random.split(key, 2))(pf_key)
+        j = pf_len - 1 - pf_off
+        present = pf_active & (j >= 0) & (j < W)
+        row = jax.lax.dynamic_index_in_dim(
+            lg_all, S * lanes + jnp.clip(j, 0, W - 1), axis=0,
+            keepdims=True,
+        )
+        pf_cand = sample_token_rows(
+            row, pf_pair[:, 1],
+            temperature=pf_temp, top_p=pf_top_p, top_k=pf_top_k,
+        )[0]
+        pf_tok0 = jnp.where(present, pf_cand, jnp.zeros((), jnp.int32))
+    else:
+        pf_tok0 = jnp.zeros((), jnp.int32)
+    pf_key_next = jax.vmap(lambda key: jax.random.split(key, 2))(
+        pf_key
+    )[:, 0]
+    return (
+        kv_pages, nxt, lengths + inc, new_finished, keys_next,
+        out_toks, n_new, acc, pf_tok0, pf_key_next,
     )
 
 

@@ -40,6 +40,14 @@ Three pieces live here:
     (models/generate.paged_ragged_step; arXiv 2604.15464). The
     reference here is the CPU bit-parity anchor; the Pallas twin walks
     the block tables in place.
+  * `spec_lane_metadata` — the SPECULATIVE extension of the same
+    packing: each live slot contributes 1+k verify lanes (its fed
+    token plus k drafted continuations at consecutive positions).
+    Draft lanes need NO new kernel — a draft at position len+j is just
+    one more (segment, position) row, causally masked at its own
+    position, attending to the earlier lanes' K/V written in the same
+    forward exactly as a chunked-prefill suffix already does
+    (models/generate.paged_spec_step).
 """
 
 from __future__ import annotations
@@ -344,6 +352,29 @@ def write_pages_packed(
     pool = cache_layer.reshape(P * ps, Hk, D)
     pool = pool.at[flat].set(new.astype(pool.dtype), mode="drop")
     return pool.reshape(P, ps, Hk, D)
+
+
+def spec_lane_metadata(
+    lengths: jnp.ndarray,  # [S] int32 confirmed kv tokens per slot
+    k: int,  # drafts per slot (static)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(q_segments, q_positions) for S slots x (1+k) speculative verify
+    lanes, slot-major: lane j of slot s sits at logical position
+    lengths[s] + j — lane 0 is the slot's fed decode token, lanes 1..k
+    its drafted continuations. The packed writer and the ragged
+    attention kernel consume this unchanged (a draft lane IS a
+    chunked-prefill-suffix lane whose token happens to be proposed, not
+    given): per-row causal masking at own position makes lane j attend
+    to lanes < j of its own slot — freshly written this forward — and
+    to nothing of any other slot's lanes. Returns ([S*(1+k)],
+    [S*(1+k)]) int32."""
+    S = lengths.shape[0]
+    seg = jnp.repeat(jnp.arange(S, dtype=jnp.int32), k + 1)
+    pos = (
+        lengths[:, None].astype(jnp.int32)
+        + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    ).reshape(-1)
+    return seg, pos
 
 
 def ragged_paged_attention(

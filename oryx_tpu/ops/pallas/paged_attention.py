@@ -16,6 +16,15 @@ Two kernels share one skeleton here:
     from a (head_dim, page_size)-keyed grid table that is autotuned
     once per shape class and cached (`ragged_grid_config`).
 
+    Speculative decoding rides the SAME kernel unchanged: a slot's 1+k
+    verify lanes (ops/paged_kv.spec_lane_metadata) are just 1+k more
+    (segment, position) rows of the R-row grid — consecutive positions
+    of one segment, exactly the shape a chunked-prefill suffix already
+    exercises, so the R axis grows from S+pf to S*(1+k)+pf and nothing
+    else moves. The grid stays static per (S, k, pf_width) class; the
+    per-row page walk, dead-tile DMA elision and tail masking are
+    position-driven and need no notion of "draft".
+
 The TPU win in both: attention over a sequence's pages happens IN
 PLACE — the block table is a scalar-prefetch operand, so each kv
 tile's DMA source address is computed from it before the tile runs,

@@ -556,6 +556,7 @@ def build_server(
     prefill_chunk: int | None = None,
     prefix_cache: bool = True,
     ragged: bool = False,
+    speculate: int = 0,
     stall_timeout: float | None = None,
     flight_recorder_size: int = 256,
     ttft_slo: float | None = None,
@@ -620,6 +621,14 @@ def build_server(
         raise ValueError(
             "--ragged requires a scheduler engine (the window batcher "
             "has no paged dispatch to fuse)"
+        )
+    if speculate and not ragged:
+        # Same fail-fast contract: drafts are extra lanes of the fused
+        # ragged dispatch — accepting the flag without --ragged would
+        # promise multi-token steps that never happen.
+        raise ValueError(
+            "--speculate requires --ragged (draft tokens ride the "
+            "fused packed dispatch as extra verify lanes)"
         )
     if engine == "window" and request_timeout:
         # Same fail-fast contract for the containment knob: deadlines
@@ -690,7 +699,7 @@ def build_server(
             chunk=decode_chunk, max_ctx=max_ctx, metrics=metrics,
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-            ragged=ragged,
+            ragged=ragged, speculate=speculate,
             max_queue=max_queue, request_timeout=request_timeout,
             degraded_cooldown=degraded_cooldown,
         )
@@ -1251,6 +1260,16 @@ def main(argv: list[str] | None = None) -> None:
         "Greedy outputs are bit-identical to the split path.",
     )
     ap.add_argument(
+        "--speculate", type=int, default=0, metavar="K",
+        help="continuous engine: speculative decoding — every live "
+        "slot self-drafts K tokens per step (n-gram prompt lookup "
+        "against its own context; no second model) and the whole "
+        "fleet's drafts verify as extra lanes of the ONE fused "
+        "dispatch, so a slot advances 1..K+1 tokens per sequential "
+        "step. Greedy outputs stay byte-identical; temperature>0 uses "
+        "rejection sampling (distribution-exact). Requires --ragged.",
+    )
+    ap.add_argument(
         "--no-prefix-cache", action="store_true",
         help="continuous engine: disable the shared-prefix KV cache "
         "(copy-on-write paged pool reuse of repeated system/media "
@@ -1340,6 +1359,11 @@ def main(argv: list[str] | None = None) -> None:
         ap.error("--engine sharded requires --shard tp=N")
     if args.ragged and not args.prefill_chunk:
         ap.error("--ragged requires a nonzero --prefill-chunk")
+    if args.speculate and not args.ragged:
+        ap.error("--speculate requires --ragged (drafts are extra "
+                 "lanes of the fused dispatch)")
+    if args.speculate < 0:
+        ap.error("--speculate must be >= 0")
 
     from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
@@ -1363,6 +1387,7 @@ def main(argv: list[str] | None = None) -> None:
         prefill_chunk=args.prefill_chunk or None,
         prefix_cache=not args.no_prefix_cache,
         ragged=args.ragged,
+        speculate=args.speculate,
         stall_timeout=args.stall_timeout or None,
         flight_recorder_size=args.flight_recorder_size,
         ttft_slo=args.ttft_slo,

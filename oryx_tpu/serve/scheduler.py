@@ -41,6 +41,21 @@ shape classes: prefill lanes present/absent, selected by host state);
 greedy and seeded outputs stay byte-identical to the split path, and
 oryx_serving_dispatches_total{kind=} is the observable proof.
 
+Speculative decoding (`speculate=k`, requires ragged; docs/DESIGN.md
+"Speculative decoding"): the fused step becomes ONE packed verify
+forward (`generate.paged_spec_step`) where every live slot rides 1+k
+lanes — its fed token plus k tokens proposed host-side by a `Drafter`
+(default `generate.NgramDrafter`, prompt-lookup against the request's
+own confirmed stream; no second model) — and advances 1..k+1 tokens
+per sequential step. Greedy replies stay byte-identical (accept ==
+argmax match); temperature>0 is rejection-sampled against the same
+truncated distribution the plain sampler draws from. Rollback is free:
+lanes only ever write the slot's exclusively-owned pages (the
+COW-at-splice invariant), so rejected drafts are dead bytes past
+cur_len, never held pages. Billing splits honestly into device steps
+(verify lanes, rejected ones included) vs client tokens — see
+`_finish_dispatch` and the accepted_tokens_per_step histogram.
+
 Prefix cache + chunked prefill (serve/prefix_cache.py): admission looks
 up the longest page-aligned cached prefix of the prompt's token ids and
 SPLICES those pages into the new slot's block table — full pages shared
@@ -130,6 +145,7 @@ from oryx_tpu.utils.metrics import (
     PREFILL_CHUNK_BUCKETS,
     REQUEST_SECONDS_BUCKETS,
     REQUEST_TOKEN_BUCKETS,
+    SPEC_ACCEPT_BUCKETS,
     ServingMetrics,
     TTFT_BUCKETS,
 )
@@ -251,9 +267,16 @@ class _Request:
     # reads the finalized dict in handle.debug["cost"], never these);
     # the supervisor/drain paths touch them only once the engine
     # thread is dead (the race detector's handoff rule).
+    # decode_steps counts DEVICE work (scan steps, or verify lanes in
+    # speculative mode — rejected drafts are paid compute); decode
+    # _tokens counts what the CLIENT got (completion-progress tokens).
+    # They were equal before speculation; recording both keeps goodput
+    # and page-seconds attribution honest when one dispatch advances a
+    # slot by several tokens (or burns rejected lanes).
     cost_prefill_tokens: int = 0  # thread-owned: engine
     cost_cached_tokens: int = 0  # thread-owned: engine
     cost_decode_steps: int = 0  # thread-owned: engine
+    cost_decode_tokens: int = 0  # thread-owned: engine
     cost_page_seconds: float = 0.0  # thread-owned: engine
     pages_t: float = 0.0  # last accrual (0 = never held) # thread-owned: engine
     # Span handles into `trace` for regions that outlive one method:
@@ -293,6 +316,8 @@ class ContinuousScheduler:
         degraded_cooldown: float = 30.0,
         degraded_clamp_tokens: int = 64,
         ragged: bool = False,
+        speculate: int = 0,
+        drafter=None,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -326,6 +351,17 @@ class ContinuousScheduler:
                 "dispatch; set prefill_chunk (the per-step prompt "
                 "budget that sizes the packed buffer's prefill lanes)"
             )
+        if not isinstance(speculate, int) or speculate < 0:
+            raise ValueError(
+                f"speculate must be a non-negative integer (draft "
+                f"tokens per slot per step), got {speculate!r}"
+            )
+        if speculate and not ragged:
+            raise ValueError(
+                "speculate requires ragged=True: drafts are extra "
+                "packed lanes of the fused ragged dispatch (the split "
+                "engine has no packed buffer to extend)"
+            )
         # Optional SLO watcher (utils/anomaly.py): TTFT and queue-depth
         # breaches fire oryx_anomaly_total{kind=} + events.jsonl.
         self.anomaly = anomaly
@@ -358,10 +394,27 @@ class ContinuousScheduler:
         self.pf_width = (
             -(-prefill_chunk // chunk) if ragged else 0
         )
-        if ragged and prefill_chunk % chunk:
+        # Speculative decoding (docs/DESIGN.md "Speculative decoding"):
+        # k>0 makes the fused step a SINGLE packed verify forward —
+        # every live slot contributes 1+k lanes (fed token + k
+        # self-drafted continuations, proposed host-side between
+        # dispatches) and advances 1..k+1 tokens per sequential step.
+        # The per-step decode window is then 1+k tokens (capacity
+        # growth, splice feasibility), not `chunk`.
+        self.speculate = int(speculate)
+        self.drafter = None
+        if self.speculate:
+            self.drafter = (
+                drafter if drafter is not None
+                else generate_lib.NgramDrafter()
+            )
+        self._win = (1 + self.speculate) if self.speculate else chunk
+        if ragged and not self.speculate and prefill_chunk % chunk:
             # The prefill lanes advance chunk*pf_width tokens per fused
             # step — ceil-rounding silently raises the configured
-            # per-step admission budget, so say so once.
+            # per-step admission budget, so say so once. (The spec
+            # step is a single forward of exactly prefill_chunk lanes;
+            # no rounding there.)
             _LOG.warning(
                 "ragged: prefill_chunk=%d is not a multiple of "
                 "chunk=%d; the fused step advances admission by %d "
@@ -385,6 +438,13 @@ class ContinuousScheduler:
         # carried (docs/OBSERVABILITY.md).
         reg.counter("dispatches_total", ("kind",))
         reg.histogram("dispatch_rows", DISPATCH_ROWS_BUCKETS)
+        # Speculation accounting: tokens a slot advanced per engine
+        # step (sum/count mean is THE speculation headline — the
+        # accepted-tokens/step gate) plus raw draft economics
+        # (proposed vs accepted = the drafter's hit rate).
+        reg.histogram("accepted_tokens_per_step", SPEC_ACCEPT_BUCKETS)
+        reg.counter("draft_proposed_total")
+        reg.counter("draft_accepted_total")
         # Containment families, pre-registered so dashboards render
         # them at zero before the first incident.
         reg.counter("admission_rejected_total", ("reason",))
@@ -398,6 +458,7 @@ class ContinuousScheduler:
         reg.histogram("request_prefill_tokens", REQUEST_TOKEN_BUCKETS)
         reg.histogram("request_cached_tokens", REQUEST_TOKEN_BUCKETS)
         reg.histogram("request_decode_steps", REQUEST_TOKEN_BUCKETS)
+        reg.histogram("request_decode_tokens", REQUEST_TOKEN_BUCKETS)
         reg.histogram("request_page_seconds", PAGE_SECONDS_BUCKETS)
         reg.histogram("request_queue_seconds", REQUEST_SECONDS_BUCKETS)
         reg.histogram("request_prefill_seconds", REQUEST_SECONDS_BUCKETS)
@@ -881,6 +942,7 @@ class ContinuousScheduler:
             "prefill_tokens": req.cost_prefill_tokens,
             "cached_tokens": req.cost_cached_tokens,
             "decode_steps": req.cost_decode_steps,
+            "decode_tokens": req.cost_decode_tokens,
             "page_seconds": round(req.cost_page_seconds, 6),
             "queue_s": round(by.get("queue_wait", 0.0), 6),
             "prefill_s": round(by.get("prefill", 0.0), 6),
@@ -899,6 +961,7 @@ class ContinuousScheduler:
         m.observe("request_prefill_tokens", cost["prefill_tokens"])
         m.observe("request_cached_tokens", cost["cached_tokens"])
         m.observe("request_decode_steps", cost["decode_steps"])
+        m.observe("request_decode_tokens", cost["decode_tokens"])
         m.observe("request_page_seconds", cost["page_seconds"])
         m.observe("request_queue_seconds", cost["queue_s"])
         m.observe("request_prefill_seconds", cost["prefill_s"])
@@ -1258,7 +1321,7 @@ class ContinuousScheduler:
                             f"({req.max_new}) exceeds max_ctx {self.max_ctx}"
                         )
                     need = self.allocator.pages_for(
-                        req.length + self.chunk
+                        req.length + self._win
                     )
                     if need > self.num_pages:
                         raise ValueError(
@@ -1350,7 +1413,7 @@ class ContinuousScheduler:
         # otherwise a head that cannot fit would pay a futile full-page
         # device copy every engine step while it waits.
         total_need = self.allocator.pages_for(
-            min(req.length + self.chunk, self.max_ctx)
+            min(req.length + self._win, self.max_ctx)
         )
         avail = self.allocator.num_free
         if self.prefix_cache is not None:
@@ -1383,7 +1446,7 @@ class ContinuousScheduler:
             spliced = use
         req.spliced = spliced
         req.prefill_pos = spliced
-        if not self._grow_slot(s, req.length + self.chunk):
+        if not self._grow_slot(s, req.length + self._win):
             self._free_slot_pages(s)
             req.spliced = 0
             req.prefill_pos = 0
@@ -1617,7 +1680,7 @@ class ContinuousScheduler:
         for s in order:
             if self.slots[s] is None or self.finished[s]:
                 continue  # freed or evicted by an earlier iteration
-            while not self._grow_slot(s, int(self.lengths[s]) + self.chunk):
+            while not self._grow_slot(s, int(self.lengths[s]) + self._win):
                 me = self.slots[s].admit_seq
                 younger = [
                     v for v in order
@@ -1705,18 +1768,29 @@ class ContinuousScheduler:
 
     def _finish_dispatch(
         self, kind: str, rows: int, live: list[int], toks, t0_ns, dt,
+        n_new=None,
     ) -> None:
-        """Post-dispatch accounting shared by the split decode chunk
-        and the fused ragged step — ONE definition so the split-vs-
-        ragged metric A/B can never drift: beat bookkeeping, dispatch
-        metrics, the per-slot harvest/billing loop, and the decode-step
-        utilization counters. The decode-side numbers (TPOT, the
-        decode_steps family) are skipped when NO slot decoded during
-        the dispatch: a prefill-only fused step produces zero output
-        tokens, and billing its dead decode lanes would skew TPOT and
-        the wasted-step fraction against the ragged engine for a
-        structural reason the utilization metric doesn't track (the
-        split engine simply runs no decode dispatch in that state)."""
+        """Post-dispatch accounting shared by the split decode chunk,
+        the fused ragged step and the speculative step — ONE definition
+        so the metric A/B across engine modes can never drift: beat
+        bookkeeping, dispatch metrics, the per-slot harvest/billing
+        loop, and the decode-step utilization counters. The decode-side
+        numbers (TPOT, the decode_steps family) are skipped when NO
+        slot decoded during the dispatch: a prefill-only fused step
+        produces zero output tokens, and billing its dead decode lanes
+        would skew TPOT and the wasted-step fraction against the ragged
+        engine for a structural reason the utilization metric doesn't
+        track (the split engine simply runs no decode dispatch in that
+        state).
+
+        n_new (speculative harvest): per-slot count of valid tokens in
+        `toks` this step (fed token + accepted drafts). Billing then
+        switches from steps==tokens to the honest split: device work
+        per slot is its 1+k verify lanes (rejected drafts are paid
+        compute, visible as wasted steps), tokens consumed are the
+        n_new prefix, and the accepted_tokens_per_step histogram
+        observes each live slot's advance — its sum/count mean is the
+        speculation headline the bench gates on."""
         self.chunks_run += 1
         self.metrics.inc("chunks")
         self.metrics.inc("dispatches_total", labels={"kind": kind})
@@ -1725,13 +1799,24 @@ class ContinuousScheduler:
         )
         if self.watchdog is not None:
             self.watchdog.beat()
+        lane_steps = (
+            1 + self.speculate if n_new is not None else self.chunk
+        )
         useful = 0
+        emitted = 0
         for s, tokens in generate_lib.unpack_ragged_rows(
             toks, live
         ).items():
             req = self.slots[s]
             if req is None:
                 continue
+            if n_new is not None:
+                tokens = tokens[: int(n_new[s])]
+                emitted += len(tokens)
+                self.metrics.observe(
+                    "accepted_tokens_per_step", len(tokens),
+                    buckets=SPEC_ACCEPT_BUCKETS,
+                )
             # The same device window lands on every live request: decode
             # chunks are shared dispatches, and per-request attribution
             # is exactly what makes occupancy problems visible in a
@@ -1740,18 +1825,25 @@ class ContinuousScheduler:
                 "decode_chunk", t0_ns, int(dt * 1e9),
                 chunk=self.chunks_run, slot=s,
             )
-            # Ledger: the device ran `chunk` steps for this row whether
-            # or not the host kept them (replay skips are still cost);
-            # the per-chunk accrual keeps page-seconds refcount samples
+            # Ledger: the device ran `chunk` scan steps (or 1+k verify
+            # lanes) for this row whether or not the host kept them
+            # (replay skips and rejected drafts are still cost); the
+            # per-chunk accrual keeps page-seconds refcount samples
             # fresh while neighbors splice and release shared pages.
-            req.cost_decode_steps += self.chunk
+            req.cost_decode_steps += lane_steps
             self._accrue_page_seconds(s)
             useful += self._advance(s, tokens)
         if live:
-            self.metrics.observe(
-                "time_per_output_token_seconds", dt / max(1, self.chunk)
+            # Per-token latency: tokens per slot this dispatch is
+            # `chunk` for the scan paths, the mean accepted advance for
+            # the speculative path (the whole point: dt buys >1 token).
+            per_tok = (
+                emitted / len(live) if n_new is not None else self.chunk
             )
-            total = self.num_slots * self.chunk
+            self.metrics.observe(
+                "time_per_output_token_seconds", dt / max(1.0, per_tok)
+            )
+            total = self.num_slots * lane_steps
             self.metrics.inc("decode_steps_total", total)
             self.metrics.inc("decode_steps_useful", useful)
             self.metrics.inc("decode_steps_wasted", total - useful)
@@ -1787,7 +1879,20 @@ class ContinuousScheduler:
         ledger) is unchanged; only the device-call structure fuses.
         A slot whose prefill completes activates AFTER the harvest and
         joins the next dispatch (token streams are identical either
-        way — per-row math never depends on dispatch grouping)."""
+        way — per-row math never depends on dispatch grouping).
+
+        Speculative mode (`speculate=k`, docs/DESIGN.md "Speculative
+        decoding"): the dispatch becomes `generate.paged_spec_step` — a
+        SINGLE packed verify forward where every live slot rides 1+k
+        lanes (its fed token plus k host-proposed drafts) and the one
+        admitting slot rides `prefill_chunk` prefill lanes. Still
+        exactly one dispatch per engine step (kind="spec"), but a slot
+        advances 1..k+1 tokens per step instead of 1. Stop STRINGS are
+        detected host-side only (`_advance` runs at every step-harvest
+        in this mode, so detection lands at the same token position the
+        device-side window would have frozen at); device-side EOS
+        truncation inside an accepted span matches the sequential
+        freeze semantics (see spec_verify_rows)."""
         # Mid-admission cancels first (same invariant as _prefill_step:
         # a hung-up client's prefill must not ride the dispatch and its
         # pages — including spliced shares — return now).
@@ -1825,21 +1930,27 @@ class ContinuousScheduler:
         faults.fault_point("decode_dispatch")
         hot_dispatch("scheduler._ragged_step")
         W = self.pf_width
+        # Per-dispatch prefill budget in TOKENS: the spec step is a
+        # single forward carrying prefill_chunk lanes; the ragged scan
+        # carries W lanes per each of its `chunk` iterations.
+        win_tokens = (
+            self.prefill_chunk if self.speculate else self.chunk * W
+        )
         dtype = oryx.compute_dtype(self.cfg)
         pf_span = -1
         pf_off = pf_len = 0
         if pf_req is not None:
             pf_off, pf_len = pf_req.prefill_pos, pf_req.length
             window = generate_lib.pack_prefill_window(
-                pf_req.embeds_np, pf_off, self.chunk * W
+                pf_req.embeds_np, pf_off, win_tokens
             )
             pf_span = pf_req.trace.begin(
                 "prefill", slot=pf_s, start=pf_off,
-                tokens=min(self.chunk * W, pf_len - pf_off),
+                tokens=min(win_tokens, pf_len - pf_off),
                 cached=pf_req.spliced > 0, replay=pf_req.replay > 0,
                 ragged=True,
             )
-            pfw = W
+            pfw = self.prefill_chunk if self.speculate else W
             slot_c, len_c, active_c, key_c, temp_c, topp_c, topk_c = (
                 pf_req.pf_consts
             )
@@ -1864,41 +1975,87 @@ class ContinuousScheduler:
             pf_args = self._ragged_blanks
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
-        with self.pipe._mesh_scope():
-            (self.kv_pages, tok, lengths, finished, recent, self.keys,
-             toks, fin, pf_tok0, pf_key) = generate_lib.paged_ragged_step(
-                self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
-                jnp.asarray(self.bt),
-                jnp.asarray(self.tok),
-                jnp.asarray(self.lengths),
-                jnp.asarray(self.finished),
-                jnp.asarray(self.recent),
-                self.keys,
-                jnp.asarray(self.temp),
-                jnp.asarray(self.top_p),
-                jnp.asarray(self.top_k),
-                self.stop_sequences,
-                *pf_args,
-                chunk=self.chunk, pf_width=pfw,
-                eos=self.cfg.generation.eos_token_id,
-                attn_impl=self.cfg.attn_impl,
-                compute_dtype=dtype,
+        if self.speculate:
+            # Host-side self-drafting BEFORE the dispatch (the drafter
+            # needs the token history the device never holds); the
+            # whole fleet's proposals then verify in the one forward.
+            drafts, dlen = self._propose_drafts(live)
+            with self.pipe._mesh_scope():
+                (self.kv_pages, tok, lengths, finished, self.keys,
+                 toks, n_new, acc, pf_tok0, pf_key) = (
+                    generate_lib.paged_spec_step(
+                        self.pipe.params["llm"], self.cfg.llm,
+                        self.kv_pages,
+                        jnp.asarray(self.bt),
+                        jnp.asarray(self.tok),
+                        jnp.asarray(self.lengths),
+                        jnp.asarray(self.finished),
+                        self.keys,
+                        jnp.asarray(self.temp),
+                        jnp.asarray(self.top_p),
+                        jnp.asarray(self.top_k),
+                        jnp.asarray(drafts),
+                        jnp.asarray(dlen),
+                        *pf_args,
+                        k=self.speculate, pf_width=pfw,
+                        eos=self.cfg.generation.eos_token_id,
+                        attn_impl=self.cfg.attn_impl,
+                        compute_dtype=dtype,
+                    )
+                )
+            toks, n_new, acc = self._harvest_spec(
+                tok, lengths, finished, toks, n_new, acc
             )
-        toks, fin = self._harvest_chunk(
-            tok, lengths, finished, recent, toks, fin
-        )
-        dt = time.monotonic() - t0
-        # Decode billing covers only slots live DURING the dispatch —
-        # a slot activated below joins the next dispatch, and its toks
-        # row this time was frozen filler.
-        rows = len(live) + (
-            min(W, pf_len - pf_off) if pf_req is not None else 0
-        )
-        self._finish_dispatch("ragged", rows, live, toks, t0_ns, dt)
+            dt = time.monotonic() - t0
+            if live:
+                self.metrics.inc(
+                    "draft_proposed_total", int(dlen[live].sum())
+                )
+                self.metrics.inc(
+                    "draft_accepted_total", int(acc[live].sum())
+                )
+            rows = len(live) * (1 + self.speculate) + (
+                min(pfw, pf_len - pf_off) if pf_req is not None else 0
+            )
+            self._finish_dispatch(
+                "spec", rows, live, toks, t0_ns, dt, n_new=n_new
+            )
+        else:
+            with self.pipe._mesh_scope():
+                (self.kv_pages, tok, lengths, finished, recent, self.keys,
+                 toks, fin, pf_tok0, pf_key) = generate_lib.paged_ragged_step(
+                    self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
+                    jnp.asarray(self.bt),
+                    jnp.asarray(self.tok),
+                    jnp.asarray(self.lengths),
+                    jnp.asarray(self.finished),
+                    jnp.asarray(self.recent),
+                    self.keys,
+                    jnp.asarray(self.temp),
+                    jnp.asarray(self.top_p),
+                    jnp.asarray(self.top_k),
+                    self.stop_sequences,
+                    *pf_args,
+                    chunk=self.chunk, pf_width=pfw,
+                    eos=self.cfg.generation.eos_token_id,
+                    attn_impl=self.cfg.attn_impl,
+                    compute_dtype=dtype,
+                )
+            toks, fin = self._harvest_chunk(
+                tok, lengths, finished, recent, toks, fin
+            )
+            dt = time.monotonic() - t0
+            # Decode billing covers only slots live DURING the dispatch
+            # — a slot activated below joins the next dispatch, and its
+            # toks row this time was frozen filler.
+            rows = len(live) + (
+                min(W, pf_len - pf_off) if pf_req is not None else 0
+            )
+            self._finish_dispatch("ragged", rows, live, toks, t0_ns, dt)
         # Prefill bookkeeping + activation (after harvest by design).
         if pf_req is not None:
             pf_req.trace.end(pf_span)
-            advanced = min(self.chunk * W, pf_len - pf_off)
+            advanced = min(win_tokens, pf_len - pf_off)
             pf_req.prefill_pos = pf_off + advanced
             pf_req.cost_prefill_tokens += advanced
             self.metrics.inc("prefill_tokens_total", advanced)
@@ -1909,6 +2066,68 @@ class ContinuousScheduler:
             if pf_req.prefill_pos >= pf_len:
                 self._activate(pf_s, pf_req, pf_tok0[np.newaxis], pf_key)
         self._occupancy_gauge()
+
+    def _propose_drafts(self, live: list[int]):
+        """Host-side draft proposal for every live slot: the drafter
+        sees the request's DEVICE-CONFIRMED stream — prompt ids +
+        emitted[:confirmed] + the pending fed token — never the full
+        host `emitted`, which runs AHEAD of the device during eviction
+        replay; proposing from it would change the accept pattern
+        between the original run and its replay and diverge the
+        replayed RNG stream from what the client already saw.
+        Multimodal prompts (no clean token-id stream) draft from the
+        reply history alone. Only the drafter's declared `window` tail
+        is materialized (None = everything): without the bound, the
+        per-step host cost here grows O(prompt + reply) per slot —
+        exactly the sequential-latency bill speculation exists to cut.
+        Returns (drafts [S, k] int32, draft_len [S] int32); unproposed
+        lanes ride the dispatch masked."""
+        k = self.speculate
+        win = getattr(self.drafter, "window", None)
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        dlen = np.zeros((self.num_slots,), np.int32)
+        for s in live:
+            req = self.slots[s]
+            confirmed = max(0, int(self.lengths[s]) - req.length)
+            prompt = (
+                req.cache_tokens if req.cache_tokens is not None
+                else np.zeros((0,), np.int64)
+            )
+            reply = req.emitted[:confirmed]
+            if win is not None:
+                # Suffix of (prompt + confirmed reply + fed token),
+                # assembled from tail slices so nothing longer than
+                # the window is ever copied.
+                keep = max(0, win - 1 - len(reply))
+                prompt = (
+                    prompt[max(0, len(prompt) - keep):]
+                    if keep else prompt[:0]
+                )
+                reply = reply[max(0, len(reply) - (win - 1)):]
+            ctx = np.concatenate([
+                np.asarray(prompt, np.int64),
+                np.asarray(reply, np.int64),
+                np.asarray([int(self.tok[s])], np.int64),
+            ])
+            prop = self.drafter.propose(ctx, k)[:k]
+            drafts[s, : len(prop)] = prop
+            dlen[s] = len(prop)
+        return drafts, dlen
+
+    # hot-path
+    def _harvest_spec(self, tok, lengths, finished, toks, n_new, acc):
+        """Blocking host copies of a speculative dispatch's outputs —
+        the spec twin of `_harvest_chunk` (no `recent` window: stop
+        strings are host-detected in this mode, and fin is subsumed by
+        the finished vector + the EOS the accepted span carries). Same
+        one-deliberate-sync-per-step contract."""
+        # oryxlint: off=host-sync
+        self.tok = np.asarray(tok).copy()
+        self.lengths = np.asarray(lengths).copy()
+        self.finished = np.asarray(finished).copy()
+        out = np.asarray(toks), np.asarray(n_new), np.asarray(acc)
+        # oryxlint: on=host-sync
+        return out
 
     def _occupancy_gauge(self) -> None:
         live = sum(
@@ -1988,6 +2207,10 @@ class ContinuousScheduler:
             # (scripts/bench_serving_sched.py's A/B depends on this
             # number being honest).
             useful = min(useful, finish[1] - chunk_start)
+        # Ledger: tokens of client-visible completion progress this
+        # step (replay skips excluded, post-stop tokens clamped away) —
+        # the "decode_tokens" half of the steps-vs-tokens split.
+        req.cost_decode_tokens += useful
         if finish is not None:
             # Flush the held-back tail (stable_text_prefix may have
             # withheld whitespace / a stop-string prefix) exactly as

@@ -435,6 +435,13 @@ PREFILL_CHUNK_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
 # prefill and decode rows ride the SAME dispatch.
 DISPATCH_ROWS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                          256.0, 512.0, 1024.0, 2048.0, 4096.0)
+# Tokens a slot advanced per speculative engine step (1 fed token + the
+# accepted drafts): integers 1..k+1, so unit-ish buckets — the
+# oryx_serving_accepted_tokens_per_step histogram whose sum/count mean
+# is the speculation headline (gate: > 1.5 on repetitive workloads,
+# scripts/bench_paged_attention.py --smoke).
+SPEC_ACCEPT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                       32.0)
 # Lock wait/hold times for the LockOrderSanitizer's
 # oryx_lock_{wait,hold}_seconds{lock=} histograms: microseconds (the
 # healthy regime for every lock in the declared order) up to the one
@@ -460,8 +467,8 @@ PAGE_SECONDS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 # harness (scripts/loadgen.py) asserts is complete for every finished
 # request in /debug/requests.
 REQUEST_COST_KEYS = (
-    "prefill_tokens", "cached_tokens", "decode_steps", "page_seconds",
-    "queue_s", "prefill_s", "decode_s", "e2e_s",
+    "prefill_tokens", "cached_tokens", "decode_steps", "decode_tokens",
+    "page_seconds", "queue_s", "prefill_s", "decode_s", "e2e_s",
 )
 
 

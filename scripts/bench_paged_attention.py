@@ -18,6 +18,12 @@ asserted:
   * byte parity — every cell's replies are compared split vs ragged
     (the perf mode must not be a different model).
 
+A SPECULATION cell rides the same harness (--speculate K): a
+repetitive-text fixture through the spec engine vs the plain ragged
+engine, gating accepted-tokens/step > 1.5, dispatches/step still
+exactly 1.0 (kind="spec" only), zero recompiles after warmup, and
+byte parity — "speculation changes nothing but speed", measured.
+
 Writes BENCH_paged_attention.json. On a CPU host the numbers are a
 labeled cpu_proxy (structure claims — dispatch counts, recompiles,
 parity — are backend-independent; steps/s is not).
@@ -60,13 +66,28 @@ def _prompts(batch: int, context: int) -> list[str]:
     return out
 
 
+DISPATCH_KINDS = ("ragged", "spec", "prefill", "decode")
+
+
 def _counter(metrics, kind: str) -> float:
     fam = metrics.registry.counter("dispatches_total", ("kind",))
     return fam.labels(kind=kind).value
 
 
+def _accept_state(metrics) -> tuple[float, float]:
+    """(sum, count) of the accepted-tokens-per-step histogram; two
+    snapshots subtract into the measured-phase mean (the speculation
+    headline, warmup excluded)."""
+    from oryx_tpu.utils.metrics import parse_prom_histogram
+
+    h = parse_prom_histogram(
+        metrics.render(), "oryx_serving_accepted_tokens_per_step"
+    )
+    return (0.0, 0.0) if h is None else (h[3], float(h[2]))
+
+
 def _run_mode(pipe, prompts, max_new, *, ragged, prefill_chunk,
-              num_slots, watch):
+              num_slots, watch, speculate=0):
     """One measured cell: fresh scheduler, warmup workload (compiles
     the shape classes), then the measured burst under the recompile
     watchdog. Returns (result dict, replies)."""
@@ -78,7 +99,7 @@ def _run_mode(pipe, prompts, max_new, *, ragged, prefill_chunk,
     sched = ContinuousScheduler(
         pipe, num_slots=num_slots, page_size=16, chunk=4, max_ctx=1024,
         metrics=metrics, autostart=False, prefill_chunk=prefill_chunk,
-        ragged=ragged,
+        ragged=ragged, speculate=speculate,
     )
     sched.start()
     # Warmup: one short and one long admission so both shape classes
@@ -89,9 +110,8 @@ def _run_mode(pipe, prompts, max_new, *, ragged, prefill_chunk,
     t0 = time.monotonic()
     steps0 = metrics.get("decode_steps_total")
     chunks0 = metrics.get("chunks")
-    disp0 = {
-        k: _counter(metrics, k) for k in ("ragged", "prefill", "decode")
-    }
+    disp0 = {k: _counter(metrics, k) for k in DISPATCH_KINDS}
+    acc0 = _accept_state(metrics)
     replies = []
     if watch:
         with recompile_watchdog(budget=1, action="record") as stats:
@@ -107,9 +127,13 @@ def _run_mode(pipe, prompts, max_new, *, ragged, prefill_chunk,
     wall = time.monotonic() - t0
     beats = metrics.get("chunks") - chunks0
     disp = {
-        k: _counter(metrics, k) - disp0[k]
-        for k in ("ragged", "prefill", "decode")
+        k: _counter(metrics, k) - disp0[k] for k in DISPATCH_KINDS
     }
+    acc1 = _accept_state(metrics)
+    accept_mean = (
+        (acc1[0] - acc0[0]) / (acc1[1] - acc0[1])
+        if acc1[1] > acc0[1] else None
+    )
     sched.close()
     total_disp = sum(disp.values())
     out = {
@@ -126,6 +150,13 @@ def _run_mode(pipe, prompts, max_new, *, ragged, prefill_chunk,
             dict(stats.counts) if stats is not None else None
         ),
     }
+    if speculate:
+        out["speculate"] = speculate
+        out["accepted_tokens_per_step"] = (
+            round(accept_mean, 4) if accept_mean is not None else None
+        )
+        out["draft_proposed"] = metrics.get("draft_proposed_total")
+        out["draft_accepted"] = metrics.get("draft_accepted_total")
     return out, replies
 
 
@@ -138,10 +169,16 @@ def run(argv=None) -> dict:
     ap.add_argument("--num-slots", type=int, default=4)
     ap.add_argument("--json", default="BENCH_paged_attention.json")
     ap.add_argument(
+        "--speculate", type=int, default=6, metavar="K",
+        help="draft depth for the speculation cell (repetitive-text "
+        "fixture, spec engine vs plain ragged; 0 skips the cell)",
+    )
+    ap.add_argument(
         "--smoke", action="store_true",
         help="one tiny cell + hard gates (dispatches/step == 1 on the "
-        "ragged path, zero recompiles after warmup, byte parity); "
-        "wired into scripts/check_tier1.sh",
+        "ragged path AND the speculative path, accepted-tokens/step "
+        "> 1.5 on the repetitive fixture, zero recompiles after "
+        "warmup, byte parity); wired into scripts/check_tier1.sh",
     )
     args = ap.parse_args(argv)
     if args.smoke:
@@ -206,6 +243,60 @@ def run(argv=None) -> dict:
                             f"cell {batch}x{ctx}x{pc} {mode}: recompiled "
                             f"after warmup: {rc}"
                         )
+    spec_cell = None
+    if args.speculate:
+        # Speculation cell (repetitive-text fixture): the spec engine's
+        # whole claim is fewer SEQUENTIAL steps at one dispatch each —
+        # gate accepted-tokens/step > 1.5, dispatches/step still 1.0
+        # (kind="spec" only), zero recompiles after warmup, and byte
+        # parity vs the plain ragged engine on the same prompts.
+        rep = ("the quick brown fox jumps over the lazy dog " * 3).strip()
+        prompts = [rep, rep + " again", rep + " and again"]
+        # Long enough that the repetitive continuation dominates the
+        # mean (the first few steps pay cold drafts); the fixture and
+        # decode budget are fixed so the gate margin is stable.
+        spec_new = max(args.max_new, 48)
+        plain, r_plain = _run_mode(
+            pipe, prompts, spec_new, ragged=True, prefill_chunk=8,
+            num_slots=args.num_slots, watch=True,
+        )
+        spec, r_spec = _run_mode(
+            pipe, prompts, spec_new, ragged=True, prefill_chunk=8,
+            num_slots=args.num_slots, watch=True,
+            speculate=args.speculate,
+        )
+        spec_cell = {
+            "prompts": len(prompts), "max_new": spec_new,
+            "speculate": args.speculate,
+            "plain_ragged": plain, "spec": spec,
+            "replies_bit_identical": r_plain == r_spec,
+        }
+        if r_plain != r_spec:
+            failures.append("speculation cell: replies differ vs ragged")
+        if spec["dispatches_per_step"] != 1.0:
+            failures.append(
+                f"speculation cell: {spec['dispatches_per_step']} "
+                "dispatches/step (must stay 1.0)"
+            )
+        if (
+            spec["dispatches"]["ragged"] or spec["dispatches"]["prefill"]
+            or spec["dispatches"]["decode"]
+        ):
+            failures.append(
+                "speculation cell: non-spec dispatch kinds leaked: "
+                f"{spec['dispatches']}"
+            )
+        accept = spec.get("accepted_tokens_per_step")
+        if accept is None or accept <= 1.5:
+            failures.append(
+                f"speculation cell: accepted-tokens/step {accept} "
+                "(gate: > 1.5 on the repetitive fixture)"
+            )
+        if spec["recompiles_after_warmup"]:
+            failures.append(
+                "speculation cell: recompiled after warmup: "
+                f"{spec['recompiles_after_warmup']}"
+            )
     out = {
         "bench": "paged_attention_ragged",
         "backend": backend if backend == "tpu" else "cpu_proxy",
@@ -214,6 +305,7 @@ def run(argv=None) -> dict:
             "max_new": args.max_new,
         },
         "cells": cells,
+        "speculation": spec_cell,
         "gates": {"failures": failures, "passed": not failures},
     }
     if args.json:

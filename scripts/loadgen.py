@@ -260,6 +260,45 @@ def server_hist_quantiles(
     return out
 
 
+def speculation_block(scrape_pairs: list[tuple[str, str]]) -> dict:
+    """Per-stage speculation report from server scrape deltas (one
+    (before, after) pair per backend; a fleet sums across replicas):
+    accepted-tokens-per-step MEAN from the
+    oryx_serving_accepted_tokens_per_step histogram's sum/count delta
+    (the docs/OBSERVABILITY.md headline — >1 means speculation is
+    converting drafts into latency), plus the raw draft economics.
+    `active` stays False (and the mean None) on a non-speculative
+    engine, so the block is schema-stable either way."""
+    from oryx_tpu.utils.metrics import parse_prom_histogram
+
+    fam = "oryx_serving_accepted_tokens_per_step"
+    d_sum = d_cnt = prop = acc = 0.0
+    for m0, m1 in scrape_pairs:
+        h0 = parse_prom_histogram(m0, fam)
+        h1 = parse_prom_histogram(m1, fam)
+        if h0 is not None and h1 is not None:
+            d_sum += h1[3] - h0[3]
+            d_cnt += h1[2] - h0[2]
+        for name, ref in (
+            ("oryx_serving_draft_proposed_total", "prop"),
+            ("oryx_serving_draft_accepted_total", "acc"),
+        ):
+            d = _counter_value(m1, name) - _counter_value(m0, name)
+            if ref == "prop":
+                prop += d
+            else:
+                acc += d
+    return {
+        "active": d_cnt > 0,
+        "accepted_tokens_per_step": (
+            round(d_sum / d_cnt, 4) if d_cnt > 0 else None
+        ),
+        "draft_proposed": prop,
+        "draft_accepted": acc,
+        "draft_accept_rate": round(acc / prop, 4) if prop > 0 else None,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Stage runner + aggregation
 # ---------------------------------------------------------------------------
@@ -442,6 +481,11 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
         ),
         "errors": errors,
         "anomalies": anomalies,
+        "speculation": speculation_block(
+            [(replica_scrapes[0].get(rid, ""), replica_scrapes[1][rid])
+             for rid in replica_scrapes[1]]
+            if replica_scrapes is not None else [(m0, m1)]
+        ),
         "cost": {
             "requests_with_cost": len(costs),
             "prefill_tokens": prefill,
@@ -450,6 +494,9 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
                 cached / max(1, prefill + cached), 4
             ),
             "decode_steps": sum(c["decode_steps"] for c in costs),
+            "decode_tokens": sum(
+                c.get("decode_tokens", 0) for c in costs
+            ),
             "page_seconds": round(page_s, 3),
             "mean_page_seconds": round(page_s / max(1, len(costs)), 6),
             "goodput_tokens_per_page_second": round(
@@ -567,7 +614,7 @@ def find_knee(stages: list[dict], good_frac: float = 0.9) -> dict | None:
 _STAGE_KEYS = (
     "offered_rps", "sent", "ok", "good", "slo_good_frac", "goodput_tps",
     "completed_tps", "ttft_s", "per_token_s", "server_ttft_s", "errors",
-    "anomalies", "cost",
+    "anomalies", "speculation", "cost",
 )
 
 
@@ -740,9 +787,11 @@ def boot_tiny_server(args, *, replica_id: str | None = None,
     if params is None:
         params = oryx.init_params(cfg, jax.random.key(0))
     pipe = OryxInference(_CharTokenizer(), params, cfg)
+    speculate = getattr(args, "speculate", 0)
     srv = api_server.build_server(
         pipe, port=0, engine="continuous", num_slots=2, page_size=16,
         decode_chunk=4, max_ctx=512, prefill_chunk=32,
+        ragged=bool(speculate), speculate=speculate,
         ttft_slo=args.server_ttft_slo,
         queue_depth_slo=args.server_queue_depth_slo,
         replica_id=replica_id,
@@ -846,6 +895,11 @@ def run(argv=None) -> dict:
     ap.add_argument("--knee-good-frac", type=float, default=0.9,
                     help="a stage below the knee must meet the SLO for "
                     "at least this request fraction")
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="self-booted server only: serve with the "
+                    "speculative ragged engine (--ragged --speculate K "
+                    "semantics); the per-stage speculation block then "
+                    "reports accepted-tokens/step and draft economics")
     ap.add_argument("--request-timeout", type=float, default=300.0)
     ap.add_argument("--max-inflight", type=int, default=256)
     ap.add_argument("--out", default="BENCH_loadgen.json",

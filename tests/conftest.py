@@ -321,6 +321,7 @@ _LOCK_SAN_FILES = (
     "test_metrics_registry.py",
     "test_prefix_cache.py",
     "test_ragged_attention.py",
+    "test_speculative.py",
 )
 
 

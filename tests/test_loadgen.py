@@ -82,9 +82,14 @@ def _stage(rate, good_frac, anomalies=0.0, hung=0, transport=0,
                    "transport": transport, "stream_error": 0,
                    "harness_inflight_cap": capped},
         "anomalies": {"ttft_slo": anomalies, "queue_depth_slo": 0.0},
+        "speculation": {"active": False,
+                        "accepted_tokens_per_step": None,
+                        "draft_proposed": 0.0, "draft_accepted": 0.0,
+                        "draft_accept_rate": None},
         "cost": {"requests_with_cost": 20, "prefill_tokens": 100,
                  "cached_tokens": 50, "cache_hit_frac": 0.33,
-                 "decode_steps": 80, "page_seconds": 2.0,
+                 "decode_steps": 80, "decode_tokens": 75,
+                 "page_seconds": 2.0,
                  "mean_page_seconds": 0.1,
                  "goodput_tokens_per_page_second": 50.0},
     }

@@ -474,6 +474,33 @@ def test_race_exempt_suppresses_checks(toy):
         assert not S.race_violations()
 
 
+def test_race_exempt_covers_stale_descriptor_epochs(toy):
+    """Regression (found wiring the speculative engine's pool-invariant
+    check through the armed CI pass): a descriptor installed by an
+    EARLIER arming epoch can outlive its detector (process-wide arming
+    via build_server's maybe_arm_from_env has no disarm point, and a
+    re-arming skips already-instrumented fields) — race_exempt taken
+    under the CURRENT epoch must still suppress the stale descriptor's
+    check, or an exempted quiesced read raises RaceViolation."""
+    stale = S.RaceDetector(action="raise")
+    try:
+        stale.install_module(toy)
+        box = toy.Box()
+        box.items.append(1)  # main thread seeds ownership
+
+        def exempt_read():
+            with S.race_exempt("quiesced"):
+                return box.items
+
+        # A second live thread interleaves, then the main thread reads
+        # back under race_exempt — with the exemption keyed to the
+        # stale detector this raised; keyed to the thread it must not.
+        assert _interleave(box, "items", exempt_read) == [1]
+        assert not stale.violations
+    finally:
+        stale.uninstall()
+
+
 def test_disarm_restores_classes(toy):
     with S.lock_sanitizer(race_modules=[toy]):
         assert any(
