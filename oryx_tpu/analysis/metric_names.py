@@ -29,11 +29,23 @@ Rules:
 `.info(...)` is only treated as a metric declaration when the receiver
 looks like a registry (`...registry.info` / `reg.info`) so ordinary
 `logger.info("...")` lines never match.
+
+Wide-event schema (PR 12): the same rule also checks every
+``build_request_event(...)`` call site (utils/request_log.py) — each
+literal keyword field must be snake_case AND drawn from the declared
+``REQUEST_EVENT_KEYS`` registry in utils/metrics.py (a superset of
+``REQUEST_COST_KEYS``). The registry is read from the canonical
+metrics module's AST (never imported — metrics.py imports jax), so
+the check works in single-file fixture runs too. A ``**splat`` passes
+statically (runtime validation in build_request_event covers it); a
+literal key outside the registry is exactly the silent-schema-drift
+this catches.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Iterator
 
@@ -53,6 +65,72 @@ _DECLARING = {"counter": "counter", "gauge": "gauge",
               "histogram": "histogram", "info": "info"}
 _USING = {"inc": "counter", "set_gauge": "gauge",
           "observe": "histogram", "set_info": "info"}
+
+
+_EVENT_BUILDER = "build_request_event"
+_EVENT_KEYS_CACHE: tuple[frozenset[str] | None, bool] = (None, False)
+
+
+def _event_keys() -> frozenset[str] | None:
+    """REQUEST_EVENT_KEYS resolved from utils/metrics.py by AST (the
+    canonical registry; REQUEST_COST_KEYS + literal extension). None
+    when the module or the assignments can't be found — the check then
+    stays quiet rather than guessing a schema."""
+    global _EVENT_KEYS_CACHE
+    keys, loaded = _EVENT_KEYS_CACHE
+    if loaded:
+        return keys
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir, "utils", "metrics.py",
+    )
+    resolved: dict[str, tuple[str, ...]] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in (
+                    "REQUEST_COST_KEYS", "REQUEST_EVENT_KEYS"
+                )
+            ):
+                continue
+            name = node.targets[0].id
+            val = node.value
+            parts: list[str] = []
+            terms = (
+                [val.left, val.right]
+                if isinstance(val, ast.BinOp)
+                and isinstance(val.op, ast.Add) else [val]
+            )
+            for term in terms:
+                if isinstance(term, ast.Name):
+                    parts += list(resolved.get(term.id, ()))
+                elif isinstance(term, ast.Tuple):
+                    parts += [
+                        e.value for e in term.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+            resolved[name] = tuple(parts)
+        keys = (
+            frozenset(resolved["REQUEST_EVENT_KEYS"])
+            if resolved.get("REQUEST_EVENT_KEYS") else None
+        )
+    except (OSError, SyntaxError):
+        keys = None
+    _EVENT_KEYS_CACHE = (keys, True)
+    return keys
+
+
+def _is_event_builder(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id == _EVENT_BUILDER
+    return isinstance(fn, ast.Attribute) and fn.attr == _EVENT_BUILDER
 
 
 def _metric_call(call: ast.Call) -> tuple[str, str, bool] | None:
@@ -108,6 +186,9 @@ class MetricNameChecker(Checker):
         for call in ast.walk(mod.tree):
             if not isinstance(call, ast.Call):
                 continue
+            if _is_event_builder(call):
+                yield from self._check_event_fields(mod, call)
+                continue
             mk = _metric_call(call)
             if mk is None or not call.args:
                 continue
@@ -161,4 +242,37 @@ class MetricNameChecker(Checker):
                     f"metric family {name!r} used as a {kind} here "
                     f"but declared/used elsewhere as: {where} — one "
                     "family, one kind",
+                )
+
+    # ---- wide-event schema (utils/request_log.build_request_event) -------
+
+    def _check_event_fields(
+        self, mod: ParsedModule, call: ast.Call
+    ) -> Iterator[Finding | None]:
+        """Literal keyword fields of a build_request_event call must be
+        snake_case members of REQUEST_EVENT_KEYS. `**splat` fields pass
+        here (build_request_event re-validates at runtime); the
+        defining module itself (utils/request_log.py, where the name is
+        a def, not a call into the registry contract) contains no call
+        sites, so no special-casing is needed."""
+        registry = _event_keys()
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue  # **splat: runtime-validated
+            if not _NAME_RE.match(kw.arg):
+                yield self.finding(
+                    mod,
+                    call,
+                    f"wide-event field {kw.arg!r} is not lowercase "
+                    "snake_case (the request-event schema is "
+                    "snake_case throughout)",
+                )
+            elif registry is not None and kw.arg not in registry:
+                yield self.finding(
+                    mod,
+                    call,
+                    f"wide-event field {kw.arg!r} is not declared in "
+                    "utils.metrics.REQUEST_EVENT_KEYS — extend the "
+                    "registry (and the docs) instead of letting the "
+                    "JSONL schema drift",
                 )

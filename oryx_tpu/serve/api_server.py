@@ -19,10 +19,23 @@ web-framework dependency.
                          too; ?limit=K bounds the response, ?state=
                          active|done|error filters — both built to stay
                          usable mid load-sweep; finished entries carry
-                         the per-request cost ledger in meta.cost)
+                         the per-request cost ledger in meta.cost;
+                         ?format=jsonl exports the wide-event log —
+                         one canonical JSON line per terminal request,
+                         schema utils.metrics.REQUEST_EVENT_KEYS)
   GET /debug/trace?id=  (one request's span tree as Chrome trace JSON —
                          loads in Perfetto; id from the X-Request-Id
-                         header every response carries)
+                         header every response carries. Client-supplied
+                         X-Request-Id values are honored end-to-end —
+                         sanitized, minted on absence/collision — and a
+                         router-propagated X-Oryx-Trace header adopts
+                         the fleet-wide id + records the parent span)
+  GET /debug/timeline   (the engine flight data recorder: ?n= newest
+                         per-step records — dispatch kind/rows/wall
+                         time, live slots, accepted tokens, queue
+                         depth, free pages, degraded mode — plus
+                         cumulative dispatch-kind counts that reconcile
+                         with oryx_serving_dispatches_total)
 
 Content may be a plain string or OpenAI content-part lists; image parts
 (`{"type": "image_url", "image_url": {"url": "data:image/...;base64,..."
@@ -363,9 +376,12 @@ class Batcher:
     def submit(
         self, request: dict[str, Any], max_new: int,
         sampling: dict[str, Any] | None = None,
+        request_id: str | None = None,
     ) -> _Pending:
+        # The tracer atomically mints a fresh id on collision — an id
+        # names ONE request.
         tr = self.tracer.start_trace(
-            "request", label=f"chat max_new={max_new}"
+            "request", label=f"chat max_new={max_new}", id=request_id,
         )
         p = _Pending(request, max_new, sampling, trace=tr)
         self.q.put(p)
@@ -568,6 +584,8 @@ def build_server(
     supervise: bool = True,
     faults_spec: str | None = None,
     replica_id: str | None = None,
+    requests_log_path: str | None = None,
+    requests_log_max_bytes: int = 16 * 1024 * 1024,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline.
 
@@ -690,7 +708,15 @@ def build_server(
         )
     else:
         from oryx_tpu.serve import engine as engine_lib
+        from oryx_tpu.utils.request_log import RequestLog
 
+        # Wide-event request log (utils/request_log.py): one JSONL
+        # event per terminal request, in-memory always (the
+        # /debug/requests?format=jsonl export), on disk when
+        # --requests-log names a path (size-capped rotation).
+        request_log = RequestLog(
+            requests_log_path, max_bytes=requests_log_max_bytes
+        )
         # Engine registry (serve/engine.py): "continuous", "sharded",
         # and whatever later shapes register — all drop-in behind this
         # server and the supervisor through the Engine protocol.
@@ -702,6 +728,8 @@ def build_server(
             ragged=ragged, speculate=speculate,
             max_queue=max_queue, request_timeout=request_timeout,
             degraded_cooldown=degraded_cooldown,
+            request_log=request_log, engine_label=engine,
+            replica_id=replica_id,
         )
         if supervise:
             supervisor = EngineSupervisor(scheduler)
@@ -769,13 +797,13 @@ def build_server(
                 q = urllib.parse.parse_qs(
                     urllib.parse.urlsplit(self.path).query
                 )
-                state = (q.get("state") or [""])[0]
-                if state not in ("", "all", "active", "done", "error"):
+                fmt = (q.get("format") or [""])[0]
+                if fmt not in ("", "json", "jsonl"):
                     self._json(400, {
-                        "error": f"unknown state {state!r} "
-                        "(active|done|error|all)",
+                        "error": f"unknown format {fmt!r} (json|jsonl)",
                     })
                     return
+                # One ?limit= contract for both formats.
                 try:
                     limit = int((q.get("limit") or ["0"])[0])
                     if limit < 0:
@@ -783,6 +811,35 @@ def build_server(
                 except ValueError:
                     self._json(400, {
                         "error": "limit must be a non-negative integer",
+                    })
+                    return
+                if fmt == "jsonl":
+                    # Wide-event export: the canonical one-line-per-
+                    # terminal-request log (utils/request_log.py),
+                    # schema REQUEST_EVENT_KEYS. ?limit= bounds it.
+                    if scheduler is None:
+                        self._json(400, {
+                            "error": "wide events require a scheduler "
+                            "engine (the window batcher has no "
+                            "request log)",
+                        })
+                        return
+                    data = scheduler.request_log.export_jsonl(
+                        limit or None
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson"
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                state = (q.get("state") or [""])[0]
+                if state not in ("", "all", "active", "done", "error"):
+                    self._json(400, {
+                        "error": f"unknown state {state!r} "
+                        "(active|done|error|all)",
                     })
                     return
                 reqs = tracer.snapshot()
@@ -804,6 +861,33 @@ def build_server(
                     "returned": len(reqs),
                     "requests": reqs,
                 })
+            elif self.path.split("?", 1)[0] == "/debug/timeline":
+                # The engine flight data recorder (utils/timeline.py):
+                # newest-first per-step records plus cumulative
+                # dispatch-kind counts that reconcile against
+                # oryx_serving_dispatches_total.
+                if scheduler is None:
+                    self._json(400, {
+                        "error": "the step timeline requires a "
+                        "scheduler engine (the window batcher has no "
+                        "engine step loop)",
+                    })
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                try:
+                    n = int((q.get("n") or ["64"])[0])
+                    if n < 0:
+                        raise ValueError
+                except ValueError:
+                    self._json(400, {
+                        "error": "n must be a non-negative integer",
+                    })
+                    return
+                body = {"engine": engine}
+                body.update(scheduler.timeline.to_dict(n or None))
+                self._json(200, body)
             elif self.path.startswith("/debug/trace"):
                 q = urllib.parse.parse_qs(
                     urllib.parse.urlsplit(self.path).query
@@ -907,13 +991,39 @@ def build_server(
                 }})
                 return
 
+            # Request identity, honored end-to-end: a sanitized client
+            # X-Request-Id becomes the trace id (responses echo it, so
+            # client logs join /debug/trace without extra plumbing); a
+            # router-propagated X-Oryx-Trace header (`rid;parent-span`)
+            # wins over both — the router already honored the client's
+            # id, and its rid is what keys the merged fleet trace.
+            # Unsafe or colliding ids fall back to minting.
+            rid_pref = trace_lib.sanitize_request_id(
+                self.headers.get("X-Request-Id")
+            )
+            routed = False
+            router_parent: int | None = None
+            if xt := self.headers.get("X-Oryx-Trace"):
+                t_rid, _, t_parent = xt.partition(";")
+                if t_rid := trace_lib.sanitize_request_id(t_rid):
+                    rid_pref = t_rid
+                    routed = True
+                    try:
+                        router_parent = int(t_parent)
+                    except ValueError:
+                        router_parent = None
+
             is_video = bool(req.get("video")) and len(images) > 1
             request_dict = {
                 "question": question, "images": images,
                 "is_video": is_video, "history": history,
             }
             if scheduler is not None:
-                self._continuous(req, request_dict, max_new, sampling)
+                self._continuous(
+                    req, request_dict, max_new, sampling,
+                    request_id=rid_pref, routed=routed,
+                    router_parent=router_parent,
+                )
                 return
             if req.get("stream"):
                 # A producer thread owns the device (and the lock); this
@@ -944,7 +1054,8 @@ def build_server(
                 # flight-recorder entry; activate() propagates it into
                 # chat_stream's prefill / decode_chunk spans.
                 tr = tracer.start_trace(
-                    "request", label=f"stream max_new={max_new}"
+                    "request", label=f"stream max_new={max_new}",
+                    id=rid_pref,  # atomically minted on collision
                 )
 
                 def produce():
@@ -1030,7 +1141,9 @@ def build_server(
                     gone.set()  # stop the producer at its next chunk
                 return
 
-            pending = batcher.submit(request_dict, max_new, sampling)
+            pending = batcher.submit(
+                request_dict, max_new, sampling, request_id=rid_pref
+            )
             pending.done.wait()
             if pending.error is not None:
                 self._json(500, {"error": {"message": pending.error}},
@@ -1041,7 +1154,9 @@ def build_server(
                     usage=pending.usage, request_id=pending.request_id,
                 ), request_id=pending.request_id)
 
-        def _continuous(self, req, request_dict, max_new, sampling) -> None:
+        def _continuous(self, req, request_dict, max_new, sampling,
+                        request_id=None, routed=False,
+                        router_parent=None) -> None:
             """Route one request through the continuous-batching
             scheduler. The scheduler thread owns the device; this
             handler thread only drains the handle's event queue, so a
@@ -1053,6 +1168,7 @@ def build_server(
                 handle = scheduler.submit(
                     request_dict, max_new, sampling,
                     streaming=bool(req.get("stream")),
+                    request_id=request_id, routed=routed,
                 )
             except AdmissionRejected as e:
                 # Backpressure / shed-load -> 429, draining -> 503;
@@ -1070,6 +1186,15 @@ def build_server(
                 })
                 return
             rid = handle.request_id
+            if routed:
+                # Mark the trace as router-originated and remember the
+                # router's parent span index: the router's merged
+                # /debug/trace?id= view nests this replica's spans
+                # under it, and offline consumers can tell routed from
+                # direct traffic.
+                handle.trace.annotate(
+                    routed=True, router_parent_span=router_parent
+                )
             if not req.get("stream"):
                 handle.done.wait()
                 if handle.error is not None:
@@ -1185,6 +1310,10 @@ def build_server(
     srv.tracer = tracer
     srv.anomaly = anomaly
     srv.supervisor = supervisor
+    srv.request_log = (
+        scheduler.request_log if scheduler is not None else None
+    )
+    srv.timeline = scheduler.timeline if scheduler is not None else None
 
     def begin_drain() -> None:
         """Drain-on-shutdown, step 1: /readyz flips 503 NOW (router
@@ -1304,6 +1433,13 @@ def main(argv: list[str] | None = None) -> None:
         "(see docs/OBSERVABILITY.md for the schema)",
     )
     ap.add_argument(
+        "--requests-log", default=None, metavar="PATH",
+        help="continuous engine: append one wide JSONL event per "
+        "terminal request here (size-capped, rolls to PATH.1; schema "
+        "utils.metrics.REQUEST_EVENT_KEYS). The in-memory ring behind "
+        "/debug/requests?format=jsonl is always on",
+    )
+    ap.add_argument(
         "--max-queue", type=int, default=256,
         help="continuous engine: bound on the admission queue; beyond "
         "it new requests get 429 + Retry-After instead of unbounded "
@@ -1398,6 +1534,7 @@ def main(argv: list[str] | None = None) -> None:
         supervise=not args.no_supervisor,
         faults_spec=args.faults or os.environ.get("ORYX_FAULTS"),
         replica_id=args.replica_id,
+        requests_log_path=args.requests_log,
     )
 
     def _drain_and_exit() -> None:

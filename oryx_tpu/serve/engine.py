@@ -74,6 +74,8 @@ class Engine(Protocol):
         *,
         streaming: bool = False,
         timeout_s: float | None = None,
+        request_id: str | None = None,
+        routed: bool = False,
     ) -> Any: ...
 
     def cancel(self, handle: Any) -> None: ...

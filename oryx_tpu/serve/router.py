@@ -40,11 +40,19 @@ GET /metrics, and GET /metrics/aggregate re-exports every replica's
 own scrape with a `replica="<id>"` label injected per sample line
 (utils/metrics.inject_exposition_label) — one scrape shows the fleet.
 GET /debug/requests merges the replicas' flight recorders (per-replica
-totals preserved); GET /debug/trace?id= finds the replica that served
-the id. /healthz is process liveness; /readyz is "≥ 1 healthy replica
-and not draining". SIGTERM drains: /readyz flips 503 immediately, new
-POSTs get 503 + Retry-After, streams already proxying run to
-completion.
+totals preserved; ?format=jsonl concatenates their wide-event logs)
+and GET /debug/timeline their engine step timelines. Distributed
+tracing: every proxied request gets a router-side trace (route_decide
+/ upstream_connect / upstream_ttfb spans, retry + eject events) under
+the SAME request id the replica adopts — a sanitized client
+X-Request-Id is honored, and the id + parent span ride the
+X-Oryx-Trace header upstream — so GET /debug/trace?id= returns ONE
+merged Perfetto-loadable trace: router spans on track 0, the owning
+replica's engine spans (eviction/restart replays included) on track 1,
+re-anchored onto the router's clock. /healthz is process liveness;
+/readyz is "≥ 1 healthy replica and not draining". SIGTERM drains:
+/readyz flips 503 immediately, new POSTs get 503 + Retry-After,
+streams already proxying run to completion.
 
     python -m oryx_tpu.serve.router --port 8100 \
         --replica r0=http://127.0.0.1:8000 \
@@ -75,6 +83,7 @@ import numpy as np
 from oryx_tpu.analysis import sanitizers
 from oryx_tpu.analysis.sanitizers import named_lock
 from oryx_tpu.serve.prefix_cache import TokenTrie
+from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.metrics import (
     TTFT_BUCKETS,
     Registry,
@@ -156,6 +165,7 @@ class PrefixAffinityRouter:
         max_trie_nodes: int = 4096,
         retry_policy: BackoffPolicy | None = None,
         registry: Registry | None = None,
+        flight_recorder_size: int = 256,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -179,6 +189,12 @@ class PrefixAffinityRouter:
         )
         self._hits = 0  # guarded-by: _lock
         self._misses = 0  # guarded-by: _lock
+        # Router-side flight recorder: one trace per proxied request
+        # (route_decide / upstream_connect / upstream_ttfb spans, retry
+        # and eject events), keyed by the SAME request id the replica's
+        # trace carries — /debug/trace?id= merges the two into one
+        # story (docs/OBSERVABILITY.md "Fleet tracing").
+        self.tracer = trace_lib.Tracer(flight_recorder_size)
         self.registry = registry or Registry(prefix="oryx_router")
         reg = self.registry
         # Pre-registered so the whole surface renders (at zero) from
@@ -361,6 +377,34 @@ class PrefixAffinityRouter:
             }
 
 
+def _merge_clock_offset_us(router_meta: dict[str, Any],
+                           replica_request: dict[str, Any]) -> float:
+    """Microseconds to ADD to the replica's chrome-trace timestamps so
+    the merged trace sits on the router's clock.
+
+    Both sides stamp spans on a wall-anchored perf clock
+    (utils/trace.py), so on one host — or NTP-synced hosts — the
+    offset is ~0 and re-anchoring would only erase real queueing
+    delay; the replica's trace is kept where it is. When the replica's
+    trace-creation time is IMPLAUSIBLE against the router's recorded
+    send time (created before the request was sent, or absurdly after
+    it), the clocks disagree and the replica trace re-anchors to the
+    router's send instant — slightly compressing the network hop, but
+    putting every span on one readable axis."""
+    sent_ns = router_meta.get("upstream_sent_ns")
+    created_s = replica_request.get("created_unix_s")
+    if not sent_ns or not created_s:
+        return 0.0
+    sent_us = sent_ns / 1e3
+    created_us = float(created_s) * 1e6
+    # 10ms of backwards slack (float rounding, sub-ms skew) and 120s
+    # forward (a request can sit in the replica's accept queue, but
+    # not for minutes before its trace even starts).
+    if sent_us - 1e4 <= created_us <= sent_us + 120e6:
+        return 0.0
+    return round(sent_us - created_us, 3)
+
+
 def build_router(
     replicas: list[tuple[str, str]],
     *,
@@ -493,6 +537,8 @@ def build_router(
                 })
             elif path == "/debug/requests":
                 self._merged_debug_requests(query)
+            elif path == "/debug/timeline":
+                self._merged_timeline(query)
             elif path == "/debug/trace":
                 self._find_trace(query)
             elif path == "/v1/models":
@@ -531,11 +577,77 @@ def build_router(
             self.end_headers()
             self.wfile.write(data)
 
+        def _merged_timeline(self, query: str) -> None:
+            """The fleet's engine timelines in one response: each
+            replica's /debug/timeline (same query string) keyed by
+            replica id. A wedged replica degrades to an error entry,
+            never a stalled endpoint (same contract as the metrics
+            aggregation)."""
+            per: dict[str, Any] = {}
+            for rid, info in sorted(router.snapshot().items()):
+                r = router.replicas[rid]
+                try:
+                    status, body = self._replica_get(
+                        r,
+                        "/debug/timeline" + (f"?{query}" if query else ""),
+                    )
+                    if status != 200:
+                        raise OSError(f"/debug/timeline -> {status}")
+                    per[rid] = json.loads(body)
+                except (OSError, ValueError) as e:
+                    per[rid] = {"error": str(e)}
+            self._json(200, {"engine": "router", "replicas": per})
+
         def _merged_debug_requests(self, query: str) -> None:
             """One flight-recorder view of the fleet: each replica's
             /debug/requests (same query string) merged, per-replica
-            totals preserved, ?limit= re-applied to the merge."""
+            totals preserved, ?limit= re-applied to the merge.
+            ?format=jsonl concatenates the replicas' wide-event logs
+            (each event already carries its replica identity)."""
             q = urllib.parse.parse_qs(query)
+            if (q.get("format") or [""])[0] == "jsonl":
+                try:
+                    limit = int((q.get("limit") or ["0"])[0])
+                    if limit < 0:
+                        raise ValueError
+                except ValueError:
+                    self._json(400, {
+                        "error": "limit must be a non-negative integer",
+                    })
+                    return
+                lines: list[str] = []
+                for rid, info in sorted(router.snapshot().items()):
+                    r = router.replicas[rid]
+                    try:
+                        status, body = self._replica_get(
+                            r, f"/debug/requests?{query}"
+                        )
+                        if status == 200:
+                            lines += [
+                                ln for ln in body.decode().splitlines()
+                                if ln
+                            ]
+                    except OSError:
+                        pass  # scrape failed: skip replica
+                # ?limit= bounds the MERGE, like the JSON path below:
+                # interleave by event time first, so the newest N of
+                # the fleet survive — not N per replica.
+                def ev_ts(ln: str) -> float:
+                    try:
+                        return float(json.loads(ln).get("ts_unix_s") or 0)
+                    except ValueError:
+                        return 0.0
+
+                lines.sort(key=ev_ts)
+                if limit:
+                    lines = lines[-limit:]
+                data = ("\n".join(lines) + ("\n" if lines else "")).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             try:
                 limit = int((q.get("limit") or ["0"])[0])
                 if limit < 0:
@@ -599,30 +711,92 @@ def build_router(
             })
 
         def _find_trace(self, query: str) -> None:
+            """ONE merged Perfetto-loadable trace for ?id=: the
+            router's own spans (route_decide, upstream_connect,
+            upstream_ttfb, retries, ejects) on track 0 and the owning
+            replica's spans — queue_wait, prefill (eviction/restart
+            replays included), decode_chunk, emission — on track 1,
+            re-anchored onto the router's clock, so a routed (and even
+            a replayed) request reads as one story. Falls back to the
+            replica's own trace when the router never saw the id (it
+            predates this router process, or the recorder rolled)."""
             q = urllib.parse.parse_qs(query)
             rid_param = (q.get("id") or [""])[0]
             if not rid_param:
                 self._json(400, {"error": "missing ?id=<request id>"})
                 return
-            for rid, info in sorted(router.snapshot().items()):
-                r = router.replicas[rid]
+            own = router.tracer.get(rid_param)
+            # Locate the replica-side trace: the owner recorded on the
+            # router trace first, then the rest of the fleet (the id
+            # may predate this router's recorder window).
+            candidates = []
+            if own is not None:
+                owner = own.summary()["meta"].get("replica")
+                if owner in router.replicas:
+                    candidates.append(owner)
+            candidates += [
+                rid for rid in sorted(router.replicas)
+                if rid not in candidates
+            ]
+            rep_json = rep_rid = None
+            for rid in candidates:
                 try:
                     status, body = self._replica_get(
-                        r, f"/debug/trace?{query}"
+                        router.replicas[rid], f"/debug/trace?{query}"
                     )
                 except OSError:
                     continue
                 if status == 200:
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.send_header("X-Oryx-Router-Replica", rid)
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-            self._json(404, {
-                "error": f"no replica holds a trace for id {rid_param!r}"
-            })
+                    try:
+                        rep_json = json.loads(body)
+                    except ValueError:
+                        continue
+                    rep_rid = rid
+                    break
+            if own is None and rep_json is None:
+                self._json(404, {
+                    "error": "neither the router nor any replica "
+                    f"holds a trace for id {rid_param!r}"
+                })
+                return
+            if own is None:
+                # Replica-only view (pre-router id): forward verbatim.
+                rep_json["merged"] = False
+                self._json(200, rep_json, extra_headers={
+                    "X-Oryx-Router-Replica": rep_rid,
+                })
+                return
+            events = own.chrome_events(tid=0)
+            merged: dict[str, Any] = {
+                "traceEvents": events,
+                "displayTimeUnit": "ms",
+                "request": own.summary(),
+                "merged": False,
+            }
+            if rep_json is not None:
+                offset_us = _merge_clock_offset_us(
+                    own.summary()["meta"], rep_json.get("request") or {}
+                )
+                for ev in rep_json.get("traceEvents", []):
+                    ev = dict(ev)
+                    ev["tid"] = 1
+                    if ev.get("ph") == "M":
+                        name = (ev.get("args") or {}).get("name", "")
+                        ev["args"] = {
+                            "name": f"replica {rep_rid} {name}".strip()
+                        }
+                    elif "ts" in ev:
+                        ev["ts"] = ev["ts"] + offset_us
+                    events.append(ev)
+                merged["merged"] = True
+                merged["replica"] = rep_rid
+                merged["clock_offset_us"] = offset_us
+                merged["replica_request"] = rep_json.get("request")
+            # The PR 9 header contract survives the merge: consumers
+            # keyed on X-Oryx-Router-Replica keep working.
+            self._json(200, merged, extra_headers=(
+                {"X-Oryx-Router-Replica": rep_rid} if rep_rid else None
+            ))
 
         def _proxy_get_first(self, path: str) -> None:
             for rid in router.healthy_ids():
@@ -667,6 +841,18 @@ def build_router(
             tokens = prefix_fingerprint(
                 [m for m in messages if isinstance(m, dict)]
             )
+            # Distributed tracing: one router-side trace per proxied
+            # request. A sanitized client X-Request-Id is honored as
+            # the trace id — the SAME id the chosen replica will adopt
+            # (propagated via X-Oryx-Trace), so /debug/trace?id= can
+            # merge the two sides into one story. Colliding or unsafe
+            # ids fall back to minting.
+            rid_pref = trace_lib.sanitize_request_id(
+                self.headers.get("X-Request-Id")
+            )
+            tr = router.tracer.start_trace(
+                "router", label="chat", id=rid_pref,  # minted on collision
+            )
             # One attempt per distinct healthy replica, delays from the
             # shared deterministic backoff schedule. 503s and transport
             # errors rotate; anything else — success, 400, 429, 504 —
@@ -677,27 +863,35 @@ def build_router(
             for delay in delays:
                 if delay:
                     time.sleep(delay)
-                replica, hit = router.route(tokens, exclude=tried)
+                with tr.span("route_decide", attempt=retries):
+                    replica, hit = router.route(tokens, exclude=tried)
                 if replica is None:
                     break
-                outcome = self._try_upstream(replica, body, retries)
+                outcome = self._try_upstream(replica, body, retries, tr)
                 if outcome is None:
+                    tr.finish(
+                        replica=replica.rid, retries=retries,
+                        affinity_hit=hit,
+                    )
                     return  # response (or client hangup) fully handled
                 tried.add(replica.rid)
                 retries += 1
+                tr.event("retry", replica=replica.rid, reason=outcome)
                 router.registry.counter(
                     "retried_total", ("replica",)
                 ).labels(replica=replica.rid).inc()
                 _LOG.info(
                     "retrying off replica %s (%s)", replica.rid, outcome
                 )
+            tr.finish(error="no_healthy_replica", retries=retries)
             self._router_error(
                 503, "no_healthy_replica", retries,
                 retry_after=router.retry_policy.base_s * 10,
             )
 
         def _try_upstream(self, replica: Replica, body: bytes,
-                          retries: int) -> str | None:
+                          retries: int,
+                          tr: trace_lib.Trace) -> str | None:
             """Proxy one attempt to `replica`. Returns None when the
             client got an answer (including a forwarded error or a
             mid-stream hangup), or a reason string meaning "rotate to
@@ -708,17 +902,45 @@ def build_router(
                 replica.host, replica.port, timeout=upstream_timeout
             )
             t0 = time.monotonic()
+            uc = tr.begin("upstream_connect", replica=replica.rid)
+            ttfb_h = -1
             try:
                 try:
+                    # Clock anchor for the merged trace: the replica's
+                    # spans re-anchor onto this send timestamp when the
+                    # two processes' clocks visibly disagree.
+                    tr.annotate(
+                        replica=replica.rid,
+                        upstream_sent_ns=trace_lib.now_ns(),
+                    )
                     conn.request(
                         "POST", "/v1/chat/completions", body=body,
-                        headers={"Content-Type": "application/json"},
+                        headers={
+                            "Content-Type": "application/json",
+                            # Trace context, router -> replica: the
+                            # replica adopts this request id as its own
+                            # trace id and records the parent span, so
+                            # the fleet shares ONE id per request.
+                            "X-Oryx-Trace": f"{tr.id};{uc}",
+                        },
+                    )
+                    tr.end(uc)
+                    ttfb_h = tr.begin(
+                        "upstream_ttfb", replica=replica.rid
                     )
                     resp = conn.getresponse()
+                    tr.end(ttfb_h)
                 except OSError as e:
+                    tr.end(uc)
+                    if ttfb_h >= 0:
+                        tr.end(ttfb_h)
                     # Transport failure before a single response byte:
                     # eject now (the prober would take a poll interval
                     # to notice a dead process) and rotate.
+                    tr.event(
+                        "eject", replica=replica.rid,
+                        reason=f"connect failed: {e}",
+                    )
                     router.set_health(
                         replica.rid, False, f"connect failed: {e}"
                     )
@@ -732,10 +954,15 @@ def build_router(
                     # take it out of rotation immediately and retry
                     # the request elsewhere.
                     resp.read()
+                    tr.event(
+                        "eject", replica=replica.rid,
+                        reason="upstream 503",
+                    )
                     router.set_health(
                         replica.rid, False, "upstream 503"
                     )
                     return "upstream 503"
+                tr.annotate(status=resp.status)
                 # Counted only once a response is actually FORWARDED
                 # from this replica (failed attempts show in
                 # retried_total instead), so requests_total is a true
@@ -744,7 +971,8 @@ def build_router(
                     "requests_total", ("replica",)
                 ).labels(replica=replica.rid).inc()
                 try:
-                    self._forward(resp, replica, retries)
+                    with tr.span("proxy_stream", replica=replica.rid):
+                        self._forward(resp, replica, retries)
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     # CLIENT hung up mid-stream: closing the upstream
                     # connection (finally) propagates the cancel to

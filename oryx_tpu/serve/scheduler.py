@@ -137,8 +137,10 @@ from oryx_tpu.ops.packing import round_up_bucket
 from oryx_tpu.serve import pipeline as pipeline_lib
 from oryx_tpu.serve.prefix_cache import PagedPrefixCache
 from oryx_tpu.utils import faults
+from oryx_tpu.utils import request_log as request_log_lib
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyMonitor
+from oryx_tpu.utils.timeline import StepTimeline
 from oryx_tpu.utils.metrics import (
     DISPATCH_ROWS_BUCKETS,
     PAGE_SECONDS_BUCKETS,
@@ -254,6 +256,14 @@ class _Request:
     processed: int = 0  # tokens consumed from the device stream
     replay: int = 0  # tokens to skip after an eviction re-admission
     admit_seq: int = -1  # admission order (eviction picks the youngest)
+    # Replay re-admissions this request paid (eviction + supervisor
+    # restart), surfaced in its wide event — the per-request spelling
+    # of the fleet's eviction pressure.
+    evictions: int = 0  # thread-owned: engine
+    # The request arrived through the front-end router (X-Oryx-Trace
+    # present): stamped into the wide event so fleet traffic can be
+    # split routed-vs-direct offline.
+    routed: bool = False
     # Cost ledger (docs/OBSERVABILITY.md "Capacity & load testing"):
     # per-request resource attribution, accumulated ACROSS placements
     # (an evicted request's replay re-pays prefill — that cost was
@@ -318,6 +328,10 @@ class ContinuousScheduler:
         ragged: bool = False,
         speculate: int = 0,
         drafter=None,
+        timeline: StepTimeline | None = None,
+        request_log: request_log_lib.RequestLog | None = None,
+        engine_label: str = "continuous",
+        replica_id: str | None = None,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -550,6 +564,20 @@ class ContinuousScheduler:
         # stall_timeout while slots are live dumps every thread stack +
         # the recorder tail to stderr, once per stall.
         self.tracer = tracer or trace_lib.Tracer()
+        # Step timeline (utils/timeline.py): one fixed-shape record per
+        # device dispatch, written lock-free from the engine thread and
+        # served at GET /debug/timeline — the engine's flight data
+        # recorder. Always on: a disabled recorder during the incident
+        # it exists for would be the wrong default, and the disarmed
+        # cost is one dict build per dispatch.
+        self.timeline = timeline or StepTimeline()
+        # Wide-event request log (utils/request_log.py): one canonical
+        # JSONL event per terminal request, merging the cost ledger,
+        # span wall-times, outcome and routing identity. engine_label/
+        # replica_id are this engine's identity fields in those events.
+        self.request_log = request_log or request_log_lib.RequestLog()
+        self.engine_label = engine_label
+        self.replica_id = replica_id
         self.watchdog: trace_lib.StallWatchdog | None = None
         if stall_timeout is not None:
             self.watchdog = trace_lib.StallWatchdog(
@@ -638,11 +666,21 @@ class ContinuousScheduler:
         *,
         streaming: bool = False,
         timeout_s: float | None = None,
+        request_id: str | None = None,
+        routed: bool = False,
     ) -> RequestHandle:
         """Queue one request; raises AdmissionRejected (without
         queueing anything) when draining, shedding load (degraded mode
         3), or the bounded queue is full. timeout_s overrides the
-        scheduler-wide request_timeout deadline for this request."""
+        scheduler-wide request_timeout deadline for this request.
+
+        request_id: a client-supplied X-Request-Id to honor as the
+        trace id (already sanitized by the HTTP layer); the tracer
+        atomically replaces it with a minted id when it collides with
+        a trace the flight recorder still holds — an id must name ONE
+        request.
+        routed: the request came through the front-end router (stamped
+        into the wide event)."""
         sampling = sampling or {}
         h = RequestHandle()
         h.streaming = streaming
@@ -650,7 +688,7 @@ class ContinuousScheduler:
             [self.pipe.conv.stop_str] if self.pipe.conv.stop_str else []
         ) + [s for s in (sampling.get("stop") or []) if s]
         tr = self.tracer.start_trace(
-            "request", label=f"chat max_new={max_new}"
+            "request", label=f"chat max_new={max_new}", id=request_id,
         )
         h.request_id = tr.id
         h.trace = tr
@@ -663,6 +701,7 @@ class ContinuousScheduler:
             request=request, max_new=max_new, sampling=sampling,
             handle=h, submit_time=now, stops=stops, trace=tr,
             deadline=(now + eff_timeout) if eff_timeout else None,
+            routed=routed,
         )
         req.qw_span = tr.begin("queue_wait")
         with self._cond:
@@ -710,6 +749,9 @@ class ContinuousScheduler:
             )
             cost = self._finalize_cost(None, req, observe=False)
             tr.finish(error=msg, rejected=reason, cost=cost)
+            self._emit_request_event(
+                req, status="rejected", error_kind=reason
+            )
             _LOG.info("request %s rejected (%s)", tr.id, reason)
             raise AdmissionRejected(
                 msg, reason=reason, retry_after_s=retry_after
@@ -830,6 +872,7 @@ class ContinuousScheduler:
             # ledger doesn't lose the pre-crash residency.
             self._accrue_page_seconds(s)
             req.replay = req.processed
+            req.evictions += 1
             req.activated = False
             req.spliced = 0
             req.prefill_pos = 0
@@ -968,6 +1011,43 @@ class ContinuousScheduler:
         m.observe("request_decode_seconds", cost["decode_s"])
         m.observe("request_e2e_seconds", cost["e2e_s"])
         return cost
+
+    def _emit_request_event(self, req: _Request, *, status: str,
+                            error_kind: str | None = None) -> None:
+        """Append the request's wide event (utils/request_log.py) —
+        called on EVERY terminal path, right after the trace closes, so
+        the event merges the finalized cost ledger, the span-derived
+        wall times already inside it, the outcome, and this engine's
+        identity. One request, one line — the offline twin of the
+        oryx_serving_request_* histograms."""
+        h = req.handle
+        cost = h.debug.get("cost") or {}
+        aps = None
+        if self.speculate and cost.get("decode_steps"):
+            # decode_steps bills 1+k verify lanes per spec dispatch, so
+            # steps/(1+k) recovers the dispatch count and tokens-per-
+            # dispatch is the per-request speculation yield.
+            dispatches = cost["decode_steps"] / (1 + self.speculate)
+            if dispatches:
+                aps = round(
+                    cost.get("decode_tokens", 0) / dispatches, 4
+                )
+        usage = h.usage or (req.length, len(req.emitted))
+        self.request_log.append(request_log_lib.build_request_event(
+            request_id=req.trace.id,
+            engine=self.engine_label,
+            replica=self.replica_id,
+            routed=req.routed,
+            status=status,
+            error_kind=error_kind,
+            finish_reason=h.finish_reason if status == "ok" else None,
+            prompt_tokens=usage[0],
+            completion_tokens=usage[1],
+            streaming=h.streaming,
+            evictions=req.evictions,
+            accepted_tokens_per_step=aps,
+            **cost,
+        ))
 
     def _free_slot_pages(self, s: int) -> None:
         pages = [int(p) for p in self.bt[s] if p != self._sentinel]
@@ -1113,6 +1193,9 @@ class ContinuousScheduler:
                         r.handle.done.set()
                         if r.trace is not None:
                             r.trace.finish(error=msg, cost=cost)
+                        self._emit_request_event(
+                            r, status="error", error_kind="server_error"
+                        )
                     # Every pop refreshes the gauge (same invariant as
                     # the cancel path): after the drain /metrics must
                     # say empty, and the drain-side observation lets a
@@ -1140,6 +1223,7 @@ class ContinuousScheduler:
         req.handle.events.put(("error", msg))
         req.handle.done.set()
         req.trace.finish(error=msg, cost=cost)
+        self._emit_request_event(req, status="error", error_kind=kind)
         _LOG.info("request %s dropped: %s", req.trace.id, msg)
 
     def _enforce_deadlines(self) -> None:
@@ -1268,6 +1352,7 @@ class ContinuousScheduler:
                 # too.
                 cost = self._finalize_cost(None, req)
                 req.trace.finish(cancelled=True, cost=cost)
+                self._emit_request_event(req, status="cancelled")
                 _LOG.info("request %s cancelled in queue", req.trace.id)
                 continue
             if req.embeds is None:
@@ -1351,6 +1436,10 @@ class ContinuousScheduler:
                     req.handle.events.put(("error", msg))
                     req.handle.done.set()
                     req.trace.finish(error=msg, cost=cost)
+                    self._emit_request_event(
+                        req, status="error",
+                        error_kind=req.handle.error_kind,
+                    )
                     _LOG.info(
                         "request %s rejected at admission: %s",
                         req.trace.id, msg,
@@ -1524,6 +1613,7 @@ class ContinuousScheduler:
                 cost = self._finalize_cost(s, req)
                 self._clear_slot(s)
                 req.trace.finish(cancelled=True, cost=cost)
+                self._emit_request_event(req, status="cancelled")
                 _LOG.info(
                     "request %s cancelled mid-prefill", req.trace.id
                 )
@@ -1564,6 +1654,7 @@ class ContinuousScheduler:
             "prefill", slot=s, start=off, tokens=end - off,
             cached=req.spliced > 0, replay=req.replay > 0,
         )
+        t0 = time.monotonic()
         with self.pipe._mesh_scope():
             kv, tok0, key = generate_lib.paged_prefill(
                 self.pipe.params["llm"], self.cfg.llm,
@@ -1593,6 +1684,13 @@ class ContinuousScheduler:
         )
         self.metrics.observe(
             "dispatch_rows", end - off, buckets=DISPATCH_ROWS_BUCKETS
+        )
+        # Split-path prefill dispatches are engine steps too: record
+        # them so timeline dispatch-kind counts reconcile with
+        # oryx_serving_dispatches_total on every engine mode.
+        self._timeline_record(
+            dur_s=time.monotonic() - t0, kind="prefill",
+            rows=end - off, accepted=0,
         )
         if self.watchdog is not None:
             # A completed prefill chunk is progress too — without this,
@@ -1708,6 +1806,7 @@ class ContinuousScheduler:
         and `processed` tokens are skipped on re-admission."""
         req = self.slots[s]
         req.replay = req.processed
+        req.evictions += 1
         req.activated = False
         req.spliced = 0
         req.prefill_pos = 0
@@ -1847,6 +1946,28 @@ class ContinuousScheduler:
             self.metrics.inc("decode_steps_total", total)
             self.metrics.inc("decode_steps_useful", useful)
             self.metrics.inc("decode_steps_wasted", total - useful)
+        self._timeline_record(
+            dur_s=dt, kind=kind, rows=rows,
+            accepted=emitted if n_new is not None else useful,
+        )
+
+    def _timeline_record(self, *, dur_s: float, kind: str, rows: int,
+                         accepted: int) -> None:
+        """One step record into the engine flight data recorder
+        (utils/timeline.py). Engine thread only; the queue-depth and
+        degraded-mode reads go through the metrics registry's own
+        gauges, so the hot path never takes the scheduler lock for a
+        telemetry sample."""
+        self.timeline.record(
+            dur_s=dur_s, kind=kind, rows=rows,
+            live_slots=sum(
+                1 for r in self.slots if r is not None and r.activated
+            ),
+            accepted_tokens=accepted,
+            queue_depth=int(self.metrics.get("queue_depth")),
+            free_pages=self.allocator.num_free,
+            degraded_mode=int(self.metrics.get("degraded_mode")),
+        )
 
     # hot-path
     def _harvest_chunk(self, tok, lengths, finished, recent, toks, fin):
@@ -1904,6 +2025,7 @@ class ContinuousScheduler:
                 cost = self._finalize_cost(s, req)
                 self._clear_slot(s)
                 req.trace.finish(cancelled=True, cost=cost)
+                self._emit_request_event(req, status="cancelled")
                 _LOG.info(
                     "request %s cancelled mid-prefill", req.trace.id
                 )
@@ -2161,6 +2283,7 @@ class ContinuousScheduler:
             cost = self._finalize_cost(s, req)
             self._clear_slot(s)
             req.trace.finish(cancelled=True, cost=cost)
+            self._emit_request_event(req, status="cancelled")
             _LOG.info("request %s cancelled mid-decode", req.trace.id)
             return useful
         chunk_start = len(req.emitted)
@@ -2264,6 +2387,7 @@ class ContinuousScheduler:
             finish_reason=reason, prompt_tokens=req.length,
             completion_tokens=completion, cost=cost,
         )
+        self._emit_request_event(req, status="ok")
         _LOG.info(
             "request %s finished (%s, %d tokens)",
             req.trace.id, reason, completion,
@@ -2281,4 +2405,5 @@ class ContinuousScheduler:
         req.handle.events.put(("error", msg))
         req.handle.done.set()
         req.trace.finish(error=msg, cost=cost)
+        self._emit_request_event(req, status="error", error_kind=kind)
         _LOG.info("request %s errored: %s", req.trace.id, msg)

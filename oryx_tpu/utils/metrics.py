@@ -471,6 +471,33 @@ REQUEST_COST_KEYS = (
     "page_seconds", "queue_s", "prefill_s", "decode_s", "e2e_s",
 )
 
+# The canonical wide-event schema: every field a terminal request's
+# JSONL event (utils/request_log.py, /debug/requests?format=jsonl) may
+# carry. A strict SUPERSET of REQUEST_COST_KEYS — the event embeds the
+# whole cost ledger — plus identity/outcome/routing/speculation fields.
+# Declared HERE (next to the cost keys and the histogram ladders) so
+# the JSONL schema, the /debug surfaces and the oryx_serving_request_*
+# histograms share one source of truth; oryxlint's metric-name rule
+# checks literal event fields against this tuple, and
+# request_log.build_request_event rejects undeclared keys at runtime,
+# so the schema cannot drift silently from the metrics.
+REQUEST_EVENT_KEYS = REQUEST_COST_KEYS + (
+    "schema",                    # event-schema version (int)
+    "ts_unix_s",                 # wall-clock time the request ended
+    "request_id",                # == X-Request-Id / the trace id
+    "engine",                    # continuous | sharded | ...
+    "replica",                   # --replica-id, null standalone
+    "routed",                    # request arrived via the router
+    "status",                    # ok | error | cancelled | rejected
+    "error_kind",                # handle.error_kind, null on ok
+    "finish_reason",             # stop | length, null unless ok
+    "prompt_tokens",
+    "completion_tokens",
+    "streaming",
+    "evictions",                 # replay re-admissions this request paid
+    "accepted_tokens_per_step",  # speculation yield, null off spec
+)
+
 
 # ---------------------------------------------------------------------------
 # Quantile helpers (shared by the loadgen report, the serving-endpoint

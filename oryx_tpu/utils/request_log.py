@@ -1,0 +1,150 @@
+"""Wide-event request log: one canonical JSONL event per terminal
+request.
+
+The serving stack already records what a request cost (the PR 7 cost
+ledger), why it was slow (trace spans), where it ran (replica build
+info) and how speculation paid off (accepted-tokens accounting) — but
+in four different places with four different lifetimes. This module
+merges them into ONE wide event at the moment a request reaches any
+terminal state (finish / error / cancel / reject), in the
+wide-event-logging shape: a flat JSON object per line, every field
+drawn from a declared registry (`utils.metrics.REQUEST_EVENT_KEYS`, a
+superset of `REQUEST_COST_KEYS`), so offline analysis can slice the
+whole fleet's traffic by any dimension without joining debug surfaces.
+
+Two sinks, same events:
+
+  * a bounded in-memory ring, exported at
+    ``GET /debug/requests?format=jsonl`` (replica and router — the
+    router merges its replicas');
+  * optionally a size-capped ``requests.jsonl`` file
+    (``--requests-log``), rolling to ``<path>.1`` past ``max_bytes``
+    exactly like the anomaly events sink (utils/anomaly.py): rotate
+    AFTER the crossing write so the live file is never a torn JSONL,
+    one generation of history kept, disk usage <= ~2x the cap.
+
+Schema discipline is enforced twice: ``build_request_event`` rejects
+undeclared or non-snake_case keys at runtime, and oryxlint's
+`metric-name` rule checks the literal keyword fields of every
+``build_request_event(...)`` call site against the registry at review
+time — the JSONL schema cannot drift silently from the histograms.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from collections import deque
+from typing import Any
+
+from oryx_tpu.analysis.sanitizers import named_lock
+from oryx_tpu.utils.metrics import REQUEST_EVENT_KEYS
+
+# The current wide-event schema version, stamped into every event so
+# offline consumers can dispatch on it when fields are added.
+EVENT_SCHEMA = 1
+
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_KEYSET = frozenset(REQUEST_EVENT_KEYS)
+
+
+def build_request_event(**fields: Any) -> dict[str, Any]:
+    """Assemble one wide event from keyword fields, validating every
+    key against the declared registry. `schema` and `ts_unix_s` are
+    filled when absent. Raises ValueError on an undeclared or
+    non-snake_case key — schema drift fails loudly at the write site,
+    never silently in a consumer."""
+    bad = sorted(
+        k for k in fields
+        if k not in _KEYSET or not _SNAKE_RE.match(k)
+    )
+    if bad:
+        raise ValueError(
+            f"undeclared request-event field(s) {bad}: add them to "
+            "utils.metrics.REQUEST_EVENT_KEYS (the wide-event schema "
+            "registry) or fix the name"
+        )
+    ev: dict[str, Any] = {"schema": EVENT_SCHEMA, "ts_unix_s": time.time()}
+    ev.update(fields)
+    return ev
+
+
+class RequestLog:
+    """Bounded ring + optional rotating JSONL file of wide events.
+
+    ``append`` is called from the engine thread's terminal paths (and
+    from submit() on rejection); readers are debug-endpoint handler
+    threads. All shared state sits under one leaf lock
+    (`request_log._lock` in the declared order) held only for the ring
+    edit and the file write — never across anything that blocks."""
+
+    def __init__(self, path: str | None = None, *, keep: int = 512,
+                 max_bytes: int = 16 * 1024 * 1024):
+        self.path = os.path.abspath(path) if path else None
+        self.max_bytes = max_bytes
+        self._lock = named_lock("request_log._lock")
+        self._ring: deque[dict[str, Any]] = deque(  # guarded-by: _lock
+            maxlen=max(1, keep)
+        )
+        self._total = 0  # guarded-by: _lock
+        self._f = None  # guarded-by: _lock
+        if self.path:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+
+    def append(self, event: dict[str, Any]) -> None:
+        """Record one event (normally built by build_request_event;
+        re-validated here so a hand-rolled dict can't bypass the
+        registry)."""
+        bad = sorted(k for k in event if k not in _KEYSET)
+        if bad:
+            raise ValueError(
+                f"undeclared request-event field(s) {bad} "
+                "(utils.metrics.REQUEST_EVENT_KEYS is the schema)"
+            )
+        line = json.dumps(event)
+        with self._lock:
+            self._ring.append(event)
+            self._total += 1
+            if self._f is not None:
+                self._f.write(line + "\n")
+                self._f.flush()
+                if self.max_bytes and self._f.tell() >= self.max_bytes:
+                    # Rotate AFTER the crossing write (the anomaly-sink
+                    # contract): the live file is always complete
+                    # JSONL, and the crossing event lands in `.1` with
+                    # its episode-mates.
+                    self._f.close()
+                    os.replace(self.path, self.path + ".1")
+                    self._f = open(self.path, "a")
+
+    # ---- readers ---------------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def snapshot(self, n: int | None = None) -> list[dict[str, Any]]:
+        """Oldest-first copies of the retained events (last `n` when
+        given) — log order, the same order the file carries."""
+        with self._lock:
+            events = list(self._ring)
+        if n is not None:
+            events = events[-max(0, int(n)):]
+        return [dict(e) for e in events]
+
+    def export_jsonl(self, n: int | None = None) -> str:
+        """The ring as JSONL text (the ?format=jsonl body)."""
+        lines = [json.dumps(e) for e in self.snapshot(n)]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

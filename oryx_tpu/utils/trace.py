@@ -38,6 +38,7 @@ import contextlib
 import contextvars
 import io
 import json
+import re
 import sys
 import threading
 import time
@@ -64,6 +65,23 @@ def now_ns() -> int:
 
 def new_request_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# Client-supplied request ids (X-Request-Id) are honored end-to-end —
+# but they land in log lines, file names adjacent surfaces and debug
+# URLs, so they are validated, never trusted: short, printable,
+# URL/label-safe. Anything else falls back to a minted id.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def sanitize_request_id(raw: str | None) -> str | None:
+    """The client-supplied id when it is safe to honor, else None
+    (caller mints). Strips surrounding whitespace; 1-64 chars of
+    [A-Za-z0-9._-] starting alphanumeric."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    return raw if _REQUEST_ID_RE.match(raw) else None
 
 
 class Span:
@@ -167,6 +185,15 @@ class Trace:
         """Instant (zero-duration) marker, e.g. an eviction."""
         self.add_complete(name, now_ns(), 0, **args)
 
+    def annotate(self, **meta) -> None:
+        """Merge metadata into the trace without closing it (finish()
+        also merges; this is for annotations known mid-flight, e.g.
+        the router parent-span id a routed request carries). Under the
+        lock like every other meta writer, so a concurrent summary()
+        never reads a half-updated dict."""
+        with self._lock:
+            self.meta.update(meta)
+
     def finish(self, **meta) -> None:
         """Close the trace: any still-open spans end now."""
         with self._lock:
@@ -262,8 +289,15 @@ class Tracer:
 
     def start_trace(self, kind: str, label: str = "",
                     id: str | None = None) -> Trace:
-        tr = Trace(kind, label, id=id)
+        """New registered trace. A caller-supplied `id` (an honored
+        client X-Request-Id) is dropped in favor of a minted one when
+        the recorder still holds that id — checked and registered
+        under ONE lock hold, so two concurrent requests carrying the
+        same id can never both claim it (an id names one trace)."""
         with self._lock:
+            if id is not None and id in self._by_id:
+                id = None  # collision: mint instead
+            tr = Trace(kind, label, id=id)
             if len(self._traces) == self.capacity:
                 evicted = self._traces[0]
                 self._by_id.pop(evicted.id, None)

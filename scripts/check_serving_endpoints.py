@@ -120,13 +120,17 @@ SYSMSG = ("You are a careful assistant. Study the context and "
           "answer briefly. " * 2)
 
 
-def _completion(base: str, messages, max_tokens: int = 4) -> str:
+def _completion(base: str, messages, max_tokens: int = 4,
+                request_id: str | None = None) -> str:
+    headers = {"Content-Type": "application/json"}
+    if request_id:
+        headers["X-Request-Id"] = request_id
     req = urllib.request.Request(
         base + "/v1/chat/completions",
         data=json.dumps({
             "messages": messages, "max_tokens": max_tokens,
         }).encode(),
-        headers={"Content-Type": "application/json"},
+        headers=headers,
     )
     with urllib.request.urlopen(req, timeout=300) as r:
         rid = r.headers.get("X-Request-Id")
@@ -177,11 +181,16 @@ def run_checks(base: str) -> str:
             fail(f"/readyz on a live target: want 200/true, "
                  f"got {r.status} {ready}")
 
+    # Client-supplied request ids are honored END-TO-END (through the
+    # router too): the response must echo the id, and it keys the
+    # trace lookups below.
     rid = _completion(
-        base, [{"role": "user", "content": "hello there"}]
+        base, [{"role": "user", "content": "hello there"}],
+        request_id="endpoint-check-1",
     )
-    if not rid:
-        fail("completion response missing X-Request-Id header")
+    if rid != "endpoint-check-1":
+        fail("client-supplied X-Request-Id was not honored "
+             f"(sent endpoint-check-1, got {rid!r})")
 
     # Prefix/build_info checks run against the BOOT-time scrape (those
     # families exist before any traffic); the latency-histogram check
@@ -233,10 +242,24 @@ def run_checks(base: str) -> str:
     with _get(base, f"/debug/trace?id={rid}") as r:
         tracejs = json.load(r)
     names = {e.get("name") for e in tracejs.get("traceEvents", [])}
-    for want in ("queue_wait", "prefill", "decode_chunk"):
+    wanted = ["queue_wait", "prefill", "decode_chunk"]
+    if kind == "router":
+        # The acceptance bar for fleet tracing: ONE merged trace with
+        # router spans AND the owning replica's engine spans, loadable
+        # as Chrome trace JSON.
+        wanted += ["route_decide", "upstream_ttfb"]
+        if tracejs.get("merged") is not True:
+            fail("/debug/trace through the router is not a merged "
+                 f"trace (merged={tracejs.get('merged')!r})")
+    for want in wanted:
         if want not in names:
             fail(f"/debug/trace missing span {want!r} (got "
                  f"{sorted(names)})")
+    for ev in tracejs.get("traceEvents", []):
+        if ev.get("ph") == "X" and not all(
+            k in ev for k in ("name", "ts", "dur", "pid", "tid")
+        ):
+            fail(f"/debug/trace event not Chrome-trace shaped: {ev}")
 
     # Shared-prefix burst: several requests with one long system
     # prompt must light up the prefix-cache metric family (and, on a
@@ -344,6 +367,67 @@ def run_checks(base: str) -> str:
         if e.code != 400:
             fail(f"/debug/requests?state=bogus -> {e.code}, want 400")
         e.close()
+
+    # Wide-event export: one JSONL line per terminal request, every
+    # field drawn from the declared schema registry.
+    from oryx_tpu.utils.metrics import REQUEST_EVENT_KEYS
+
+    with _get(base, "/debug/requests?format=jsonl") as r:
+        if r.headers.get("Content-Type") != "application/x-ndjson":
+            fail("?format=jsonl content type is "
+                 f"{r.headers.get('Content-Type')!r}")
+        lines = [ln for ln in r.read().decode().splitlines() if ln]
+    if len(lines) < 4:
+        fail(f"?format=jsonl returned {len(lines)} events, want >= 4 "
+             "(the burst reached terminal states)")
+    seen_ids = set()
+    for ln in lines:
+        try:
+            ev = json.loads(ln)
+        except ValueError:
+            fail(f"?format=jsonl line is not JSON: {ln[:80]!r}")
+        extra = set(ev) - set(REQUEST_EVENT_KEYS)
+        if extra:
+            fail(f"wide event carries undeclared fields {sorted(extra)}")
+        if not ev.get("request_id") or "status" not in ev:
+            fail(f"wide event missing identity/outcome: {ev}")
+        seen_ids.add(ev["request_id"])
+    if rid not in seen_ids:
+        fail(f"wide-event log does not contain request {rid}")
+
+    # Step timeline: per-step records, and (replica) dispatch-kind
+    # counts that reconcile EXACTLY with the dispatches_total counters
+    # — both cumulative since boot, scraped with the engine quiesced.
+    with _get(base, "/debug/timeline?n=16") as r:
+        tl = json.load(r)
+    if kind == "replica":
+        if not tl.get("records"):
+            fail("/debug/timeline returned no records after the burst")
+        counts = tl.get("counts_by_kind") or {}
+        if tl.get("total_steps") != sum(counts.values()):
+            fail(f"timeline total_steps {tl.get('total_steps')} != "
+                 f"sum of counts_by_kind {counts}")
+        with _get(base, "/metrics") as r:
+            mtext = r.read().decode()
+        for k, v in counts.items():
+            m = re.search(
+                rf'^oryx_serving_dispatches_total\{{kind="{k}"\}} '
+                rf"([0-9.e+-]+)$", mtext, re.M,
+            )
+            if not m or float(m.group(1)) != v:
+                fail(f"timeline kind {k!r}={v} does not reconcile "
+                     "with oryx_serving_dispatches_total "
+                     f"({m.group(1) if m else 'absent'})")
+    else:
+        reps = tl.get("replicas") or {}
+        if not reps:
+            fail("router /debug/timeline returned no replicas")
+        served = [
+            r for r in reps.values()
+            if isinstance(r.get("records"), list) and r["records"]
+        ]
+        if not served:
+            fail(f"no replica timeline carries records: {tl}")
     return kind
 
 
@@ -439,9 +523,13 @@ def main() -> None:
           "/metrics (content-type, prefix, build_info"
           + (", aggregate replica labels" if kind == "router"
              else ", hbm gauges")
-          + ") + /debug/requests (+ limit/state filters, cost ledger) "
-          "+ /debug/trace + prefix-cache family under a shared-prefix "
-          "burst + latency quantiles via the shared histogram helper")
+          + ") + /debug/requests (+ limit/state filters, cost ledger, "
+          "wide-event jsonl) + /debug/trace"
+          + (" (merged router+replica)" if kind == "router" else "")
+          + " + /debug/timeline (dispatch-kind reconciliation) + "
+          "honored X-Request-Id + prefix-cache family under a "
+          "shared-prefix burst + latency quantiles via the shared "
+          "histogram helper")
 
 
 if __name__ == "__main__":

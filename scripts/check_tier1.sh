@@ -10,14 +10,16 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 560 = the 540 recorded at PR 10 plus the speculative-decoding suite
-# added in PR 11 (drafter units, spec_verify greedy/eos/rejection-
-# sampling-distribution pins, engine byte-parity matrix incl.
-# eviction replay + tp=2, zero-leak all-reject rollback, stop-across-
-# accept-boundary regression, steps-vs-tokens ledger split in
-# tests/test_speculative.py; ~594 observed), with headroom for
-# load-dependent flakes (bench-supervisor probes on one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-560}
+# 600 = the 560 recorded at PR 11 plus the fleet-observability suites
+# added in PR 12 (step-timeline ring + /debug/timeline reconciliation
+# in tests/test_timeline.py, wide-event schema/rotation/terminal-path
+# coverage in tests/test_request_log.py, fleet tracing — request-id
+# roundtrip, merged router+replica trace, eviction/restart trace
+# continuity — in tests/test_fleet_trace.py, and the bench regression
+# sentinel in tests/test_bench_compare.py; ~630 observed), with
+# headroom for load-dependent flakes (bench-supervisor probes on one
+# CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-600}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -160,6 +162,22 @@ echo "checking capacity harness (loadgen.py --smoke)"
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/loadgen.py --smoke > /dev/null; then
     echo "LOADGEN CAPACITY CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- bench regression sentinel -----------------------------------------------
+# The loadgen smoke above regenerated BENCH_loadgen.json; diff it (and
+# BENCH_paged_attention.json) against the committed baselines/ with
+# noise-aware per-metric-class tolerances. A moved knee, collapsed
+# accepted-tokens/step, >1 dispatches/step or flipped byte parity
+# fails CI with the offending series named; non-comparable runs
+# (backend or sweep-config drift) are refused, not diffed. Refresh
+# baselines deliberately with `bench_compare.py --update-baselines`.
+# (Runs BEFORE the router sweep below, which rewrites the artifact
+# with its router-flavored config.)
+echo "checking bench regression sentinel (bench_compare.py --gate)"
+if ! timeout -k 10 120 python scripts/bench_compare.py --gate; then
+    echo "BENCH REGRESSION SENTINEL FAILED (see the verdict table)" >&2
     exit 1
 fi
 

@@ -226,6 +226,37 @@ def scrape_metrics(base: str, timeout: float = 30.0) -> str:
         return r.read().decode()
 
 
+def build_info_labels(text: str, family: str) -> dict[str, str]:
+    """Labels of an info gauge (build_info) from a text exposition —
+    the target's self-declared identity (engine, revision, replica),
+    stamped into the report so scripts/bench_compare.py can refuse
+    cross-config comparisons instead of producing a noisy diff."""
+    m = re.search(rf"^{re.escape(family)}\{{([^}}]*)\}} 1$", text, re.M)
+    if not m:
+        return {}
+    return dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+
+
+def fetch_timeline(base: str, n: int = 24, timeout: float = 30.0) -> dict:
+    """One replica's /debug/timeline snapshot (utils/timeline.py): the
+    per-stage flight-data-recorder embed — reading the records at the
+    knee stage replaces guessing engine state from counter deltas. A
+    target without the endpoint (window engine, old server) degrades
+    to an error entry, never a failed stage."""
+    try:
+        with urllib.request.urlopen(
+            base + f"/debug/timeline?n={n}", timeout=timeout
+        ) as r:
+            body = json.load(r)
+        return {
+            "total_steps": body.get("total_steps"),
+            "counts_by_kind": body.get("counts_by_kind"),
+            "records": body.get("records"),
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def anomaly_counts(text: str) -> dict[str, float]:
     out = {}
     for kind in ANOMALY_KINDS:
@@ -575,12 +606,22 @@ def run_stage(base: str, rate: float, cfg: dict,
         # Snapshot: hung daemon workers may still append after the
         # drain; aggregation must see one consistent list.
         snapshot = list(results)
-    return aggregate_stage(
+    st = aggregate_stage(
         rate, duration, snapshot, hung, m0, m1,
         cfg["slo_ttft"], cfg["slo_per_token"],
         replica_scrapes=(r0, r1) if replicas else None,
         router=router,
     )
+    # Engine step-timeline snapshot at stage end: what the engine(s)
+    # were actually doing as this offered load drained — per replica
+    # behind a router (the router has no engine loop of its own).
+    if replicas:
+        st["timeline"] = {
+            rid: fetch_timeline(u) for rid, u in replicas.items()
+        }
+    else:
+        st["timeline"] = fetch_timeline(base)
+    return st
 
 
 # ---------------------------------------------------------------------------
@@ -614,7 +655,7 @@ def find_knee(stages: list[dict], good_frac: float = 0.9) -> dict | None:
 _STAGE_KEYS = (
     "offered_rps", "sent", "ok", "good", "slo_good_frac", "goodput_tps",
     "completed_tps", "ttft_s", "per_token_s", "server_ttft_s", "errors",
-    "anomalies", "speculation", "cost",
+    "anomalies", "speculation", "cost", "timeline",
 )
 
 
@@ -1031,10 +1072,48 @@ def run(argv=None) -> dict:
             )
             stages.append(st)
         knee = find_knee(stages, args.knee_good_frac)
+        # Provenance stamps (scripts/bench_compare.py refuses
+        # comparisons across any of these): the git revision this run
+        # measured, the backend class (a cpu self-boot is a labeled
+        # cpu_proxy run, the same convention as bench.py — never
+        # comparable against a TPU baseline), the target's own
+        # build_info identity, and the engine flags in effect.
+        import jax
+
+        from oryx_tpu.serve.api_server import _git_revision
+
+        scrape = scrape_metrics(base)
+        server_build = (
+            build_info_labels(scrape, "oryx_serving_build_info")
+            or build_info_labels(scrape, "oryx_router_build_info")
+        )
+        if args.base_url:
+            backend = "remote"
+            # A remote target's engine flags are unknowable from the
+            # client side — stamping the harness's own (unused) flags
+            # would let bench_compare diff across a server config
+            # change instead of refusing. Null = honestly unknown;
+            # server_build carries what the target self-declares.
+            speculate = ragged = None
+        else:
+            backend = jax.default_backend()
+            if backend != "tpu":
+                backend = "cpu_proxy"
+            speculate = args.speculate or 0
+            ragged = bool(args.speculate)
         report = {
             "bench": "loadgen",
             "config": {
                 "gated": bool(args.gate),
+                "git_rev": _git_revision(),
+                "backend": backend,
+                "server_build": server_build,
+                "engine": {
+                    "engine": server_build.get("engine"),
+                    "ragged": ragged,
+                    "speculate": speculate,
+                    "router_replicas": args.router or None,
+                },
                 "base_url": args.base_url or (
                     f"self-boot router x{args.router} (cpu)"
                     if args.router else "self-boot tiny (cpu)"

@@ -11,3 +11,10 @@ def declare(reg, metrics):
     metrics.inc("requests_total")
     metrics.set_gauge("queue_depth_fixture", 3)
     metrics.observe("ttft_seconds_fixture", 0.2)
+
+
+def emit_events(build_request_event):
+    build_request_event(
+        request_id="r1", status="ok", error_kind=None,
+        prefill_tokens=4, cached_tokens=0, page_seconds=0.5,
+    )

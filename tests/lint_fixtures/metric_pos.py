@@ -14,3 +14,10 @@ def declare(reg, metrics):
 def declare_computed(reg, names):
     for n in names:
         reg.gauge(n)  # expect: metric-name
+
+
+def emit_events(build_request_event):
+    build_request_event(request_id="r1", status="ok")  # ok
+    build_request_event(mystery_field=1)  # expect: metric-name
+    build_request_event(BadCaseField="x")  # expect: metric-name
+    build_request_event(request_id="r2", undeclared_one=1)  # expect: metric-name

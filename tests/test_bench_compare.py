@@ -1,0 +1,235 @@
+"""Bench regression sentinel (scripts/bench_compare.py): synthetic
+regressions are detected and NAMED, non-comparable runs (backend or
+config drift) are refused rather than diffed, and the CLI gate's exit
+contract holds."""
+
+import copy
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "scripts"))
+
+import bench_compare  # noqa: E402
+
+
+def _loadgen_report(knee_rps=4.0, goodput=24.8, tpps=30.0,
+                    backend="cpu_proxy", rates=(1.0, 4.0), seed=0):
+    stages = []
+    for i, r in enumerate(rates):
+        stages.append({
+            "offered_rps": r,
+            "slo_good_frac": 1.0,
+            "speculation": {"accepted_tokens_per_step": None},
+            "cost": {"goodput_tokens_per_page_second": tpps},
+        })
+    return {
+        "bench": "loadgen",
+        "config": {
+            "backend": backend,
+            "rates_rps": list(rates),
+            "duration_s": 5.0,
+            "seed": seed,
+            "slo_ttft_s": 30.0,
+            "knee_good_frac": 0.9,
+            "max_tokens_choices": [4, 6],
+            "prompt_chars_choices": [32, 64],
+            "shared_prefix_frac": 0.5,
+            "router_replicas": None,
+            "engine": {"engine": "continuous", "speculate": 0},
+        },
+        "stages": stages,
+        "knee": {
+            "index": len(rates) - 1,
+            "offered_rps": knee_rps,
+            "goodput_tps": goodput,
+            "saturated": False,
+        },
+    }
+
+
+def _paged_report(dps=1.0, parity=True, accepted=2.04,
+                  backend="cpu_proxy"):
+    return {
+        "bench": "paged_attention_ragged",
+        "backend": backend,
+        "geometry": {"num_slots": 4, "page_size": 16, "chunk": 4,
+                     "max_new": 8},
+        "cells": [{
+            "split": {"decode_steps_per_s": 1000.0,
+                      "dispatches_per_step": 2.0},
+            "ragged": {"decode_steps_per_s": 800.0,
+                       "dispatches_per_step": dps},
+            "replies_bit_identical": parity,
+        }],
+        "speculation": {
+            "spec": {"accepted_tokens_per_step": accepted},
+            "replies_bit_identical": parity,
+        },
+    }
+
+
+def _regressions(rows):
+    return [r.series for r in rows if r.verdict == "regression"]
+
+
+# ---------------------------------------------------------------------------
+# loadgen comparisons
+# ---------------------------------------------------------------------------
+
+
+def test_identical_reports_are_clean():
+    base = _loadgen_report()
+    rows, refusal = bench_compare.compare_loadgen(
+        copy.deepcopy(base), base
+    )
+    assert refusal is None
+    assert not _regressions(rows)
+
+
+def test_synthetic_20pct_knee_regression_is_detected_and_named():
+    """The ISSUE-12 acceptance bar: a 20% knee drop must be caught
+    (tolerance sits at 10%) and the offending series named."""
+    base = _loadgen_report(knee_rps=4.0)
+    cur = _loadgen_report(knee_rps=3.2)  # -20%
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert refusal is None
+    assert "loadgen knee.offered_rps" in _regressions(rows)
+
+
+def test_small_knee_noise_passes():
+    base = _loadgen_report(knee_rps=4.0, goodput=24.8, tpps=30.0)
+    cur = _loadgen_report(knee_rps=3.8, goodput=20.0, tpps=22.0)
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert refusal is None
+    assert not _regressions(rows)
+
+
+def test_vanished_knee_is_a_regression():
+    base = _loadgen_report()
+    cur = _loadgen_report()
+    cur["knee"] = None
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert refusal is None
+    regs = _regressions(rows)
+    assert "loadgen knee.offered_rps" in regs
+
+
+def test_goodput_per_page_second_regression_detected():
+    base = _loadgen_report(tpps=30.0)
+    cur = _loadgen_report(tpps=10.0)  # -67%, beyond the 50% band
+    rows, _ = bench_compare.compare_loadgen(cur, base)
+    assert "loadgen knee-stage goodput_tokens_per_page_second" in \
+        _regressions(rows)
+
+
+def test_cpu_proxy_vs_tpu_is_refused_not_diffed():
+    base = _loadgen_report(backend="tpu")
+    cur = _loadgen_report(backend="cpu_proxy")
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert rows == []  # refused means NO diff rows at all
+    assert refusal is not None
+    assert "cpu_proxy" in refusal and "tpu" in refusal
+
+
+def test_config_drift_is_refused_with_key_named():
+    base = _loadgen_report(rates=(1.0, 4.0))
+    cur = _loadgen_report(rates=(1.0, 8.0))
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert rows == []
+    assert "config.rates_rps" in refusal
+    # seed drift too
+    rows, refusal = bench_compare.compare_loadgen(
+        _loadgen_report(seed=7), _loadgen_report(seed=0)
+    )
+    assert "config.seed" in refusal
+
+
+# ---------------------------------------------------------------------------
+# paged-attention comparisons
+# ---------------------------------------------------------------------------
+
+
+def test_dispatches_per_step_is_exact():
+    base = _paged_report(dps=1.0)
+    rows, refusal = bench_compare.compare_paged(
+        _paged_report(dps=1.5), base
+    )
+    assert refusal is None
+    assert "paged_attention max ragged dispatches_per_step" in \
+        _regressions(rows)
+    rows, _ = bench_compare.compare_paged(_paged_report(dps=1.0), base)
+    assert not _regressions(rows)
+
+
+def test_parity_flip_and_accept_collapse_regress():
+    base = _paged_report()
+    rows, _ = bench_compare.compare_paged(
+        _paged_report(parity=False), base
+    )
+    assert any("replies_bit_identical" in s for s in _regressions(rows))
+    rows, _ = bench_compare.compare_paged(
+        _paged_report(accepted=1.0), base  # accepted/step collapsed
+    )
+    assert any("accepted_tokens_per_step" in s
+               for s in _regressions(rows))
+
+
+def test_paged_backend_mismatch_refused():
+    rows, refusal = bench_compare.compare_paged(
+        _paged_report(backend="cpu_proxy"), _paged_report(backend="tpu")
+    )
+    assert rows == [] and "refusing to diff" in refusal
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(root, *args):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "bench_compare.py"),
+         "--root", str(root), *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_gate_exit_codes(tmp_path):
+    # Clean pair -> 0 (paged pair absent: skipped, not fatal).
+    (tmp_path / "baselines").mkdir()
+    base = _loadgen_report()
+    (tmp_path / "BENCH_loadgen.json").write_text(json.dumps(base))
+    (tmp_path / "baselines" / "BENCH_loadgen.json").write_text(
+        json.dumps(base)
+    )
+    res = _run_cli(tmp_path, "--gate")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SKIPPED" in res.stdout  # the missing paged pair
+    # Regression -> 1, offending series named on stderr.
+    cur = _loadgen_report(knee_rps=1.0)
+    (tmp_path / "BENCH_loadgen.json").write_text(json.dumps(cur))
+    res = _run_cli(tmp_path, "--gate")
+    assert res.returncode == 1
+    assert "knee.offered_rps" in res.stderr
+    # Refusal -> 2, reason printed.
+    cur = _loadgen_report(backend="tpu")
+    (tmp_path / "BENCH_loadgen.json").write_text(json.dumps(cur))
+    res = _run_cli(tmp_path, "--gate")
+    assert res.returncode == 2
+    assert "REFUSED" in res.stderr
+    # Without --gate the same refusal is informational (exit 0).
+    res = _run_cli(tmp_path)
+    assert res.returncode == 0
+    assert "REFUSED" in res.stdout + res.stderr
+
+
+def test_repo_artifacts_pass_the_gate():
+    """The committed artifacts and baselines must agree — the exact
+    check CI runs after regenerating the loadgen smoke."""
+    res = _run_cli(ROOT, "--gate")
+    assert res.returncode == 0, res.stdout + res.stderr

@@ -1,0 +1,365 @@
+"""Fleet distributed tracing: client X-Request-Id honored end-to-end
+through the 2-replica router, X-Oryx-Trace propagation, the router's
+merged /debug/trace (router + replica spans on one clock, Chrome-trace
+loadable), and trace CONTINUITY across eviction replay and supervisor
+restart — a replayed request is one trace telling one story, with a
+byte-identical reply."""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve import api_server
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.router import _merge_clock_offset_us, build_router
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils import faults
+from oryx_tpu.utils import trace as trace_lib
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def pipe(tiny_model):
+    cfg, params = tiny_model
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _boot_replica(cfg, params, rid):
+    pipe = OryxInference(FakeTokenizer(), params, cfg)
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32, replica_id=rid,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _base(srv):
+    return f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+@pytest.fixture()
+def fleet(tiny_model):
+    cfg, params = tiny_model
+    reps = [_boot_replica(cfg, params, f"r{i}") for i in range(2)]
+    rsrv = build_router(
+        [(f"r{i}", _base(s)) for i, s in enumerate(reps)],
+        port=0, probe=False,
+    )
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    yield reps, rsrv
+    rsrv.stop_prober()
+    for s in reps:
+        if s.scheduler is not None:
+            s.scheduler.close()
+        s.shutdown()
+    rsrv.shutdown()
+
+
+def _post(base, body, headers=None, timeout=300):
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+CHAT = {"messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 4}
+
+
+# ---------------------------------------------------------------------------
+# Request-id plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_sanitize_request_id():
+    assert trace_lib.sanitize_request_id("abc-123.X_Y") == "abc-123.X_Y"
+    assert trace_lib.sanitize_request_id("  padded  ") == "padded"
+    assert trace_lib.sanitize_request_id(None) is None
+    assert trace_lib.sanitize_request_id("") is None
+    assert trace_lib.sanitize_request_id("-leading-dash") is None
+    assert trace_lib.sanitize_request_id("has space") is None
+    assert trace_lib.sanitize_request_id("semi;colon") is None
+    assert trace_lib.sanitize_request_id("x" * 65) is None
+    assert trace_lib.sanitize_request_id("x" * 64) == "x" * 64
+
+
+def test_client_request_id_roundtrip_through_fleet(fleet):
+    """The acceptance bar: a client-supplied X-Request-Id survives
+    router -> replica -> response, and keys the merged trace."""
+    reps, rsrv = fleet
+    with _post(_base(rsrv), CHAT,
+               {"X-Request-Id": "client-trace-42"}) as r:
+        assert r.headers.get("X-Request-Id") == "client-trace-42"
+        body = json.load(r)
+        assert body["id"] == "chatcmpl-client-trace-42"
+        served_by = r.headers.get("X-Oryx-Router-Replica")
+    # Both sides hold a trace under the SAME id.
+    assert rsrv.router.tracer.get("client-trace-42") is not None
+    owner_port = int(
+        rsrv.router.replicas[served_by].url.rsplit(":", 1)[1]
+    )
+    owner = next(
+        s for s in reps if s.server_address[1] == owner_port
+    )
+    assert owner.tracer.get("client-trace-42") is not None
+
+
+def test_unsafe_and_colliding_ids_fall_back_to_minting(fleet):
+    reps, rsrv = fleet
+    # Unsafe: header ignored, a fresh id minted.
+    with _post(_base(rsrv), CHAT, {"X-Request-Id": "bad id !!"}) as r:
+        rid = r.headers.get("X-Request-Id")
+        json.load(r)
+    assert rid and rid != "bad id !!"
+    # Collision: the second request may not steal the first's trace.
+    with _post(_base(rsrv), CHAT, {"X-Request-Id": "dup-1"}) as r:
+        assert r.headers.get("X-Request-Id") == "dup-1"
+        json.load(r)
+    with _post(_base(rsrv), CHAT, {"X-Request-Id": "dup-1"}) as r:
+        rid2 = r.headers.get("X-Request-Id")
+        json.load(r)
+    assert rid2 and rid2 != "dup-1"
+
+
+def test_replica_honors_client_id_directly(fleet):
+    """Without the router in between, the replica itself honors (and
+    echoes) a sanitized client id."""
+    reps, _ = fleet
+    with _post(_base(reps[0]), CHAT, {"X-Request-Id": "direct-7"}) as r:
+        assert r.headers.get("X-Request-Id") == "direct-7"
+        json.load(r)
+    assert reps[0].tracer.get("direct-7") is not None
+
+
+# ---------------------------------------------------------------------------
+# Merged trace
+# ---------------------------------------------------------------------------
+
+
+def test_merged_trace_contains_both_sides_on_one_clock(fleet):
+    reps, rsrv = fleet
+    with _post(_base(rsrv), CHAT, {"X-Request-Id": "merged-1"}) as r:
+        json.load(r)
+    with urllib.request.urlopen(
+        _base(rsrv) + "/debug/trace?id=merged-1", timeout=30
+    ) as r:
+        tr = json.load(r)
+    assert tr["merged"] is True
+    assert tr["replica"] in ("r0", "r1")
+    assert tr["clock_offset_us"] == 0.0  # one process, one clock
+    events = tr["traceEvents"]
+    # Chrome-trace loadable: complete events carry ph/ts/dur/pid/tid.
+    spans = [e for e in events if e.get("ph") == "X"]
+    for e in spans:
+        for k in ("name", "ts", "dur", "pid", "tid"):
+            assert k in e, e
+    names = {e["name"] for e in spans}
+    # Router spans AND the replica's engine spans in ONE trace.
+    for want in ("route_decide", "upstream_connect", "upstream_ttfb",
+                 "queue_wait", "prefill", "decode_chunk"):
+        assert want in names, f"missing {want} in {sorted(names)}"
+    # Two tracks: router tid 0, replica tid 1.
+    assert {e["tid"] for e in spans} == {0, 1}
+    # Common clock: the replica's first span may not start before the
+    # router's trace does (sub-ms tolerance for the shared anchor).
+    router_t0 = min(e["ts"] for e in spans if e["tid"] == 0)
+    replica_t0 = min(e["ts"] for e in spans if e["tid"] == 1)
+    assert replica_t0 >= router_t0 - 1e3
+    # The replica-side trace is marked routed, with the router's
+    # parent span recorded.
+    rep_meta = (tr.get("replica_request") or {}).get("meta") or {}
+    assert rep_meta.get("routed") is True
+    assert isinstance(rep_meta.get("router_parent_span"), int)
+
+
+def test_merge_clock_offset_heuristic():
+    # Same clock (created just after sent): no re-anchoring.
+    sent_ns = 1_700_000_000_000_000_000
+    assert _merge_clock_offset_us(
+        {"upstream_sent_ns": sent_ns},
+        {"created_unix_s": sent_ns / 1e9 + 0.005},
+    ) == 0.0
+    # Replica clock far behind: re-anchor to the router's send.
+    off = _merge_clock_offset_us(
+        {"upstream_sent_ns": sent_ns},
+        {"created_unix_s": sent_ns / 1e9 - 300.0},
+    )
+    assert off == pytest.approx(300e6, rel=1e-6)
+    # Replica clock absurdly ahead: re-anchor too.
+    off = _merge_clock_offset_us(
+        {"upstream_sent_ns": sent_ns},
+        {"created_unix_s": sent_ns / 1e9 + 600.0},
+    )
+    assert off == pytest.approx(-600e6, rel=1e-6)
+    # Missing anchors: leave timestamps alone.
+    assert _merge_clock_offset_us({}, {"created_unix_s": 1.0}) == 0.0
+
+
+def test_router_trace_records_retry_and_eject(tiny_model):
+    """One dead replica in the rotation: the served request's router
+    trace carries the eject event and the retry marker before the
+    healthy replica's spans."""
+    cfg, params = tiny_model
+    live = _boot_replica(cfg, params, "alive")
+    rsrv = build_router(
+        [("dead", "http://127.0.0.1:9"), ("alive", _base(live))],
+        port=0, probe=False,
+    )
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    try:
+        # Pin affinity cold-start to the dead replica by loading the
+        # live one; the miss then picks "alive" only after the eject.
+        rsrv.router.begin_request("alive")
+        with _post(_base(rsrv), CHAT, {"X-Request-Id": "retry-1"}) as r:
+            assert r.headers.get("X-Oryx-Router-Replica") == "alive"
+            assert r.headers.get("X-Oryx-Router-Retries") == "1"
+            json.load(r)
+        rsrv.router.end_request("alive")
+        tr = rsrv.router.tracer.get("retry-1")
+        assert tr is not None
+        names = [s.name for s in tr.spans]
+        assert "retry" in names and "eject" in names
+        assert names.count("route_decide") == 2  # one per attempt
+    finally:
+        live.scheduler.close()
+        live.shutdown()
+        rsrv.stop_prober()
+        rsrv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Trace continuity across replay
+# ---------------------------------------------------------------------------
+
+
+def _prefill_spans(tr):
+    with tr._lock:
+        return [
+            (s.name, s.start_ns, dict(s.args or {}))
+            for s in tr.spans if s.name == "prefill"
+        ]
+
+
+def test_eviction_replay_is_one_ordered_trace(pipe):
+    """Engineered page pressure evicts the younger request; its ONE
+    trace must carry the evicted event, a requeued queue_wait, and
+    replay prefill spans AFTER the originals — and the reply stays
+    byte-identical to the solo path."""
+    q1, q2 = "hello there", "tell me more"
+    chunk, ps = 4, 16
+    ids1 = len(pipe._prepare_request({"question": q1})[0])
+    ids2 = len(pipe._prepare_request({"question": q2})[0])
+    admit1 = math.ceil((ids1 + chunk) / ps)
+    admit2 = math.ceil((ids2 + chunk) / ps)
+    cap = (admit1 * ps - ids1) + ps
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
+        num_pages=admit1 + admit2 + 1, autostart=False,
+        prefix_cache=False,
+    )
+    h1 = sched.submit({"question": q1}, cap)
+    h2 = sched.submit({"question": q2}, cap)
+    sched.start()
+    r1 = h1.result(timeout=600)[0]
+    r2 = h2.result(timeout=600)[0]
+    sched.close()
+    assert r1 == pipe.chat(q1, max_new_tokens=cap)
+    assert r2 == pipe.chat(q2, max_new_tokens=cap)
+    evicted = [
+        h for h in (h1, h2)
+        if any(s.name == "evicted" for s in h.trace.spans)
+    ]
+    assert evicted, "the engineered pressure must evict someone"
+    tr = evicted[0].trace
+    names = [s.name for s in tr.spans]
+    # One trace, one story: original prefill(s), the eviction marker,
+    # a requeued wait, then the replay prefill(s).
+    ev_idx = names.index("evicted")
+    assert "prefill" in names[:ev_idx], "original prefill missing"
+    assert "prefill" in names[ev_idx:], "replay prefill missing"
+    requeued = [
+        s for s in tr.spans
+        if s.name == "queue_wait" and (s.args or {}).get("requeued")
+    ]
+    assert requeued, "re-admission must reopen queue_wait"
+    # Replay prefills are marked and ordered after the originals.
+    pf = _prefill_spans(tr)
+    replay_pf = [p for p in pf if p[2].get("replay")]
+    original_pf = [p for p in pf if not p[2].get("replay")]
+    assert replay_pf and original_pf
+    assert min(p[1] for p in replay_pf) >= \
+        max(p[1] for p in original_pf)
+    # The trace meta records the ledger with the eviction's double-pay.
+    meta_cost = tr.summary()["meta"]["cost"]
+    assert meta_cost["prefill_tokens"] > 0
+
+
+def test_supervisor_restart_replay_is_one_ordered_trace(pipe):
+    """Kill the engine thread mid-decode; after restart() the replayed
+    request is still ONE trace: engine_restart_replay event, requeued
+    queue_wait, replay prefill spans after the originals — and the
+    reply byte-identical (the client never learns the engine died)."""
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        autostart=False,
+    )
+    h = sched.submit({"question": "hello there"}, 12)
+    faults.configure("engine_crash:after=1")
+    sched.start()
+    deadline = 120
+    import time as _time
+
+    end = _time.monotonic() + deadline
+    while sched.alive() and _time.monotonic() < end:
+        _time.sleep(0.02)
+    assert not sched.alive(), "injected crash should kill the engine"
+    sched.restart()
+    reply, _, _ = h.result(timeout=600)
+    assert reply == pipe.chat("hello there", max_new_tokens=12)
+    sched.close()
+    tr = h.trace
+    names = [s.name for s in tr.spans]
+    ridx = names.index("engine_restart_replay")
+    assert "prefill" in names[:ridx]
+    assert "prefill" in names[ridx:]
+    pf = _prefill_spans(tr)
+    replay_pf = [p for p in pf if p[2].get("replay")]
+    assert replay_pf, "restart replay must re-prefill, marked replay"
+    assert any(
+        s.name == "queue_wait" and (s.args or {}).get("requeued")
+        for s in tr.spans
+    )
+    # Continuity bar: one trace id throughout, done exactly once.
+    assert tr.done

@@ -92,6 +92,9 @@ def _stage(rate, good_frac, anomalies=0.0, hung=0, transport=0,
                  "page_seconds": 2.0,
                  "mean_page_seconds": 0.1,
                  "goodput_tokens_per_page_second": 50.0},
+        "timeline": {"total_steps": 40,
+                     "counts_by_kind": {"prefill": 20, "decode": 20},
+                     "records": []},
     }
 
 

@@ -1,0 +1,256 @@
+"""Wide-event request log (utils/request_log.py): schema validation
+against REQUEST_EVENT_KEYS, size-capped rotation, one event per
+terminal request on every scheduler path (ok / rejected / cancelled /
+error), and the /debug/requests?format=jsonl export."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve import api_server
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import (
+    AdmissionRejected,
+    ContinuousScheduler,
+)
+from oryx_tpu.utils.metrics import REQUEST_COST_KEYS, REQUEST_EVENT_KEYS
+from oryx_tpu.utils.request_log import (
+    RequestLog,
+    build_request_event,
+)
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Unit: schema + sinks
+# ---------------------------------------------------------------------------
+
+
+def test_registry_is_superset_of_cost_keys():
+    assert set(REQUEST_COST_KEYS) < set(REQUEST_EVENT_KEYS)
+    # One schema discipline throughout: every key is snake_case.
+    import re
+
+    for k in REQUEST_EVENT_KEYS:
+        assert re.match(r"^[a-z][a-z0-9_]*$", k), k
+
+
+def test_build_request_event_validates_keys():
+    ev = build_request_event(request_id="r1", status="ok")
+    assert ev["schema"] == 1
+    assert ev["ts_unix_s"] > 0
+    # Deliberately undeclared fields, passed as splats: the static
+    # rule lets a splat through (it can't see inside), which is
+    # exactly why the RUNTIME validation below must catch it.
+    with pytest.raises(ValueError, match="mystery_field"):
+        build_request_event(**{"mystery_field": 1})
+    with pytest.raises(ValueError, match="REQUEST_EVENT_KEYS"):
+        build_request_event(**{"request_id": "r", "BadCase": 2})
+    # append() re-validates hand-rolled dicts too.
+    log = RequestLog()
+    with pytest.raises(ValueError, match="sneaky"):
+        log.append({"sneaky": 1})
+
+
+def test_ring_and_file_with_rotation(tmp_path):
+    path = tmp_path / "requests.jsonl"
+    log = RequestLog(str(path), keep=4, max_bytes=400)
+    for i in range(10):
+        log.append(build_request_event(
+            request_id=f"r{i}", status="ok", prefill_tokens=i,
+        ))
+    assert log.total == 10
+    snap = log.snapshot()
+    assert len(snap) == 4  # ring bounded
+    assert [e["request_id"] for e in snap] == ["r6", "r7", "r8", "r9"]
+    assert [e["request_id"] for e in log.snapshot(2)] == ["r8", "r9"]
+    # The export is one valid JSON object per line, log order.
+    lines = log.export_jsonl().strip().splitlines()
+    assert [json.loads(ln)["request_id"] for ln in lines] == \
+        ["r6", "r7", "r8", "r9"]
+    # Rotation: the live file plus ONE .1 generation (older rolls are
+    # dropped — disk stays <= ~2x the cap), both complete JSONL with
+    # no torn lines, together holding a contiguous SUFFIX of the
+    # stream ending at the newest event.
+    log.close()
+    rolled = tmp_path / "requests.jsonl.1"
+    assert rolled.exists()
+    recovered = []
+    for p in (rolled, path):
+        for ln in p.read_text().splitlines():
+            recovered.append(json.loads(ln)["request_id"])
+    all_ids = [f"r{i}" for i in range(10)]
+    assert recovered == all_ids[-len(recovered):]
+    assert recovered[-1] == "r9"
+    assert len(recovered) >= 4
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: one event per terminal path
+# ---------------------------------------------------------------------------
+
+
+def test_every_terminal_path_emits_one_event(pipe, tmp_path):
+    log = RequestLog(str(tmp_path / "req.jsonl"))
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        autostart=False, request_log=log, replica_id="rA",
+    )
+    ok = sched.submit({"question": "hello there"}, 4)
+    # Cancelled while queued: the engine hasn't started yet.
+    gone = sched.submit({"question": "tell me more"}, 4)
+    gone.cancelled = True
+    # Invalid at admission (prompt + max_tokens exceeds max_ctx).
+    bad = sched.submit({"question": "hi"}, 2048)
+    sched.start()
+    ok.result(timeout=600)
+    with pytest.raises(RuntimeError):
+        bad.result(timeout=600)
+    sched.close()
+    events = {e["request_id"]: e for e in log.snapshot()}
+    assert len(events) == 3
+    e_ok = events[ok.request_id]
+    assert e_ok["status"] == "ok"
+    assert e_ok["finish_reason"] in ("stop", "length")
+    assert e_ok["replica"] == "rA"
+    assert e_ok["engine"] == "continuous"
+    assert e_ok["routed"] is False
+    assert e_ok["evictions"] == 0
+    # The whole cost ledger is embedded, matching the handle's copy.
+    for k in REQUEST_COST_KEYS:
+        assert e_ok[k] == ok.debug["cost"][k], k
+    assert events[gone.request_id]["status"] == "cancelled"
+    e_bad = events[bad.request_id]
+    assert e_bad["status"] == "error"
+    assert e_bad["error_kind"] == "invalid_request"
+    # Every event is drawn from the declared schema.
+    for e in events.values():
+        assert set(e) <= set(REQUEST_EVENT_KEYS)
+
+
+def test_submit_rejection_emits_rejected_event(pipe):
+    log = RequestLog()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        autostart=False, request_log=log, max_queue=1,
+    )
+    sched.submit({"question": "hello there"}, 4)
+    with pytest.raises(AdmissionRejected):
+        sched.submit({"question": "tell me more"}, 4)
+    events = log.snapshot()
+    assert len(events) == 1
+    assert events[0]["status"] == "rejected"
+    assert events[0]["error_kind"] == "backpressure"
+    # Zero-resource ledger, still complete.
+    assert events[0]["prefill_tokens"] == 0
+    sched.close()
+
+
+def test_eviction_count_lands_in_event(pipe):
+    """An evicted-and-replayed request's event carries evictions >= 1
+    (mirrors test_scheduler's engineered page pressure)."""
+    import math
+
+    q1, q2 = "hello there", "tell me more"
+    chunk, ps = 4, 16
+    ids1 = len(pipe._prepare_request({"question": q1})[0])
+    ids2 = len(pipe._prepare_request({"question": q2})[0])
+    admit1 = math.ceil((ids1 + chunk) / ps)
+    admit2 = math.ceil((ids2 + chunk) / ps)
+    cap = (admit1 * ps - ids1) + ps
+    log = RequestLog()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
+        num_pages=admit1 + admit2 + 1, autostart=False,
+        prefix_cache=False, request_log=log,
+    )
+    h1 = sched.submit({"question": q1}, cap)
+    h2 = sched.submit({"question": q2}, cap)
+    sched.start()
+    h1.result(timeout=600)
+    h2.result(timeout=600)
+    sched.close()
+    events = {e["request_id"]: e for e in log.snapshot()}
+    assert sum(e["evictions"] for e in events.values()) >= 1
+    for e in events.values():
+        assert e["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# HTTP export
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_export_over_http(pipe):
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32,
+        replica_id="r9",
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        rids = []
+        for i in range(3):
+            req = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [
+                        {"role": "user", "content": f"question {i}?"}
+                    ],
+                    "max_tokens": 3,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                rids.append(r.headers.get("X-Request-Id"))
+                json.load(r)
+        with urllib.request.urlopen(
+            base + "/debug/requests?format=jsonl", timeout=30
+        ) as r:
+            assert r.headers.get("Content-Type") == \
+                "application/x-ndjson"
+            lines = [ln for ln in r.read().decode().splitlines() if ln]
+        events = [json.loads(ln) for ln in lines]
+        assert [e["request_id"] for e in events] == rids  # log order
+        for e in events:
+            assert e["replica"] == "r9"
+            assert set(e) <= set(REQUEST_EVENT_KEYS)
+        # ?limit= bounds the export.
+        with urllib.request.urlopen(
+            base + "/debug/requests?format=jsonl&limit=1", timeout=30
+        ) as r:
+            lim = [ln for ln in r.read().decode().splitlines() if ln]
+        assert len(lim) == 1
+        assert json.loads(lim[0])["request_id"] == rids[-1]
+        # Unknown format is a 400.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/debug/requests?format=xml", timeout=30
+            )
+        assert ei.value.code == 400
+        ei.value.close()
+    finally:
+        srv.scheduler.close()
+        srv.shutdown()

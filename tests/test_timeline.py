@@ -1,0 +1,222 @@
+"""Engine step timeline (utils/timeline.py): ring semantics, the
+scheduler's per-dispatch records, the /debug/timeline endpoint, and
+the acceptance bar — timeline dispatch-kind counts reconcile exactly
+with oryx_serving_dispatches_total deltas over the same window."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve import api_server
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils.metrics import ServingMetrics
+from oryx_tpu.utils.timeline import STEP_RECORD_KEYS, StepTimeline
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Unit: the ring itself
+# ---------------------------------------------------------------------------
+
+
+def _rec(tl, kind="decode", **kw):
+    args = dict(
+        dur_s=0.01, kind=kind, rows=2, live_slots=1,
+        accepted_tokens=2, queue_depth=0, free_pages=7,
+        degraded_mode=0,
+    )
+    args.update(kw)
+    tl.record(**args)
+
+
+def test_ring_bounds_and_newest_first():
+    tl = StepTimeline(capacity=4)
+    for i in range(10):
+        _rec(tl, rows=i)
+    assert tl.total_steps == 10
+    snap = tl.snapshot()
+    assert len(snap) == 4  # bounded by capacity
+    assert [r["step"] for r in snap] == [10, 9, 8, 7]  # newest first
+    assert [r["rows"] for r in snap] == [9, 8, 7, 6]
+    # n= bounds further; n > retained clamps.
+    assert [r["step"] for r in tl.snapshot(2)] == [10, 9]
+    assert len(tl.snapshot(99)) == 4
+    for r in snap:
+        assert tuple(sorted(r)) == tuple(sorted(STEP_RECORD_KEYS))
+
+
+def test_counts_by_kind_survive_ring_wrap():
+    """The reconciliation counters are cumulative — NOT a property of
+    the retained window — so kind-count deltas match dispatch-counter
+    deltas even after the ring wrapped many times over."""
+    tl = StepTimeline(capacity=2)
+    for _ in range(5):
+        _rec(tl, kind="prefill")
+    for _ in range(3):
+        _rec(tl, kind="ragged")
+    assert tl.counts_by_kind() == {"prefill": 5, "ragged": 3}
+    assert tl.total_steps == 8
+    body = tl.to_dict(1)
+    assert body["capacity"] == 2
+    assert body["counts_by_kind"]["prefill"] == 5
+    assert len(body["records"]) == 1
+
+
+def test_snapshot_is_safe_under_concurrent_writer():
+    """Readers are lock-free by design: every record they see must be
+    whole and well-formed while a writer hammers the ring."""
+    tl = StepTimeline(capacity=8)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            _rec(tl, rows=i % 100)
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            for r in tl.snapshot():
+                assert tuple(sorted(r)) == tuple(sorted(STEP_RECORD_KEYS))
+                assert r["kind"] == "decode"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+
+def _drain(sched, reqs):
+    handles = [sched.submit({"question": q}, cap) for q, cap in reqs]
+    sched.start()
+    for h in handles:
+        h.result(timeout=600)
+    return handles
+
+
+def _kind_counters(metrics):
+    fam = metrics.registry.existing("dispatches_total")
+    out = {}
+    if fam is None:
+        return out
+    for key, child in fam._children.items():
+        out[key[0]] = int(child.value)
+    return out
+
+
+@pytest.mark.parametrize("ragged", [False, True])
+def test_engine_records_reconcile_with_dispatch_counters(pipe, ragged):
+    """Every device dispatch — split prefill/decode or fused ragged —
+    lands exactly one timeline record of the same kind the
+    dispatches_total counter was bumped with."""
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+        prefill_chunk=32 if ragged else None, ragged=ragged,
+    )
+    _drain(sched, [("hello there", 4), ("tell me more", 6)])
+    counters = {
+        k: v for k, v in _kind_counters(metrics).items() if v
+    }
+    assert counters, "no dispatches recorded"
+    assert sched.timeline.counts_by_kind() == counters
+    assert sched.timeline.total_steps == sum(counters.values())
+    recs = sched.timeline.snapshot()
+    assert all(r["dur_s"] >= 0 for r in recs)
+    if ragged:
+        assert set(counters) == {"ragged"}
+    else:
+        assert set(counters) == {"prefill", "decode"}
+    # Steady-state fields are sane: free pages never exceed the pool,
+    # queue depth ended at zero.
+    assert all(0 <= r["free_pages"] <= sched.num_pages for r in recs)
+    assert recs[0]["queue_depth"] == 0
+    sched.close()
+
+
+def test_timeline_endpoint_over_http(pipe):
+    """GET /debug/timeline?n= on a live server: well-formed records,
+    kind counts matching the /metrics dispatch counters scraped in the
+    same quiesced window, and 400s on bad parameters."""
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        for i in range(3):
+            req = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [
+                        {"role": "user", "content": f"question {i}?"}
+                    ],
+                    "max_tokens": 3,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                json.load(r)
+        with urllib.request.urlopen(
+            base + "/debug/timeline?n=5", timeout=30
+        ) as r:
+            body = json.load(r)
+        assert body["engine"] == "continuous"
+        assert len(body["records"]) == 5
+        assert body["total_steps"] == sum(
+            body["counts_by_kind"].values()
+        )
+        for rec in body["records"]:
+            assert tuple(sorted(rec)) == tuple(sorted(STEP_RECORD_KEYS))
+        # Reconciliation over the full window: engine idle now, so the
+        # cumulative timeline counts equal the scraped counters.
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        for kind, count in body["counts_by_kind"].items():
+            m = re.search(
+                rf'^oryx_serving_dispatches_total\{{kind="{kind}"\}} '
+                rf"([0-9.e+-]+)$",
+                text, re.M,
+            )
+            assert m, f"no dispatches_total counter for kind {kind}"
+            assert float(m.group(1)) == count, kind
+        # Parameter validation.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/debug/timeline?n=nope", timeout=30
+            )
+        assert ei.value.code == 400
+        ei.value.close()
+    finally:
+        srv.scheduler.close()
+        srv.shutdown()
