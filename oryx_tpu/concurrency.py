@@ -43,7 +43,7 @@ from __future__ import annotations
 
 # The manifest: one declaration, read by the static rule from this
 # comment and by the runtime sanitizer from the tuple beneath it.
-# lock-order: server.stream_lock < scheduler._cond < anomaly._lock < trace._lock < tracer._lock < request_log._lock < watchdog._lock < router._lock < registry._lock < metrics.family
+# lock-order: server.stream_lock < scheduler._cond < anomaly._lock < trace._lock < tracer._lock < request_log._lock < forensics._lock < watchdog._lock < router._lock < registry._lock < metrics.family
 LOCK_ORDER: tuple[str, ...] = (
     "server.stream_lock",   # window-engine device lock (api_server)
     "scheduler._cond",      # admission queue + control flags
@@ -53,6 +53,9 @@ LOCK_ORDER: tuple[str, ...] = (
     "request_log._lock",    # wide-event ring + requests.jsonl sink
                             # (terminal paths emit after closing the
                             # trace, so it ranks after the trace locks)
+    "forensics._lock",      # OOM forensic ring (utils/forensics.py;
+                            # a leaf like the request log — captures
+                            # hold no other lock while appending)
     "watchdog._lock",       # stall-watchdog beat state
     "router._lock",         # front-end router replica table + affinity
                             # trie (serve/router.py; a router process

@@ -52,6 +52,7 @@ Three pieces live here:
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -83,6 +84,19 @@ class PageAllocator:
     (`copy_pages` below); `refcount(p) > 1` is the "must COW" test.
     Freeing an unallocated page, or more references than a page holds,
     raises immediately with the page id (leak/double-free guard).
+
+    Ownership observatory (docs/OBSERVABILITY.md "Memory & device
+    time"): every reference carries an OWNER TAG stamped by the caller
+    at the transition (`alloc`/`share`/`free` take `owner=`; the
+    scheduler stamps `req:<request-id>`, the prefix cache `cache`), and
+    every page records when its current tenancy began (`_born`, set at
+    refcount 0→1) and when a reference last changed (`_touched`).
+    `snapshot()` turns that into the live ownership map `/debug/pages`
+    serves; an attached `observer` (utils/pagemap.PoolObservatory) is
+    told the lifetime + idle time of every page returning to the free
+    list, feeding the oryx_page_{lifetime,idle}_seconds histograms.
+    Owner tags are accounting labels only — they never change what the
+    allocator does, and an untagged transition stamps "?".
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -92,6 +106,17 @@ class PageAllocator:
         self.page_size = page_size
         self._free: list[int] = list(range(num_pages - 1, -1, -1))
         self._refs: list[int] = [0] * num_pages
+        # Ownership map state (one tag per live reference, in grant
+        # order) + tenancy clocks, all monotonic-clock based.
+        self._owners: list[list[str]] = [[] for _ in range(num_pages)]
+        self._born: list[float] = [0.0] * num_pages
+        self._touched: list[float] = [0.0] * num_pages
+        # Low-water mark of the free list since construction — the
+        # peak-occupancy watermark the loadgen memory block reads.
+        self.min_free: int = num_pages
+        # utils/pagemap.PoolObservatory (or any object with a
+        # page_freed(lifetime_s, idle_s) method); None = no telemetry.
+        self.observer = None
 
     @property
     def sentinel(self) -> int:
@@ -113,7 +138,7 @@ class PageAllocator:
             raise ValueError(f"page {page} outside pool of {self.num_pages}")
         return self._refs[page]
 
-    def alloc(self, n: int) -> list[int]:
+    def alloc(self, n: int, *, owner: str | None = None) -> list[int]:
         if n > 0:
             # Chaos site: simulated pool exhaustion. Every caller must
             # treat OutOfPagesError as a scheduling signal (defer /
@@ -133,11 +158,16 @@ class PageAllocator:
             return []
         out = self._free[-n:][::-1]
         del self._free[-n:]
+        now = time.monotonic()
+        tag = owner or "?"
         for p in out:
             self._refs[p] = 1
+            self._owners[p] = [tag]
+            self._born[p] = self._touched[p] = now
+        self.min_free = min(self.min_free, len(self._free))
         return out
 
-    def share(self, pages: list[int]) -> None:
+    def share(self, pages: list[int], *, owner: str | None = None) -> None:
         """Add one reference per page. All-or-nothing: sharing a FREE
         page is a bug (its contents are up for grabs) and raises with
         the page id before anything is mutated."""
@@ -146,15 +176,21 @@ class PageAllocator:
                 raise ValueError(f"page {p} outside pool of {self.num_pages}")
             if self._refs[p] <= 0:
                 raise ValueError(f"share of unallocated page {p}")
+        now = time.monotonic()
+        tag = owner or "?"
         for p in pages:
             self._refs[p] += 1
+            self._owners[p].append(tag)
+            self._touched[p] = now
 
-    def free(self, pages: list[int]) -> None:
+    def free(self, pages: list[int], *, owner: str | None = None) -> None:
         """Drop one reference per page; pages reaching refcount 0 return
         to the free list (in `pages` order, LIFO-recycled). Raises with
         the offending page id — before mutating anything — on a double
         free (refcount already 0) or when one call drops more references
-        to a page than it holds."""
+        to a page than it holds. `owner` removes that holder's tag from
+        the ownership map (falling back to the most recent tag when the
+        caller's stamp is absent — accounting only, never a guard)."""
         from collections import Counter
 
         drops = Counter(pages)
@@ -168,11 +204,25 @@ class PageAllocator:
                     f"freeing {n} references to page {p}, which holds "
                     f"only {self._refs[p]}"
                 )
+        now = time.monotonic()
         released = []
         for p in pages:
             self._refs[p] -= 1
+            tags = self._owners[p]
+            if owner is not None and owner in tags:
+                tags.remove(owner)
+            elif tags:
+                tags.pop()
             if self._refs[p] == 0:
                 released.append(p)
+                if self.observer is not None:
+                    # Free-time telemetry: how long the page was
+                    # resident, and how long since its last reference
+                    # transition (the idle tail nobody was using it).
+                    self.observer.page_freed(
+                        now - self._born[p], now - self._touched[p]
+                    )
+            self._touched[p] = now
         self._free.extend(reversed(released))
 
     # `release` is `free` under its sharing-aware name: both drop one
@@ -218,6 +268,54 @@ class PageAllocator:
                     f"page {p}: refcount {self._refs[p]} but "
                     f"{held.get(p, 0)} holders"
                 )
+
+    @staticmethod
+    def classify(refcount: int, owners: list[str]) -> str:
+        """Observatory state of one page — the four states partition
+        the pool (free + slot + cache + shared == num_pages): free
+        (refcount 0), shared (>= 2 holders, whoever they are), cache
+        (exactly the prefix cache's own reference) or slot (exactly one
+        request-held reference)."""
+        if refcount <= 0:
+            return "free"
+        if refcount >= 2:
+            return "shared"
+        return "cache" if owners == ["cache"] else "slot"
+
+    def snapshot(self) -> dict:
+        """The live ownership map: one record per page (state, refcount,
+        owner tags, tenancy age, idle time) plus the raw pool geometry.
+        Pure read — derived summaries (state counts, fragmentation,
+        age quantiles) live in utils/pagemap.summarize so the router
+        and the bench harness share one implementation.
+
+        Thread contract: the map is engine-owned state; a read from a
+        debug-endpoint thread is best-effort (each page record is
+        internally consistent, the map is exact on a quiesced engine —
+        the reconciliation gate scrapes quiesced by design)."""
+        now = time.monotonic()
+        pages = []
+        for p in range(self.num_pages):
+            r = self._refs[p]
+            owners = list(self._owners[p])
+            pages.append({
+                "page": p,
+                "state": self.classify(r, owners),
+                "refcount": r,
+                "owners": owners,
+                "age_s": round(now - self._born[p], 6) if r > 0 else None,
+                "idle_s": (
+                    round(now - self._touched[p], 6) if r > 0 else None
+                ),
+            })
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "num_free": len(self._free),
+            "min_free": self.min_free,
+            "free_pages": sorted(self._free),
+            "pages": pages,
+        }
 
 
 @partial(jax.jit, donate_argnums=0)

@@ -33,9 +33,26 @@ web-framework dependency.
   GET /debug/timeline   (the engine flight data recorder: ?n= newest
                          per-step records — dispatch kind/rows/wall
                          time, live slots, accepted tokens, queue
-                         depth, free pages, degraded mode — plus
-                         cumulative dispatch-kind counts that reconcile
-                         with oryx_serving_dispatches_total)
+                         depth, free pages, degraded mode, sampled
+                         device_us — plus cumulative dispatch-kind
+                         counts that reconcile with
+                         oryx_serving_dispatches_total)
+  GET /debug/pages      (page-pool observatory: the live ownership map
+                         — per page free/slot/cache/shared, refcount,
+                         owner tags, tenancy age — ?format=summary for
+                         just the derived counts/fragmentation, which
+                         reconcile with the oryx_pool_* gauges on a
+                         quiesced engine)
+  GET /debug/oom        (OOM forensics: ?n= newest memory-pressure
+                         records — pool summary, top-K residents with
+                         ledgers, cache LRU tail, timeline tail —
+                         captured at every OutOfPagesError and
+                         degraded-mode escalation)
+  GET /debug/profile    (on-demand device-time capture: bracket the
+                         next ?steps=K dispatches in one jax.profiler
+                         capture; returns a Perfetto-loadable Chrome
+                         trace + per-kind device-time split. 503 on an
+                         idle engine)
 
 Content may be a plain string or OpenAI content-part lists; image parts
 (`{"type": "image_url", "image_url": {"url": "data:image/...;base64,..."
@@ -573,6 +590,7 @@ def build_server(
     prefix_cache: bool = True,
     ragged: bool = False,
     speculate: int = 0,
+    profile_sample_every: int = 0,
     stall_timeout: float | None = None,
     flight_recorder_size: int = 256,
     ttft_slo: float | None = None,
@@ -656,6 +674,11 @@ def build_server(
             "--request-timeout requires a scheduler engine (the "
             "window batcher does not enforce per-request deadlines)"
         )
+    if engine == "window" and profile_sample_every:
+        raise ValueError(
+            "--profile-sample-every requires a scheduler engine (the "
+            "window batcher has no engine step loop to sample)"
+        )
     # $ORYX_LOCK_SANITIZER=1 arms the lock-order sanitizer + race
     # detector for this server (chaos/test runs). Armed BEFORE the
     # metrics registry and scheduler are built so every named lock
@@ -726,6 +749,7 @@ def build_server(
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
             ragged=ragged, speculate=speculate,
+            profile_sample_every=profile_sample_every,
             max_queue=max_queue, request_timeout=request_timeout,
             degraded_cooldown=degraded_cooldown,
             request_log=request_log, engine_label=engine,
@@ -888,6 +912,112 @@ def build_server(
                 body = {"engine": engine}
                 body.update(scheduler.timeline.to_dict(n or None))
                 self._json(200, body)
+            elif self.path.split("?", 1)[0] == "/debug/pages":
+                # Page-pool observatory (utils/pagemap.py): the live
+                # ownership map — per page free/slot/cache/shared,
+                # refcount, owner tags, tenancy age — plus the derived
+                # summary whose state counts must reconcile with the
+                # oryx_pool_* gauges on a quiesced engine.
+                if scheduler is None:
+                    self._json(400, {
+                        "error": "the page map requires a scheduler "
+                        "engine (the window batcher has no paged "
+                        "pool)",
+                    })
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                fmt = (q.get("format") or ["json"])[0]
+                if fmt not in ("json", "summary"):
+                    self._json(400, {
+                        "error": f"unknown format {fmt!r} "
+                        "(json|summary)",
+                    })
+                    return
+                snap = scheduler.pool_snapshot()
+                body = {
+                    "engine": engine,
+                    "num_pages": snap["num_pages"],
+                    "page_size": snap["page_size"],
+                    "summary": snap["summary"],
+                }
+                if fmt == "json":
+                    body["pages"] = snap["pages"]
+                self._json(200, body)
+            elif self.path.split("?", 1)[0] == "/debug/oom":
+                # OOM forensics (utils/forensics.py): the bounded ring
+                # of memory-pressure incident records — pool summary,
+                # top-K residents with ledgers, cache LRU tail,
+                # timeline tail — captured at every OutOfPagesError
+                # and degraded-mode escalation.
+                if scheduler is None:
+                    self._json(400, {
+                        "error": "OOM forensics require a scheduler "
+                        "engine (the window batcher has no paged "
+                        "pool)",
+                    })
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                try:
+                    n = int((q.get("n") or ["16"])[0])
+                    if n < 0:
+                        raise ValueError
+                except ValueError:
+                    self._json(400, {
+                        "error": "n must be a non-negative integer",
+                    })
+                    return
+                body = {"engine": engine}
+                body.update(scheduler.forensics.to_dict(n or None))
+                self._json(200, body)
+            elif self.path.split("?", 1)[0] == "/debug/profile":
+                # On-demand device-time capture: bracket the next
+                # ?steps=K engine dispatches in one jax.profiler
+                # capture and return the Perfetto-loadable Chrome
+                # trace + per-kind device-time attribution. Needs live
+                # traffic — an idle engine answers 503.
+                if scheduler is None:
+                    self._json(400, {
+                        "error": "profiling requires a scheduler "
+                        "engine (the window batcher has no engine "
+                        "step loop)",
+                    })
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                try:
+                    steps = int((q.get("steps") or ["4"])[0])
+                    if not 1 <= steps <= 256:
+                        raise ValueError
+                except ValueError:
+                    self._json(400, {
+                        "error": "steps must be an integer in "
+                        "[1, 256]",
+                    })
+                    return
+                try:
+                    timeout = float((q.get("timeout") or ["30"])[0])
+                except ValueError:
+                    self._json(400, {"error": "timeout must be a "
+                                     "number"})
+                    return
+                try:
+                    result = scheduler.request_profile(
+                        steps, timeout=max(1.0, min(timeout, 300.0))
+                    )
+                except TimeoutError as e:
+                    self._json(503, {"error": str(e)},
+                               extra_headers={"Retry-After": "1"})
+                    return
+                except RuntimeError as e:
+                    self._json(503, {"error": str(e)})
+                    return
+                result["engine"] = engine
+                self._json(200, result)
             elif self.path.startswith("/debug/trace"):
                 q = urllib.parse.parse_qs(
                     urllib.parse.urlsplit(self.path).query
@@ -1314,6 +1444,7 @@ def build_server(
         scheduler.request_log if scheduler is not None else None
     )
     srv.timeline = scheduler.timeline if scheduler is not None else None
+    srv.forensics = scheduler.forensics if scheduler is not None else None
 
     def begin_drain() -> None:
         """Drain-on-shutdown, step 1: /readyz flips 503 NOW (router
@@ -1397,6 +1528,17 @@ def main(argv: list[str] | None = None) -> None:
         "dispatch, so a slot advances 1..K+1 tokens per sequential "
         "step. Greedy outputs stay byte-identical; temperature>0 uses "
         "rejection sampling (distribution-exact). Requires --ragged.",
+    )
+    ap.add_argument(
+        "--profile-sample-every", type=int, default=0, metavar="N",
+        help="continuous engine: every N engine steps, bracket ONE "
+        "dispatch in a jax.profiler capture and attribute its device "
+        "busy time to oryx_device_time_seconds_total{kind=} + the "
+        "step's /debug/timeline record (0 = off; sampling never "
+        "alters tokens or adds a dispatch, and a failed capture only "
+        "increments oryx_profile_capture_errors_total). "
+        "GET /debug/profile?steps=K serves on-demand captures either "
+        "way",
     )
     ap.add_argument(
         "--no-prefix-cache", action="store_true",
@@ -1524,6 +1666,7 @@ def main(argv: list[str] | None = None) -> None:
         prefix_cache=not args.no_prefix_cache,
         ragged=args.ragged,
         speculate=args.speculate,
+        profile_sample_every=args.profile_sample_every,
         stall_timeout=args.stall_timeout or None,
         flight_recorder_size=args.flight_recorder_size,
         ttft_slo=args.ttft_slo,

@@ -239,7 +239,9 @@ class PagedPrefixCache:
         new = 0
         for node, page in zip(path, pages):
             if node.payload is None:
-                self.allocator.share([int(page)])
+                # "cache" is the ownership-map stamp the page-pool
+                # observatory classifies cache-owned pages by.
+                self.allocator.share([int(page)], owner="cache")
                 node.payload = int(page)
                 new += 1
         self._pages += new
@@ -270,7 +272,7 @@ class PagedPrefixCache:
             for victim in candidates:
                 if freed >= need_pages:
                     break
-                self.allocator.release([victim.payload])
+                self.allocator.release([victim.payload], owner="cache")
                 self.trie.remove(victim)
                 self._pages -= 1
                 freed += 1
@@ -284,7 +286,7 @@ class PagedPrefixCache:
         the scheduler rebuilds a consumed pool)."""
         for node in list(self.trie.nodes()):
             if node.payload is not None:
-                self.allocator.release([node.payload])
+                self.allocator.release([node.payload], owner="cache")
         self.trie = TokenTrie(self.page_size)
         self._pages = 0
         self._gauges()

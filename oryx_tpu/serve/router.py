@@ -538,7 +538,17 @@ def build_router(
             elif path == "/debug/requests":
                 self._merged_debug_requests(query)
             elif path == "/debug/timeline":
-                self._merged_timeline(query)
+                self._merged_replica_json("/debug/timeline", query)
+            elif path == "/debug/pages":
+                # The fleet's page-ownership maps, keyed by replica —
+                # same degrade-to-error-entry contract as the timeline
+                # merge (a wedged replica never stalls the fleet view).
+                self._merged_replica_json("/debug/pages", query)
+            elif path == "/debug/oom":
+                # The fleet's OOM forensic rings, keyed by replica.
+                self._merged_replica_json("/debug/oom", query)
+            elif path == "/debug/profile":
+                self._proxy_profile(query)
             elif path == "/debug/trace":
                 self._find_trace(query)
             elif path == "/v1/models":
@@ -577,26 +587,81 @@ def build_router(
             self.end_headers()
             self.wfile.write(data)
 
-        def _merged_timeline(self, query: str) -> None:
-            """The fleet's engine timelines in one response: each
-            replica's /debug/timeline (same query string) keyed by
-            replica id. A wedged replica degrades to an error entry,
-            never a stalled endpoint (same contract as the metrics
-            aggregation)."""
+        def _merged_replica_json(self, path: str, query: str) -> None:
+            """Generic per-replica JSON merge (the /debug/timeline,
+            /debug/pages and /debug/oom views): each replica's
+            response (same query string) under its id, a wedged
+            replica degrading to an error entry — never a stalled
+            endpoint (same contract as the metrics aggregation)."""
             per: dict[str, Any] = {}
             for rid, info in sorted(router.snapshot().items()):
                 r = router.replicas[rid]
                 try:
                     status, body = self._replica_get(
-                        r,
-                        "/debug/timeline" + (f"?{query}" if query else ""),
+                        r, path + (f"?{query}" if query else ""),
                     )
                     if status != 200:
-                        raise OSError(f"/debug/timeline -> {status}")
+                        raise OSError(f"{path} -> {status}")
                     per[rid] = json.loads(body)
                 except (OSError, ValueError) as e:
                     per[rid] = {"error": str(e)}
             self._json(200, {"engine": "router", "replicas": per})
+
+        def _proxy_profile(self, query: str) -> None:
+            """Proxy /debug/profile to the OWNING replica: ?replica=
+            names it explicitly, otherwise the busiest healthy replica
+            (most in-flight requests — profiling needs live
+            dispatches) with the first healthy one as the idle-fleet
+            fallback. Long timeout: the capture spans real engine
+            steps."""
+            q = urllib.parse.parse_qs(query)
+            want = (q.get("replica") or [""])[0]
+            snap = router.snapshot()
+            if want:
+                if want not in snap:
+                    self._json(404, {
+                        "error": f"unknown replica {want!r} "
+                        f"(known: {sorted(snap)})",
+                    })
+                    return
+                rid = want
+            else:
+                healthy = router.healthy_ids()
+                if not healthy:
+                    self._router_error(503, "no_healthy_replica", 0)
+                    return
+                rid = max(
+                    healthy,
+                    key=lambda i: snap.get(i, {}).get("inflight", 0),
+                )
+            pass_q = urllib.parse.urlencode(
+                [(k, v[0]) for k, v in q.items() if k != "replica"]
+            )
+            # The socket timeout must outlive the replica's own wait
+            # (it clamps ?timeout= to [1, 300]); a fixed proxy timeout
+            # below it would 503 while the replica capture stays in
+            # flight and refuses the retry.
+            try:
+                upstream_t = float((q.get("timeout") or ["30"])[0])
+            except ValueError:
+                upstream_t = 30.0
+            try:
+                status, body = self._replica_get(
+                    router.replicas[rid],
+                    "/debug/profile" + (f"?{pass_q}" if pass_q else ""),
+                    timeout=max(1.0, min(upstream_t, 300.0)) + 30.0,
+                )
+            except OSError as e:
+                self._json(503, {
+                    "error": f"replica {rid} profile failed: {e}",
+                })
+                return
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Oryx-Router-Replica", rid)
+            self.end_headers()
+            self.wfile.write(body)
 
         def _merged_debug_requests(self, query: str) -> None:
             """One flight-recorder view of the fleet: each replica's

@@ -137,6 +137,9 @@ from oryx_tpu.ops.packing import round_up_bucket
 from oryx_tpu.serve import pipeline as pipeline_lib
 from oryx_tpu.serve.prefix_cache import PagedPrefixCache
 from oryx_tpu.utils import faults
+from oryx_tpu.utils import forensics as forensics_lib
+from oryx_tpu.utils import pagemap
+from oryx_tpu.utils import profiling as profiling_lib
 from oryx_tpu.utils import request_log as request_log_lib
 from oryx_tpu.utils import trace as trace_lib
 from oryx_tpu.utils.anomaly import AnomalyMonitor
@@ -289,6 +292,12 @@ class _Request:
     cost_decode_tokens: int = 0  # thread-owned: engine
     cost_page_seconds: float = 0.0  # thread-owned: engine
     pages_t: float = 0.0  # last accrual (0 = never held) # thread-owned: engine
+    # HBM high-water mark: most pages held at once (sampled at every
+    # accrual point — grow/free/chunk/finalize) and the page-seconds
+    # the request had paid when it got there; both land in the cost
+    # ledger + wide event as peak_pages / peak_page_seconds.
+    peak_pages: int = 0  # thread-owned: engine
+    peak_page_seconds: float = 0.0  # thread-owned: engine
     # Span handles into `trace` for regions that outlive one method:
     # queue_wait opens at submit (and again at eviction), admission
     # opens when the request reaches the queue head. -1 = not open.
@@ -332,6 +341,8 @@ class ContinuousScheduler:
         request_log: request_log_lib.RequestLog | None = None,
         engine_label: str = "continuous",
         replica_id: str | None = None,
+        profile_sample_every: int = 0,
+        forensics: forensics_lib.ForensicRing | None = None,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -478,7 +489,52 @@ class ContinuousScheduler:
         reg.histogram("request_prefill_seconds", REQUEST_SECONDS_BUCKETS)
         reg.histogram("request_decode_seconds", REQUEST_SECONDS_BUCKETS)
         reg.histogram("request_e2e_seconds", REQUEST_SECONDS_BUCKETS)
+        reg.histogram("request_peak_pages", REQUEST_TOKEN_BUCKETS)
+        # Memory-pressure forensics: one counter per captured incident
+        # (the chaos suite reconciles it against the injection
+        # schedule) backing the bounded ring /debug/oom serves.
+        reg.counter("oom_forensics_total", ("trigger",))
         self.allocator = paged_kv.PageAllocator(self.num_pages, page_size)
+        # Page-pool observatory (utils/pagemap.py): oryx_pool_* gauges
+        # refreshed at scrape time + the free-time page-lifetime/idle
+        # histograms the allocator feeds through its observer hook.
+        # Constructed once (families may not be re-declared); every
+        # pool rebuild re-attaches the fresh allocator.
+        self.pool_observatory = pagemap.PoolObservatory(
+            reg, lambda: self.allocator
+        )
+        self.pool_observatory.attach(self.allocator)
+        # OOM forensic ring (utils/forensics.py): every OutOfPagesError
+        # and degraded-mode escalation captures a bounded record,
+        # served at GET /debug/oom.
+        self.forensics = forensics or forensics_lib.ForensicRing()
+        # Continuous device-time attribution (utils/profiling.py):
+        # every `profile_sample_every` engine steps ONE dispatch is
+        # bracketed in a jax.profiler capture and its device busy time
+        # lands on oryx_device_time_seconds_total{kind=} + the step's
+        # timeline record (device_us). 0 = periodic sampling off; the
+        # sampler still serves on-demand /debug/profile captures.
+        if not isinstance(profile_sample_every, int) \
+                or profile_sample_every < 0:
+            raise ValueError(
+                "profile_sample_every must be a non-negative integer "
+                f"(steps between samples; 0 = off), got "
+                f"{profile_sample_every!r}"
+            )
+        self.profiler = profiling_lib.DeviceTimeSampler(
+            reg, every=profile_sample_every
+        )
+        # On-demand capture coordination: HTTP threads park a request
+        # here (request_profile); the engine loop adopts it at the next
+        # step and completes it over the asked number of dispatches.
+        self._profile_pending = None  # guarded-by: _cond
+        self._profile_active = None  # thread-owned: engine
+        # Pool-pressure episode arming: the REAL capacity path (free
+        # list short, eviction pending) retries every engine step
+        # while a head waits — capture ONE forensic per episode
+        # (armed at the first failed grow/splice, cleared by the next
+        # successful allocation), not one per step.
+        self._oom_episode = False  # thread-owned: engine
         self.prefix_cache = (
             PagedPrefixCache(self.allocator, metrics=self.metrics)
             if prefix_cache else None
@@ -652,6 +708,48 @@ class ContinuousScheduler:
         enforces exactly that when armed)."""
         with self._cond:
             return len(self._queue)
+
+    def request_profile(self, steps: int, timeout: float = 60.0
+                        ) -> dict[str, Any]:
+        """On-demand device-time capture (the GET /debug/profile
+        entry point, any thread): park a request for the engine loop,
+        which brackets its next `steps` dispatches in one
+        jax.profiler capture and returns the Perfetto-loadable Chrome
+        trace + per-kind device-time attribution. Raises TimeoutError
+        when the engine ran no dispatches in time (an idle engine
+        cannot be profiled — send it traffic first) and RuntimeError
+        when a capture is already in flight or the capture failed."""
+        if not isinstance(steps, int) or steps < 1:
+            raise ValueError(f"steps must be a positive integer, "
+                             f"got {steps!r}")
+        holder: dict[str, Any] = {
+            "steps": steps, "done": threading.Event(), "result": None,
+        }
+        with self._cond:
+            if self._profile_pending is not None:
+                raise RuntimeError(
+                    "a profile capture is already queued"
+                )
+            self._profile_pending = holder
+            self._cond.notify()
+        if not holder["done"].wait(timeout):
+            with self._cond:
+                # Safe check-then-act: the guard for this clear is the
+                # IDENTITY re-check on this line, under this lock
+                # acquisition (only OUR holder is ever removed); the
+                # earlier emptiness check going stale is harmless —
+                # an adopted holder simply isn't pending any more.
+                if self._profile_pending is holder:
+                    self._profile_pending = None  # oryxlint: disable=atomicity
+            raise TimeoutError(
+                f"no completed profile capture within {timeout:g}s "
+                "(engine idle, or a capture already in flight — "
+                "profiling needs live dispatches)"
+            )
+        result = holder["result"]
+        if isinstance(result, dict) and "error" in result:
+            raise RuntimeError(result["error"])
+        return result
 
     def start(self) -> None:
         if not self._thread.is_alive():
@@ -886,7 +984,9 @@ class ContinuousScheduler:
         with self._cond:
             self.metrics.set_gauge("queue_depth", len(self._queue))
         # The dead dispatch may have consumed the donated pool; rebuild
-        # (this clears every slot and asserts check_invariant).
+        # (this clears every slot and asserts check_invariant). Any
+        # capture the dead thread left running is discarded too.
+        self._abort_profile()
         self._reset_pool()
         self.restarts += 1
         self.metrics.inc("engine_restarts_total")
@@ -908,6 +1008,9 @@ class ContinuousScheduler:
         self.allocator = paged_kv.PageAllocator(
             self.num_pages, self.page_size
         )
+        # A fresh allocator starts with observer=None: re-attach so
+        # page-lifetime telemetry keeps flowing after the rebuild.
+        self.pool_observatory.attach(self.allocator)
         if self.prefix_cache is not None:
             # The old cache indexed pages of the CONSUMED pool; rebuild
             # it over the fresh allocator.
@@ -919,6 +1022,7 @@ class ContinuousScheduler:
             dtype=oryx.compute_dtype(self.cfg),
         ))
         self.bt[:] = self._sentinel
+        self._oom_episode = False
         self.slots = [None] * self.num_slots
         self.finished[:] = True
         self.lengths[:] = 0
@@ -943,6 +1047,148 @@ class ContinuousScheduler:
                 holders.append(self.prefix_cache.held_pages())
             self.allocator.check_invariant(holders)
 
+    def pool_snapshot(self) -> dict[str, Any]:
+        """The live page-ownership map + derived summary — the
+        GET /debug/pages body (utils/pagemap.summarize over
+        PageAllocator.snapshot). Thread contract: engine-owned state
+        read best-effort from debug threads; exact on a quiesced
+        engine, which is how the reconciliation gate
+        (scripts/check_serving_endpoints.py) reads it — declared to
+        the armed race detector like the pool-invariant check."""
+        with race_exempt("pool snapshot: debug read, quiesced by "
+                         "contract"):
+            snap = self.allocator.snapshot()
+            # Force-refresh the oryx_pool_* gauges from the same
+            # moment, so a scrape right after this snapshot agrees
+            # with it (the collector is otherwise TTL-cached).
+            self.pool_observatory.collect(force=True)
+        snap["summary"] = pagemap.summarize(snap)
+        return snap
+
+    def _capture_oom(self, trigger: str, detail: str, *,
+                     asking: tuple | None = None) -> None:
+        """Forensic capture at a memory-pressure moment (engine thread
+        only; docs/OBSERVABILITY.md "Memory & device time"): pool
+        summary, top-K residents by pages held with their in-flight
+        ledgers, the prefix cache's LRU tail, and the engine timeline
+        tail land in the bounded ring (/debug/oom), plus one flat
+        oom_pressure wide event through the request-log sink so
+        requests.jsonl carries the greppable one-liner. `asking` =
+        (slot, request, pages_needed) — the allocation that failed,
+        which at admission time is not yet a resident but is exactly
+        the request an operator wants named."""
+        summary = pagemap.summarize(self.allocator.snapshot())
+        residents = []
+        for s, req in enumerate(self.slots):
+            if req is None:
+                continue
+            residents.append(self._forensic_request(s, req))
+        if asking is not None:
+            s, req, need = asking
+            if req is not None and all(
+                r["request_id"] != req.trace.id for r in residents
+            ):
+                ent = self._forensic_request(s, req)
+                ent["asking_pages"] = int(need)
+                residents.append(ent)
+        residents.sort(key=lambda r: -r["pages"])
+        residents = residents[:forensics_lib.TOP_K]
+        cache = None
+        cache_lru = []
+        if self.prefix_cache is not None:
+            cache = {
+                "entries": self.prefix_cache.entries,
+                "pages": self.prefix_cache.pages,
+                "evictable_pages": self.prefix_cache.evictable_pages(),
+            }
+            leaves = sorted(
+                self.prefix_cache.trie.leaves(), key=lambda n: n.stamp
+            )
+            for node in leaves[:forensics_lib.TOP_K]:
+                depth = 0
+                walk = node
+                while walk is not None and walk.parent is not None:
+                    depth += 1
+                    walk = walk.parent
+                cache_lru.append({
+                    "leaf_page": node.payload,
+                    "depth_pages": depth,
+                    "lru_stamp": node.stamp,
+                    "refcount": self.allocator.refcount(node.payload),
+                })
+        record = {
+            "kind": "oom_pressure",
+            "trigger": trigger,
+            "detail": detail,
+            "engine": self.engine_label,
+            "replica": self.replica_id,
+            "degraded_mode": int(self.metrics.get("degraded_mode")),
+            "queue_depth": int(self.metrics.get("queue_depth")),
+            "live_slots": sum(
+                1 for r in self.slots if r is not None
+            ),
+            "pool": summary,
+            "top_requests": residents,
+            "cache": cache,
+            "cache_lru": cache_lru,
+            "timeline_tail": self.timeline.snapshot(16),
+        }
+        idx = self.forensics.append(record)
+        self.metrics.inc(
+            "oom_forensics_total", labels={"trigger": trigger}
+        )
+        self.request_log.append(request_log_lib.build_oom_event(
+            trigger=trigger,
+            detail=detail,
+            engine=self.engine_label,
+            replica=self.replica_id,
+            degraded_mode=record["degraded_mode"],
+            queue_depth=record["queue_depth"],
+            live_slots=record["live_slots"],
+            free_pages=summary["free"],
+            slot_pages=summary["slot"],
+            cache_pages=summary["cache"],
+            shared_pages=summary["shared"],
+            fragmentation_ratio=summary["fragmentation_ratio"],
+            top_request_id=(
+                residents[0]["request_id"] if residents else None
+            ),
+            top_request_pages=(
+                residents[0]["pages"] if residents else 0
+            ),
+            forensic_index=idx,
+        ))
+        _LOG.warning(
+            "memory-pressure forensic #%d captured (%s: %s; free=%d "
+            "slot=%d cache=%d shared=%d)", idx, trigger, detail,
+            summary["free"], summary["slot"], summary["cache"],
+            summary["shared"],
+        )
+
+    def _forensic_request(self, s: int, req: _Request) -> dict[str, Any]:
+        """One resident's line in a forensic record: identity, pages
+        held, and the in-flight half of its cost ledger (the finalized
+        ledger lands in its wide event later — this is the live view
+        at the incident)."""
+        return {
+            "request_id": req.trace.id,
+            "slot": s,
+            "pages": self._held(s),
+            "prompt_tokens": req.length,
+            "emitted_tokens": len(req.emitted),
+            "spliced_tokens": req.spliced,
+            "activated": req.activated,
+            "evictions": req.evictions,
+            "cost": {
+                "prefill_tokens": req.cost_prefill_tokens,
+                "cached_tokens": req.cost_cached_tokens,
+                "decode_steps": req.cost_decode_steps,
+                "decode_tokens": req.cost_decode_tokens,
+                "page_seconds": round(req.cost_page_seconds, 6),
+                "peak_pages": req.peak_pages,
+            },
+        }
+
     def _held(self, s: int) -> int:
         return int((self.bt[s] != self._sentinel).sum())
 
@@ -961,12 +1207,21 @@ class ContinuousScheduler:
         if req is None or not req.pages_t:
             return
         now = time.monotonic()
-        weight = sum(
-            1.0 / max(1, self.allocator.refcount(int(p)))
-            for p in self.bt[s] if p != self._sentinel
-        )
+        held = 0
+        weight = 0.0
+        for p in self.bt[s]:
+            if p != self._sentinel:
+                held += 1
+                weight += 1.0 / max(1, self.allocator.refcount(int(p)))
         req.cost_page_seconds += weight * (now - req.pages_t)
         req.pages_t = now
+        if held > req.peak_pages:
+            # HBM high-water mark: accrual runs before every page-count
+            # change AND at finalization (pages still held), so the
+            # peak is sampled at worst one accrual late and always
+            # covers the final held count.
+            req.peak_pages = held
+            req.peak_page_seconds = req.cost_page_seconds
 
     def _finalize_cost(self, s: int | None, req: _Request,
                        observe: bool = True) -> dict[str, Any]:
@@ -991,6 +1246,8 @@ class ContinuousScheduler:
             "prefill_s": round(by.get("prefill", 0.0), 6),
             "decode_s": round(by.get("decode_chunk", 0.0), 6),
             "e2e_s": round(time.monotonic() - req.submit_time, 6),
+            "peak_pages": req.peak_pages,
+            "peak_page_seconds": round(req.peak_page_seconds, 6),
         }
         req.handle.debug["cost"] = cost
         if not observe:
@@ -1010,6 +1267,7 @@ class ContinuousScheduler:
         m.observe("request_prefill_seconds", cost["prefill_s"])
         m.observe("request_decode_seconds", cost["decode_s"])
         m.observe("request_e2e_seconds", cost["e2e_s"])
+        m.observe("request_peak_pages", cost["peak_pages"])
         return cost
 
     def _emit_request_event(self, req: _Request, *, status: str,
@@ -1049,10 +1307,18 @@ class ContinuousScheduler:
             **cost,
         ))
 
-    def _free_slot_pages(self, s: int) -> None:
+    @staticmethod
+    def _owner_tag(req: _Request | None) -> str | None:
+        """The ownership-map stamp for a request's page references
+        (PageAllocator owner tags; "cache" is the prefix cache's)."""
+        return None if req is None else f"req:{req.trace.id}"
+
+    def _free_slot_pages(self, s: int, owner: str | None = None) -> None:
         pages = [int(p) for p in self.bt[s] if p != self._sentinel]
         if pages:
-            self.allocator.free(pages)
+            self.allocator.free(
+                pages, owner=owner or self._owner_tag(self.slots[s])
+            )
         self.bt[s] = self._sentinel
 
     def _clear_slot(self, s: int) -> None:
@@ -1070,16 +1336,21 @@ class ContinuousScheduler:
         self.top_k[s] = 0
         self.recent[s] = -2
 
-    def _grow_slot(self, s: int, tokens: int) -> bool:
+    def _grow_slot(self, s: int, tokens: int,
+                   req: _Request | None = None) -> bool:
         """Extend slot s's block table to cover `tokens` logical slots;
         False when the free list can't satisfy it. The ask is clamped to
         max_ctx (the table is max_pages wide; near the context ceiling
         the final chunk's overshoot steps self-confine to the row's own
-        discarded tail)."""
+        discarded tail). `req` is the ownership-map stamp (defaults to
+        the slot's occupant — admission passes the not-yet-placed
+        request explicitly)."""
         tokens = min(tokens, self.max_ctx)
         need = self.allocator.pages_for(tokens) - self._held(s)
         if need <= 0:
             return True
+        if req is None:
+            req = self.slots[s]
         # Page count is about to change: bank the integral at the OLD
         # held count first, or the grown pages would be backdated.
         self._accrue_page_seconds(s)
@@ -1094,18 +1365,36 @@ class ContinuousScheduler:
             if self.prefix_cache.evictable_pages() >= shortfall:
                 self.prefix_cache.evict(shortfall)
         if need > self.allocator.num_free:
+            # THE real capacity-OOM path (no exception: deferral and
+            # eviction absorb it) — the incident /debug/oom exists to
+            # diagnose. One capture per pressure episode.
+            if not self._oom_episode:
+                self._oom_episode = True
+                self._capture_oom(
+                    "pool_pressure",
+                    f"free-list shortfall: need {need} page(s), "
+                    f"{self.allocator.num_free} free",
+                    asking=(s, req, need),
+                )
             return False
         held = self._held(s)
         try:
-            pages = self.allocator.alloc(need)
-        except paged_kv.OutOfPagesError:
+            pages = self.allocator.alloc(need, owner=self._owner_tag(req))
+        except paged_kv.OutOfPagesError as e:
             # Free-list said yes but alloc refused (injected OOM, or a
             # racing holder): report "can't grow" so the normal
             # eviction/defer machinery handles it — an allocation
             # failure is a scheduling signal, never a crash. alloc is
-            # all-or-nothing, so nothing is held on this path.
+            # all-or-nothing, so nothing is held on this path. The
+            # moment IS a forensic: capture the pool state while the
+            # pressure that caused it is still live.
+            self._capture_oom(
+                "oom", f"{type(e).__name__}: {e}",
+                asking=(s, req, need),
+            )
             return False
         self.bt[s, held: held + need] = pages
+        self._oom_episode = False  # pressure episode over: pages flowed
         return True
 
     # ---- scheduling loop -------------------------------------------------
@@ -1138,6 +1427,30 @@ class ContinuousScheduler:
                 _LOG.info("drain complete: engine loop exiting")
                 return
             if idle:
+                if self._profile_active is not None:
+                    # Traffic drained mid-capture: close the capture
+                    # NOW with the windows collected so far (an idle
+                    # loop would otherwise leave the process-global
+                    # profiler recording forever and every later
+                    # capture failing at start — and the requester
+                    # hanging to its timeout for steps that will
+                    # never come).
+                    act, self._profile_active = (
+                        self._profile_active, None
+                    )
+                    holder = act["holder"]
+                    if act["windows"]:
+                        holder["result"] = self.profiler.finish_capture(
+                            act["windows"]
+                        )
+                    else:
+                        self.profiler.abort()
+                        holder["result"] = {
+                            "error": "engine went idle before any "
+                            "dispatch was captured (profiling needs "
+                            "live traffic)",
+                        }
+                    holder["done"].set()
                 # The degraded ladder must keep decaying while idle —
                 # mode 3 sheds load, so "no traffic" is exactly when
                 # it has to walk itself back down (called OUTSIDE the
@@ -1156,6 +1469,19 @@ class ContinuousScheduler:
             # dies — exactly what the API server's supervisor exists
             # to catch and restart).
             faults.fault_point("engine_crash")
+            # Adopt a parked /debug/profile request only when there is
+            # work to dispatch (an idle engine would leave the
+            # profiler running against nothing until the requester's
+            # timeout).
+            with self._cond:
+                take = (
+                    self._profile_pending
+                    if self._profile_active is None else None
+                )
+                if take is not None:
+                    self._profile_pending = None
+            if take is not None:
+                self._adopt_profile(take)
             try:
                 self._update_degraded()
                 self._enforce_deadlines()
@@ -1206,7 +1532,9 @@ class ContinuousScheduler:
                 # The failed dispatch may have CONSUMED the donated page
                 # pool (donate_argnames=kv_pages): rebuild it so the
                 # engine keeps serving new traffic instead of erroring
-                # forever on a deleted array.
+                # forever on a deleted array. A capture straddling the
+                # failure is discarded the same way.
+                self._abort_profile()
                 self._reset_pool()
 
     def _reject_queued(
@@ -1301,6 +1629,14 @@ class ContinuousScheduler:
             ["normal", "prefix cache shed", "max_tokens clamped",
              "shedding load"][mode],
         )
+        if mode > prev:
+            # An escalation is a capacity incident in progress: capture
+            # the same forensic record an OOM gets, while the pressure
+            # that drove the SLO breach is still visible in the pool.
+            self._capture_oom(
+                "degraded_escalation",
+                f"degraded mode {prev} -> {mode}",
+            )
         if mode >= 1 and not self._cache_shed:
             # Shed the prefix cache: free its pages for live requests
             # and stop feeding it until the ladder fully clears.
@@ -1510,11 +1846,23 @@ class ContinuousScheduler:
                 exclude=[int(p) for p in pages[:full]]
             )
         if total_need - full > avail:
+            # Admission-side twin of the _grow_slot shortfall: the
+            # head cannot fit even with every evictable cache page —
+            # same one-capture-per-episode forensic contract.
+            if not self._oom_episode:
+                self._oom_episode = True
+                self._capture_oom(
+                    "pool_pressure",
+                    f"admission shortfall: prompt needs "
+                    f"{total_need - full} fresh page(s), "
+                    f"{avail} coverable",
+                    asking=(s, req, total_need - full),
+                )
             return False
         if cache_on:
             if full:
                 share = [int(p) for p in pages[:full]]
-                self.allocator.share(share)
+                self.allocator.share(share, owner=self._owner_tag(req))
                 self.bt[s, :full] = share
             if use - full * ps > 0:
                 # The suffix prefill starts MID-page: the cache (and
@@ -1522,8 +1870,14 @@ class ContinuousScheduler:
                 # writer gets its own copy (COW) — or, when no page is
                 # free for the copy, simply recomputes the partial page.
                 try:
-                    cow = self.allocator.alloc(1)[0]
-                except paged_kv.OutOfPagesError:
+                    cow = self.allocator.alloc(
+                        1, owner=self._owner_tag(req)
+                    )[0]
+                except paged_kv.OutOfPagesError as e:
+                    self._capture_oom(
+                        "oom", f"COW alloc: {type(e).__name__}: {e}",
+                        asking=(s, req, 1),
+                    )
                     use = full * ps
                 else:
                     self.kv_pages = paged_kv.copy_pages(
@@ -1535,8 +1889,8 @@ class ContinuousScheduler:
             spliced = use
         req.spliced = spliced
         req.prefill_pos = spliced
-        if not self._grow_slot(s, req.length + self._win):
-            self._free_slot_pages(s)
+        if not self._grow_slot(s, req.length + self._win, req=req):
+            self._free_slot_pages(s, owner=self._owner_tag(req))
             req.spliced = 0
             req.prefill_pos = 0
             return False
@@ -1654,7 +2008,9 @@ class ContinuousScheduler:
             "prefill", slot=s, start=off, tokens=end - off,
             cached=req.spliced > 0, replay=req.replay > 0,
         )
+        sampled = self._profile_dispatch_begin()
         t0 = time.monotonic()
+        t0_ns = trace_lib.now_ns()
         with self.pipe._mesh_scope():
             kv, tok0, key = generate_lib.paged_prefill(
                 self.pipe.params["llm"], self.cfg.llm,
@@ -1691,6 +2047,9 @@ class ContinuousScheduler:
         self._timeline_record(
             dur_s=time.monotonic() - t0, kind="prefill",
             rows=end - off, accepted=0,
+            device_us=self._profile_dispatch_end(
+                sampled, "prefill", t0_ns
+            ),
         )
         if self.watchdog is not None:
             # A completed prefill chunk is progress too — without this,
@@ -1823,6 +2182,75 @@ class ContinuousScheduler:
         self.metrics.inc("evicted")
         self._occupancy_gauge()
 
+    # ---- device-time sampling (utils/profiling.DeviceTimeSampler) --------
+
+    def _abort_profile(self) -> None:
+        """Containment: a failed dispatch (or engine restart) may have
+        left a capture — periodic or on-demand — straddling the
+        failure. Stop and discard it so the process-global profiler
+        stays usable, and answer any waiting /debug/profile requester
+        with an error instead of a hang."""
+        self.profiler.abort()
+        act, self._profile_active = self._profile_active, None
+        if act is not None:
+            act["holder"]["result"] = {
+                "error": "engine step failed during the capture",
+            }
+            act["holder"]["done"].set()
+
+    def _adopt_profile(self, holder: dict[str, Any]) -> None:
+        """Engine thread: begin an on-demand capture spanning the next
+        `steps` dispatches. A profiler that cannot start answers the
+        requester immediately (counted error, engine untouched)."""
+        if self.profiler.begin():
+            self._profile_active = {
+                "holder": holder,
+                "left": int(holder["steps"]),
+                "windows": [],
+            }
+        else:
+            holder["result"] = {
+                "error": "profiler start failed (see "
+                "oryx_profile_capture_errors_total)",
+            }
+            holder["done"].set()
+
+    def _profile_dispatch_begin(self) -> bool:
+        """Immediately before a dispatch: True when THIS dispatch is a
+        periodic device-time sample (capture started). The step
+        counter advances every dispatch; steps inside an on-demand
+        capture are never double-captured (jax's profiler is
+        process-global) — their windows are recorded in
+        _profile_dispatch_end instead."""
+        due = self.profiler.tick()
+        if self._profile_active is not None:
+            return False
+        return due and self.profiler.begin()
+
+    def _profile_dispatch_end(self, sampled: bool, kind: str,
+                              t0_ns: int) -> int | None:
+        """After the dispatch's harvest sync: close a periodic sample
+        (returns the window's device microseconds for the timeline
+        record) or advance the on-demand capture by one window,
+        finishing it — and answering the requester — when the asked
+        step count is reached."""
+        t1_ns = trace_lib.now_ns()
+        act = self._profile_active
+        if act is not None:
+            act["windows"].append((kind, t0_ns, t1_ns))
+            act["left"] -= 1
+            if act["left"] <= 0:
+                self._profile_active = None
+                holder = act["holder"]
+                holder["result"] = self.profiler.finish_capture(
+                    act["windows"]
+                )
+                holder["done"].set()
+            return None
+        if sampled:
+            return self.profiler.end(kind, t0_ns, t1_ns)
+        return None
+
     # hot-path
     def _step_chunk(self) -> None:
         # Chaos site: decode dispatch failure (raise -> every in-flight
@@ -1834,6 +2262,7 @@ class ContinuousScheduler:
         # held would serialize submit()/scrapes/debug reads on device
         # latency — the runtime twin of the static hot-path rule.
         hot_dispatch("scheduler._step_chunk")
+        sampled = self._profile_dispatch_begin()
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
         with self.pipe._mesh_scope():
@@ -1858,16 +2287,19 @@ class ContinuousScheduler:
             tok, lengths, finished, recent, toks, fin
         )
         dt = time.monotonic() - t0
+        dev_us = self._profile_dispatch_end(sampled, "decode", t0_ns)
         live = [
             s for s, r in enumerate(self.slots)
             if r is not None and r.activated
         ]
-        self._finish_dispatch("decode", len(live), live, toks, t0_ns, dt)
+        self._finish_dispatch(
+            "decode", len(live), live, toks, t0_ns, dt, device_us=dev_us
+        )
         self._occupancy_gauge()
 
     def _finish_dispatch(
         self, kind: str, rows: int, live: list[int], toks, t0_ns, dt,
-        n_new=None,
+        n_new=None, device_us=None,
     ) -> None:
         """Post-dispatch accounting shared by the split decode chunk,
         the fused ragged step and the speculative step — ONE definition
@@ -1949,10 +2381,12 @@ class ContinuousScheduler:
         self._timeline_record(
             dur_s=dt, kind=kind, rows=rows,
             accepted=emitted if n_new is not None else useful,
+            device_us=device_us,
         )
 
     def _timeline_record(self, *, dur_s: float, kind: str, rows: int,
-                         accepted: int) -> None:
+                         accepted: int,
+                         device_us: int | None = None) -> None:
         """One step record into the engine flight data recorder
         (utils/timeline.py). Engine thread only; the queue-depth and
         degraded-mode reads go through the metrics registry's own
@@ -1967,6 +2401,7 @@ class ContinuousScheduler:
             queue_depth=int(self.metrics.get("queue_depth")),
             free_pages=self.allocator.num_free,
             degraded_mode=int(self.metrics.get("degraded_mode")),
+            device_us=device_us,
         )
 
     # hot-path
@@ -2095,6 +2530,7 @@ class ContinuousScheduler:
             # constant blank operands were built once at construction.
             pfw = 0
             pf_args = self._ragged_blanks
+        sampled = self._profile_dispatch_begin()
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
         if self.speculate:
@@ -2129,6 +2565,7 @@ class ContinuousScheduler:
                 tok, lengths, finished, toks, n_new, acc
             )
             dt = time.monotonic() - t0
+            dev_us = self._profile_dispatch_end(sampled, "spec", t0_ns)
             if live:
                 self.metrics.inc(
                     "draft_proposed_total", int(dlen[live].sum())
@@ -2140,7 +2577,8 @@ class ContinuousScheduler:
                 min(pfw, pf_len - pf_off) if pf_req is not None else 0
             )
             self._finish_dispatch(
-                "spec", rows, live, toks, t0_ns, dt, n_new=n_new
+                "spec", rows, live, toks, t0_ns, dt, n_new=n_new,
+                device_us=dev_us,
             )
         else:
             with self.pipe._mesh_scope():
@@ -2167,13 +2605,16 @@ class ContinuousScheduler:
                 tok, lengths, finished, recent, toks, fin
             )
             dt = time.monotonic() - t0
+            dev_us = self._profile_dispatch_end(sampled, "ragged", t0_ns)
             # Decode billing covers only slots live DURING the dispatch
             # — a slot activated below joins the next dispatch, and its
             # toks row this time was frozen filler.
             rows = len(live) + (
                 min(W, pf_len - pf_off) if pf_req is not None else 0
             )
-            self._finish_dispatch("ragged", rows, live, toks, t0_ns, dt)
+            self._finish_dispatch(
+                "ragged", rows, live, toks, t0_ns, dt, device_us=dev_us
+            )
         # Prefill bookkeeping + activation (after harvest by design).
         if pf_req is not None:
             pf_req.trace.end(pf_span)

@@ -1,0 +1,79 @@
+"""OOM forensics: a bounded ring of memory-pressure incident records.
+
+An `OutOfPagesError` is designed to be survivable — the scheduler
+defers, evicts or recomputes and the client never sees it — which is
+exactly why capacity incidents have been UNDIAGNOSABLE after the fact:
+by the time an operator looks, the pool has recovered and the only
+residue is a counter. This module is the flight recorder for that
+moment: every OOM (and every degraded-mode escalation) captures one
+bounded record — pool-state summary (utils/pagemap.summarize), the
+top-K resident requests by pages held with their in-flight cost
+ledgers, the prefix cache's LRU tail, and the engine step-timeline
+tail — so `GET /debug/oom?n=` replays the incident from one artifact.
+
+The scheduler is the only writer (captures happen on the engine
+thread, at the catch site, while the state that caused the pressure is
+still live); debug-endpoint threads read snapshots. One leaf lock
+(`forensics._lock`, declared in oryx_tpu/concurrency.py) guards the
+ring — held only for the append/copy, never across capture assembly.
+
+Dependency-free stdlib, like utils/timeline.py.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any
+
+from oryx_tpu.analysis.sanitizers import named_lock
+
+# Residents / cache entries retained per record: enough to name the
+# pressure sources, bounded so a record is always one readable screen.
+TOP_K = 8
+
+
+class ForensicRing:
+    """Bounded newest-last ring of forensic records (see module
+    docstring). `append` returns the record's monotone index — the
+    join key the oom_pressure wide event carries."""
+
+    def __init__(self, keep: int = 64):
+        self._lock = named_lock("forensics._lock")
+        self._ring: deque[dict[str, Any]] = deque(  # guarded-by: _lock
+            maxlen=max(1, int(keep))
+        )
+        self._total = 0  # guarded-by: _lock
+
+    def append(self, record: dict[str, Any]) -> int:
+        """Record one incident; stamps ts_unix_s/index when absent and
+        returns the monotone index."""
+        with self._lock:
+            idx = self._total
+            record.setdefault("ts_unix_s", time.time())
+            record["index"] = idx
+            self._ring.append(record)
+            self._total += 1
+        return idx
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def snapshot(self, n: int | None = None) -> list[dict[str, Any]]:
+        """Newest-first copies of the retained records (last `n` when
+        given)."""
+        with self._lock:
+            records = list(self._ring)
+        if n is not None:
+            records = records[-max(0, int(n)):]
+        return [dict(r) for r in reversed(records)]
+
+    def to_dict(self, n: int | None = None) -> dict[str, Any]:
+        """The /debug/oom response body (minus the engine label the
+        server adds)."""
+        return {
+            "total": self.total,
+            "records": self.snapshot(n),
+        }

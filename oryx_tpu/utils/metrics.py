@@ -460,6 +460,13 @@ REQUEST_TOKEN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
 REQUEST_SECONDS_BUCKETS = TTFT_BUCKETS + (120.0, 300.0)
 PAGE_SECONDS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                         5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+# One page's whole tenancy (alloc -> refcount-0 free) and its idle
+# tail, fed at free time by the allocator's observer hook
+# (utils/pagemap.PoolObservatory): sub-chunk holds through minutes of
+# cache residency. The oryx_page_{lifetime,idle}_seconds ladders.
+PAGE_LIFETIME_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0,
+                         2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                         600.0)
 
 # The canonical per-request cost-ledger keys: what the scheduler writes
 # into handle.debug["cost"] / the trace meta at every terminal state,
@@ -469,6 +476,12 @@ PAGE_SECONDS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 REQUEST_COST_KEYS = (
     "prefill_tokens", "cached_tokens", "decode_steps", "decode_tokens",
     "page_seconds", "queue_s", "prefill_s", "decode_s", "e2e_s",
+    # HBM high-water mark: the most pages the request held at once,
+    # and the page-seconds it had accumulated when it reached that
+    # peak — together they say whether a request's HBM cost was a
+    # short spike or a long plateau (docs/OBSERVABILITY.md "Memory &
+    # device time").
+    "peak_pages", "peak_page_seconds",
 )
 
 # The canonical wide-event schema: every field a terminal request's
@@ -496,6 +509,32 @@ REQUEST_EVENT_KEYS = REQUEST_COST_KEYS + (
     "streaming",
     "evictions",                 # replay re-admissions this request paid
     "accepted_tokens_per_step",  # speculation yield, null off spec
+)
+
+# The memory-pressure wide-event schema: one flat event per
+# OutOfPagesError / degraded-mode escalation, emitted through the same
+# request-log sink (kind distinguishes it from request events; the
+# full forensic record — top-K residents, cache LRU, timeline tail —
+# lives in the bounded ring utils/forensics.py serves at /debug/oom,
+# this event is the greppable one-liner in requests.jsonl). Declared
+# next to REQUEST_EVENT_KEYS for the same reason: one source of truth
+# for sink validation.
+OOM_EVENT_KEYS = (
+    "schema", "ts_unix_s",
+    "kind",                  # always "oom_pressure"
+    "trigger",               # oom (an allocation raised) |
+                             # pool_pressure (free-list shortfall
+                             # episode, defer/evict path) |
+                             # degraded_escalation (SLO ladder moved)
+    "detail",                # the OutOfPagesError text / ladder step
+    "engine", "replica",
+    "degraded_mode",
+    "queue_depth", "live_slots",
+    "free_pages", "slot_pages", "cache_pages", "shared_pages",
+    "fragmentation_ratio",
+    "top_request_id",        # largest resident by pages held
+    "top_request_pages",
+    "forensic_index",        # index of the full record in /debug/oom
 )
 
 
@@ -651,7 +690,8 @@ def register_process_collector(reg: Registry) -> None:
     reg.register_collector(collect)
 
 
-def register_device_memory_collector(reg: Registry) -> None:
+def register_device_memory_collector(reg: Registry,
+                                     ttl_s: float = 1.0) -> None:
     """Device (HBM) telemetry at scrape time, shared by train and serve:
 
       hbm_live_bytes   — sum of nbytes over `jax.live_arrays()`: what
@@ -662,13 +702,26 @@ def register_device_memory_collector(reg: Registry) -> None:
                          (absent on backends that don't expose it, e.g.
                          CPU and the axon remote transport — those
                          gauges then hold 0 while live_bytes stays
-                         real)."""
+                         real).
+
+    Rate-limited: `jax.live_arrays()` walks EVERY live array, so an
+    aggressive scraper (or the router's aggregation fan-out) would
+    otherwise pay O(live arrays) per scrape. Refreshes at most once
+    per `ttl_s` (monotonic clock; 0 disables the cache) — scrapes
+    inside the window re-serve the last values, which for gauges whose
+    truth changes per engine step is indistinguishable from a
+    marginally earlier scrape."""
     live = reg.gauge("hbm_live_bytes")
     in_use = reg.gauge("hbm_bytes_in_use")
     peak = reg.gauge("hbm_peak_bytes")
     limit = reg.gauge("hbm_limit_bytes")
+    last = [float("-inf")]
 
     def collect() -> None:
+        now = time.monotonic()
+        if ttl_s and now - last[0] < ttl_s:
+            return
+        last[0] = now
         live.set(sum(
             getattr(a, "nbytes", 0) for a in jax.live_arrays()
         ))

@@ -1,0 +1,192 @@
+"""Page-pool observatory: derived views over PageAllocator ownership.
+
+The paged KV pool is the scarcest serving resource (concurrent users
+per chip is bounded by HBM bytes per KV token — the framing of the
+Gemma-on-TPU serving comparison, PAPERS.md arXiv 2605.25645), and
+until this module its live state was invisible: the allocator knew
+refcounts, the scheduler knew block tables, the prefix cache knew its
+entries, and no surface showed WHO holds WHICH page, for how long, or
+how churned the free list is. This module is that surface's math:
+
+  * ``fragmentation_ratio`` — largest contiguous free-page-id run over
+    total free pages. Block tables indirect every access, so physical
+    contiguity never gates correctness; what the ratio measures is
+    free-list CHURN under the slot-growth pattern (LIFO recycling keeps
+    a healthy pool near 1.0 — page ids hand back in runs; a pool
+    shredded by interleaved grow/evict/cache-churn trends toward
+    1/free). A falling ratio with stable occupancy is the signature of
+    eviction thrash, not capacity pressure — see docs/OBSERVABILITY.md.
+  * ``summarize`` — one dict from ``PageAllocator.snapshot()``: state
+    counts (free/slot/cache/shared partition the pool), fragmentation,
+    tenancy-age and idle quantiles of resident pages. The same
+    implementation feeds ``GET /debug/pages?format=summary``, the OOM
+    forensic records (utils/forensics.py) and the loadgen memory block.
+  * ``PoolObservatory`` — the metrics bridge: raw-named
+    ``oryx_pool_{free,slot,cache,shared}_pages`` +
+    ``oryx_pool_size_pages`` + ``oryx_pool_min_free_pages`` gauges and
+    ``oryx_pool_fragmentation_ratio``, refreshed by a scrape-time
+    collector, plus the free-time ``oryx_page_lifetime_seconds`` /
+    ``oryx_page_idle_seconds`` histograms the allocator feeds through
+    its ``observer`` hook the moment a page's refcount reaches 0.
+
+Dependency-free except for the shared metrics helpers; never imports
+jax.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from oryx_tpu.utils.metrics import (
+    PAGE_LIFETIME_BUCKETS,
+    Registry,
+    sample_quantile,
+)
+
+
+def fragmentation_ratio(free_pages: list[int], num_free: int | None = None
+                        ) -> float:
+    """Largest contiguous run of free page IDS over the free total.
+
+    `free_pages` must be sorted ascending (PageAllocator.snapshot's
+    `free_pages` field is). 1.0 = unfragmented (one run, or an empty
+    free list — nothing to fragment); the floor is 1/num_free (every
+    free page an island)."""
+    n = len(free_pages) if num_free is None else num_free
+    if n <= 0:
+        return 1.0
+    best = run = 1
+    for prev, cur in zip(free_pages, free_pages[1:]):
+        run = run + 1 if cur == prev + 1 else 1
+        best = max(best, run)
+    return round(best / n, 6)
+
+
+def _quantiles(values: list[float]) -> dict[str, float | None]:
+    if not values:
+        return {"n": 0, "p50": None, "p95": None, "max": None}
+    return {
+        "n": len(values),
+        "p50": round(sample_quantile(values, 0.5), 6),
+        "p95": round(sample_quantile(values, 0.95), 6),
+        "max": round(max(values), 6),
+    }
+
+
+def summarize(snapshot: dict) -> dict[str, Any]:
+    """Derived summary of one ``PageAllocator.snapshot()``: the state
+    partition (free + slot + cache + shared == num_pages — the
+    reconciliation invariant scripts/check_serving_endpoints.py gates),
+    fragmentation, peak occupancy since boot, and resident-page
+    age/idle quantiles."""
+    counts = {"free": 0, "slot": 0, "cache": 0, "shared": 0}
+    ages: list[float] = []
+    idles: list[float] = []
+    for rec in snapshot["pages"]:
+        counts[rec["state"]] += 1
+        if rec["age_s"] is not None:
+            ages.append(rec["age_s"])
+        if rec["idle_s"] is not None:
+            idles.append(rec["idle_s"])
+    total = snapshot["num_pages"]
+    return {
+        "num_pages": total,
+        "page_size": snapshot["page_size"],
+        **counts,
+        "reconciled": sum(counts.values()) == total,
+        "peak_pages_in_use": total - snapshot["min_free"],
+        "fragmentation_ratio": fragmentation_ratio(
+            snapshot["free_pages"], snapshot["num_free"]
+        ),
+        "resident_age_s": _quantiles(ages),
+        "resident_idle_s": _quantiles(idles),
+    }
+
+
+class PoolObservatory:
+    """Registry bridge for one engine's page pool.
+
+    Construct ONCE per scheduler (families may not be re-declared);
+    the allocator is read through ``allocator_fn`` so pool rebuilds
+    (`_reset_pool`, supervisor restart) are followed automatically —
+    re-``attach`` each fresh allocator so free-time histograms keep
+    flowing. The scrape-time collector reads only the allocator's own
+    plain lists (best-effort under a live engine, exact quiesced —
+    the same contract as ``PageAllocator.snapshot``), and is
+    TTL-rate-limited like the HBM collector: the walk is O(num_pages)
+    plus a free-list sort, and the router's aggregation fan-out
+    would otherwise pay it per replica per scrape. Consumers that
+    need exactness (``scheduler.pool_snapshot`` — the /debug/pages
+    reconciliation surface) force a refresh."""
+
+    def __init__(self, registry: Registry,
+                 allocator_fn: Callable[[], Any],
+                 ttl_s: float = 1.0):
+        self._allocator_fn = allocator_fn
+        self._ttl_s = ttl_s
+        self._last = float("-inf")
+        self._free = registry.gauge("oryx_pool_free_pages", raw_name=True)
+        self._slot = registry.gauge("oryx_pool_slot_pages", raw_name=True)
+        self._cache = registry.gauge(
+            "oryx_pool_cache_pages", raw_name=True
+        )
+        self._shared = registry.gauge(
+            "oryx_pool_shared_pages", raw_name=True
+        )
+        self._size = registry.gauge("oryx_pool_size_pages", raw_name=True)
+        self._min_free = registry.gauge(
+            "oryx_pool_min_free_pages", raw_name=True
+        )
+        self._frag = registry.gauge(
+            "oryx_pool_fragmentation_ratio", raw_name=True
+        )
+        self._lifetime = registry.histogram(
+            "oryx_page_lifetime_seconds", PAGE_LIFETIME_BUCKETS,
+            raw_name=True,
+        )
+        self._idle = registry.histogram(
+            "oryx_page_idle_seconds", PAGE_LIFETIME_BUCKETS,
+            raw_name=True,
+        )
+        registry.register_collector(self.collect)
+        self.collect()
+
+    def attach(self, allocator) -> None:
+        """Point the allocator's free-time telemetry here (call again
+        after every pool rebuild — a fresh allocator starts with
+        ``observer=None``). Forces a refresh: gauges must never keep
+        reporting the dead pool."""
+        allocator.observer = self
+        self.collect(force=True)
+
+    def page_freed(self, lifetime_s: float, idle_s: float) -> None:
+        """Allocator callback at refcount 0: one page's whole tenancy
+        (alloc → last free) and its idle tail (last ref transition →
+        free) land in the histograms."""
+        self._lifetime.observe(max(0.0, lifetime_s))
+        self._idle.observe(max(0.0, idle_s))
+
+    def collect(self, force: bool = False) -> None:
+        """Refresh the oryx_pool_* gauges from the live allocator
+        (registered as a scrape-time collector). Rate-limited to one
+        walk per ``ttl_s`` (0 disables the cache); ``force`` bypasses
+        it — the /debug/pages path forces so its summary and the
+        gauges always agree on a quiesced engine."""
+        now = time.monotonic()
+        if not force and self._ttl_s and now - self._last < self._ttl_s:
+            return
+        self._last = now
+        alloc = self._allocator_fn()
+        if alloc is None:
+            return
+        counts = {"free": 0, "slot": 0, "cache": 0, "shared": 0}
+        for p in range(alloc.num_pages):
+            counts[alloc.classify(alloc._refs[p], alloc._owners[p])] += 1
+        self._free.set(counts["free"])
+        self._slot.set(counts["slot"])
+        self._cache.set(counts["cache"])
+        self._shared.set(counts["shared"])
+        self._size.set(alloc.num_pages)
+        self._min_free.set(alloc.min_free)
+        self._frag.set(fragmentation_ratio(sorted(alloc._free)))

@@ -23,6 +23,19 @@ def start_server(port: int = 9999) -> None:
     jax.profiler.start_server(port)
 
 
+def _start_trace(logdir: str, *, host_tracer_level: int = 2) -> None:
+    """jax.profiler.start_trace with the ProfileOptions fallback —
+    newer jax takes options, older versions take none and default to
+    host tracing on; one helper so every capture path (the trace()
+    context manager, the continuous DeviceTimeSampler) shares it."""
+    if hasattr(jax.profiler, "ProfileOptions"):
+        opts = jax.profiler.ProfileOptions()
+        opts.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=opts)
+    else:
+        jax.profiler.start_trace(logdir)
+
+
 @contextlib.contextmanager
 def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
     """Capture a trace viewable in TensorBoard/Perfetto.
@@ -30,12 +43,7 @@ def trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
     ProfileOptions only exists on newer jax; older versions take no
     options and default to host tracing on — fall back rather than
     making every profile capture version-locked."""
-    if hasattr(jax.profiler, "ProfileOptions"):
-        opts = jax.profiler.ProfileOptions()
-        opts.host_tracer_level = host_tracer_level
-        jax.profiler.start_trace(logdir, profiler_options=opts)
-    else:
-        jax.profiler.start_trace(logdir)
+    _start_trace(logdir, host_tracer_level=host_tracer_level)
     try:
         yield
     finally:
@@ -114,6 +122,240 @@ def op_profile(
         xplane.top_ops(host, n=top_n), "host_fallback", files[-1], names,
         t_start, t_end,
     )
+
+
+# ---------------------------------------------------------------------------
+# Continuous device-time attribution (docs/OBSERVABILITY.md "Memory &
+# device time")
+# ---------------------------------------------------------------------------
+
+# The dispatch kinds the serving engine emits (utils/timeline.py) plus
+# the "other" bucket for capture time outside every window.
+DISPATCH_KINDS = ("ragged", "spec", "prefill", "decode", "other")
+
+
+def attribute_capture(
+    planes, windows: list[tuple[str, int, int]],
+    session_end_ns: int = 0,
+) -> dict:
+    """Pure attribution of one parsed capture onto labeled host
+    windows: per-label busy microseconds (interval union on the
+    busiest execution line, clipped per window — in-window time can
+    never exceed the window), plus "other" (capture busy time outside
+    every window) and the provenance source. TPU device planes ('XLA
+    Ops' lines) are preferred; without them the host-event fallback
+    measures python/dispatch time ('Modules' aggregate lines excluded)
+    — same convention as op_profile, and the source says which you
+    got. Unit-tested against synthetic planes (tests/test_device_time
+    .py); DeviceTimeSampler feeds it live captures."""
+    from oryx_tpu.utils import xplane
+
+    # Precompute the busiest line's merged spans ONCE; each window is
+    # then a cheap clip — an on-demand capture may carry hundreds of
+    # windows and this runs on the engine thread.
+    spans = xplane.busiest_line_spans(
+        planes, plane_filter="TPU", line_filter="Ops",
+        session_end_ns=session_end_ns,
+    )
+    source = "tpu_xla_ops"
+    if not spans:
+        spans = xplane.busiest_line_spans(
+            planes, line_exclude="Modules",
+            session_end_ns=session_end_ns,
+        )
+        source = "host_fallback"
+    out: dict = {"by_kind_us": {}, "other_us": 0, "source": source}
+    windowed = 0
+    for label, t0, t1 in windows:
+        busy = xplane.clipped_us(spans, t0, t1)
+        out["by_kind_us"][label] = out["by_kind_us"].get(label, 0) + busy
+        windowed += busy
+    total_busy = sum(e - s for s, e in spans) // 1000
+    out["other_us"] = max(0, total_busy - windowed)
+    return out
+
+
+class DeviceTimeSampler:
+    """Always-on sampled device-time attributor for the serving engine.
+
+    Every N engine steps (``every``; 0 = off) the scheduler brackets
+    ONE dispatch in a ``jax.profiler`` capture to a private temp dir,
+    and the capture's busy time inside the dispatch window lands on
+    ``oryx_device_time_seconds_total{kind=}`` (the window's dispatch
+    kind; capture busy time outside the window goes to kind="other")
+    with the sampled wall window on
+    ``oryx_profile_sampled_wall_seconds_total{kind=}`` — so
+    device/wall per kind is a ratio of two counters scraped together.
+    The same begin/finish machinery serves the on-demand
+    ``GET /debug/profile?steps=K`` capture (a multi-window capture
+    returning the Perfetto-loadable Chrome trace).
+
+    Failure contract (the satellite bar): a capture that cannot start,
+    stop, parse or attribute increments
+    ``oryx_profile_capture_errors_total{stage=}`` and the engine step
+    proceeds untouched — sampling may lose a sample, never a token.
+    Profiling never alters the computation: the dispatch itself is
+    byte-identical sampled or not (gated by tests/test_device_time.py).
+
+    Engine-thread-owned; one sampler per engine, but jax's profiler is
+    process-global — a concurrent capture elsewhere in the process
+    surfaces as a counted stage="start" error, not a crash."""
+
+    def __init__(self, registry=None, *, every: int = 0):
+        self.every = max(0, int(every))
+        self._step = 0  # thread-owned: engine
+        self._dir: str | None = None  # thread-owned: engine
+        self._dev = self._wall = self._errs = self._caps = None
+        if registry is not None:
+            self._dev = registry.counter(
+                "oryx_device_time_seconds_total", ("kind",),
+                raw_name=True,
+            )
+            self._wall = registry.counter(
+                "oryx_profile_sampled_wall_seconds_total", ("kind",),
+                raw_name=True,
+            )
+            self._errs = registry.counter(
+                "oryx_profile_capture_errors_total", ("stage",),
+                raw_name=True,
+            )
+            self._caps = registry.counter(
+                "oryx_profile_captures_total", raw_name=True
+            )
+
+    def _err(self, stage: str) -> None:
+        if self._errs is not None:
+            self._errs.labels(stage=stage).inc()
+
+    def tick(self) -> bool:
+        """Advance the engine-step counter; True when THIS step is due
+        a sample (every Nth step; never with every=0)."""
+        self._step += 1
+        return self.every > 0 and self._step % self.every == 0
+
+    def begin(self) -> bool:
+        """Start one capture into a fresh temp dir. False (with the
+        labeled error counted) when the profiler cannot start —
+        callers then run the step unprofiled."""
+        import shutil
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="oryx-devtime-")
+        try:
+            _start_trace(d)
+        except Exception:
+            self._err("start")
+            shutil.rmtree(d, ignore_errors=True)
+            return False
+        self._dir = d
+        return True
+
+    def abort(self) -> None:
+        """Discard an in-flight capture (the dispatch-failure
+        containment path): stop the process-global profiler if this
+        sampler started it and reclaim the temp dir, reporting
+        nothing. Without this, a dispatch exception between begin()
+        and end() would leave the profiler running forever and every
+        later capture failing at start."""
+        import shutil
+
+        d, self._dir = self._dir, None
+        if d is None:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            self._err("stop")
+        shutil.rmtree(d, ignore_errors=True)
+
+    def _stop_and_parse(self):
+        """Stop the in-flight capture and parse its xplane file;
+        returns (planes, session_end_ns) or None with the stage
+        counted. Always reclaims the temp dir."""
+        import shutil
+
+        from oryx_tpu.utils import trace as trace_lib
+        from oryx_tpu.utils import xplane
+
+        d, self._dir = self._dir, None
+        try:
+            jax.profiler.stop_trace()
+            end_ns = trace_lib.now_ns()
+        except Exception:
+            self._err("stop")
+            shutil.rmtree(d, ignore_errors=True)
+            return None
+        try:
+            files = xplane.find_xplane_files(d)
+            if not files:
+                raise RuntimeError(f"no xplane.pb written under {d}")
+            planes = xplane.parse_xspace(files[-1])
+        except Exception:
+            self._err("parse")
+            return None
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        return planes, end_ns
+
+    def _credit(self, att: dict, windows) -> None:
+        if self._dev is None:
+            return
+        for kind, us in att["by_kind_us"].items():
+            if us:
+                self._dev.labels(kind=kind).inc(us / 1e6)
+        if att["other_us"]:
+            self._dev.labels(kind="other").inc(att["other_us"] / 1e6)
+        for kind, t0, t1 in windows:
+            self._wall.labels(kind=kind).inc(max(0, t1 - t0) / 1e9)
+        if self._caps is not None:
+            self._caps.inc()
+
+    def end(self, kind: str, t0_ns: int, t1_ns: int) -> int | None:
+        """Close a per-step sample around one dispatch window: counters
+        fed, temp dir reclaimed; returns the window's device
+        microseconds (the timeline record's device_us field) or None
+        on a counted failure."""
+        parsed = self._stop_and_parse()
+        if parsed is None:
+            return None
+        planes, end_ns = parsed
+        try:
+            att = attribute_capture(
+                planes, [(kind, t0_ns, t1_ns)], session_end_ns=end_ns
+            )
+        except Exception:
+            self._err("attribute")
+            return None
+        self._credit(att, [(kind, t0_ns, t1_ns)])
+        return att["by_kind_us"].get(kind, 0)
+
+    def finish_capture(self, windows: list[tuple[str, int, int]]) -> dict:
+        """Close an on-demand multi-step capture: the /debug/profile
+        response — Perfetto-loadable Chrome trace + per-kind
+        attribution over the captured dispatch windows. Errors come
+        back as {"error": ...} (and the stage counter), never raised
+        into the engine loop."""
+        from oryx_tpu.utils import xplane
+
+        parsed = self._stop_and_parse()
+        if parsed is None:
+            return {"error": "profile capture failed (see "
+                    "oryx_profile_capture_errors_total)"}
+        planes, end_ns = parsed
+        try:
+            att = attribute_capture(planes, windows,
+                                    session_end_ns=end_ns)
+            body = xplane.chrome_trace(planes)
+        except Exception as e:
+            self._err("attribute")
+            return {"error": f"profile attribution failed: "
+                    f"{type(e).__name__}: {e}"}
+        self._credit(att, windows)
+        body["steps"] = len(windows)
+        body["device_time_us"] = att["by_kind_us"]
+        body["other_us"] = att["other_us"]
+        body["source"] = att["source"]
+        return body
 
 
 class StepTimer:

@@ -40,7 +40,7 @@ from collections import deque
 from typing import Any
 
 from oryx_tpu.analysis.sanitizers import named_lock
-from oryx_tpu.utils.metrics import REQUEST_EVENT_KEYS
+from oryx_tpu.utils.metrics import OOM_EVENT_KEYS, REQUEST_EVENT_KEYS
 
 # The current wide-event schema version, stamped into every event so
 # offline consumers can dispatch on it when fields are added.
@@ -48,6 +48,12 @@ EVENT_SCHEMA = 1
 
 _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _KEYSET = frozenset(REQUEST_EVENT_KEYS)
+# Non-request wide events share the sink but carry their own declared
+# schema, dispatched on the `kind` field ("kind" is deliberately NOT a
+# request-event key, so a request event can never be mistaken for one).
+_KIND_KEYSETS = {
+    "oom_pressure": frozenset(OOM_EVENT_KEYS),
+}
 
 
 def build_request_event(**fields: Any) -> dict[str, Any]:
@@ -67,6 +73,30 @@ def build_request_event(**fields: Any) -> dict[str, Any]:
             "registry) or fix the name"
         )
     ev: dict[str, Any] = {"schema": EVENT_SCHEMA, "ts_unix_s": time.time()}
+    ev.update(fields)
+    return ev
+
+
+def build_oom_event(**fields: Any) -> dict[str, Any]:
+    """Assemble one memory-pressure wide event (`kind="oom_pressure"`),
+    validated against utils.metrics.OOM_EVENT_KEYS — the flat one-line
+    spelling of a forensic record (utils/forensics.py holds the full
+    artifact; `forensic_index` joins the two). Same loud-failure
+    contract as build_request_event."""
+    bad = sorted(
+        k for k in fields
+        if k not in _KIND_KEYSETS["oom_pressure"] or not _SNAKE_RE.match(k)
+    )
+    if bad:
+        raise ValueError(
+            f"undeclared oom-event field(s) {bad}: add them to "
+            "utils.metrics.OOM_EVENT_KEYS (the memory-pressure schema "
+            "registry) or fix the name"
+        )
+    ev: dict[str, Any] = {
+        "schema": EVENT_SCHEMA, "ts_unix_s": time.time(),
+        "kind": "oom_pressure",
+    }
     ev.update(fields)
     return ev
 
@@ -97,14 +127,18 @@ class RequestLog:
             self._f = open(self.path, "a")
 
     def append(self, event: dict[str, Any]) -> None:
-        """Record one event (normally built by build_request_event;
-        re-validated here so a hand-rolled dict can't bypass the
-        registry)."""
-        bad = sorted(k for k in event if k not in _KEYSET)
+        """Record one event (normally built by build_request_event /
+        build_oom_event; re-validated here so a hand-rolled dict can't
+        bypass a registry). The schema is dispatched on `kind`: absent
+        = a request event, "oom_pressure" = the memory-pressure
+        schema."""
+        keyset = _KIND_KEYSETS.get(event.get("kind"), _KEYSET)
+        bad = sorted(k for k in event if k not in keyset)
         if bad:
             raise ValueError(
                 f"undeclared request-event field(s) {bad} "
-                "(utils.metrics.REQUEST_EVENT_KEYS is the schema)"
+                "(utils.metrics.REQUEST_EVENT_KEYS / OOM_EVENT_KEYS "
+                "is the schema)"
             )
         line = json.dumps(event)
         with self._lock:

@@ -44,6 +44,10 @@ STEP_RECORD_KEYS = (
     "queue_depth",      # admission queue depth at the step
     "free_pages",       # allocator free pages at the step
     "degraded_mode",    # degraded-ladder level at the step
+    "device_us",        # device busy time inside the step window, from
+                        # the sampled profiler capture bracketing this
+                        # dispatch (utils/profiling.DeviceTimeSampler);
+                        # null on unsampled steps
 )
 
 
@@ -82,6 +86,7 @@ class StepTimeline:
         queue_depth: int,
         free_pages: int,
         degraded_mode: int,
+        device_us: int | None = None,
         ts_unix_s: float | None = None,
     ) -> None:
         """Append one step record. The dict is built fresh and swapped
@@ -99,6 +104,7 @@ class StepTimeline:
             "queue_depth": int(queue_depth),
             "free_pages": int(free_pages),
             "degraded_mode": int(degraded_mode),
+            "device_us": None if device_us is None else int(device_us),
         }
         self._buf[(n - 1) % self.capacity] = rec
         self._by_kind[kind] = self._by_kind.get(kind, 0) + 1

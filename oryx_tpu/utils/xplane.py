@@ -260,6 +260,157 @@ def _plane_shift_ns(plane: Plane, session_end_ns: int) -> int:
     return session_end_ns - max_end
 
 
+def merge_intervals(
+    intervals: list[tuple[int, int]]
+) -> list[tuple[int, int]]:
+    """Sorted DISJOINT union of [start_ns, end_ns) intervals — busy
+    time, not summed durations, so nested/overlapping events (host
+    python stacks, fused op sub-events) can never count the same wall
+    nanosecond twice."""
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    out = [intervals[0]]
+    for s, e in intervals[1:]:
+        cs, ce = out[-1]
+        if s > ce:
+            out.append((s, e))
+        elif e > ce:
+            out[-1] = (cs, e)
+    return out
+
+
+def clipped_us(merged: list[tuple[int, int]], t0_ns: int,
+               t1_ns: int) -> int:
+    """Microseconds of already-merged intervals inside [t0, t1) — the
+    per-window clip, O(len(merged)), run against one precomputed
+    merge for any number of windows."""
+    total = 0
+    for s, e in merged:
+        lo, hi = max(s, t0_ns), min(e, t1_ns)
+        if hi > lo:
+            total += hi - lo
+    return total // 1000
+
+
+def busiest_line_spans(
+    planes: list[Plane],
+    plane_filter: str = "",
+    line_filter: str = "",
+    line_exclude: str = "",
+    session_end_ns: int = 0,
+) -> list[tuple[int, int]]:
+    """The merged busy intervals (epoch ns) of the BUSIEST matching
+    line — precomputed ONCE per capture; per-window attribution is
+    then a cheap clip (utils/profiling.attribute_capture runs up to
+    hundreds of windows on the engine thread, so a per-window rescan
+    of every event would stall the dispatch loop).
+
+    One line = one execution stream (a TPU core's 'XLA Ops' line, a
+    host thread), so the per-line interval union is genuine busy time
+    and an in-window clip can never exceed the window. Taking the
+    busiest line (rather than summing lines) keeps the host-event
+    fallback honest — host captures carry one line per python thread
+    and summing them would charge idle threads' tracer overhead as
+    device time. Clock alignment follows attribute_device_time: epoch
+    timestamps pass through, relative planes anchor on the file's own
+    profile_start_time stat, else on session_end_ns."""
+    start_anchor = profile_start_time_ns(planes)
+    best: list[tuple[int, int]] = []
+    best_total = 0
+    for plane in planes:
+        if plane_filter and plane_filter not in plane.name:
+            continue
+        relative = any(
+            line.timestamp_ns < _EPOCH_THRESHOLD_NS
+            for line in plane.lines if line.events
+        )
+        shift = 0
+        if relative:
+            shift = start_anchor or _plane_shift_ns(
+                plane, session_end_ns
+            )
+        for line in plane.lines:
+            if line_filter and line_filter not in line.name:
+                continue
+            if line_exclude and line_exclude in line.name:
+                continue
+            base = line.timestamp_ns + shift
+            merged = merge_intervals([
+                (base + ev.offset_ps // 1000,
+                 base + (ev.offset_ps + ev.duration_ps) // 1000)
+                for ev in line.events
+            ])
+            total = sum(e - s for s, e in merged)
+            if total > best_total:
+                best, best_total = merged, total
+    return best
+
+
+def busy_time_us(
+    planes: list[Plane],
+    t0_ns: int,
+    t1_ns: int,
+    plane_filter: str = "",
+    line_filter: str = "",
+    line_exclude: str = "",
+    session_end_ns: int = 0,
+) -> tuple[int, int]:
+    """(busy_us inside [t0_ns, t1_ns), busy_us over the whole capture)
+    on the busiest matching line — the one-window convenience over
+    busiest_line_spans (multi-window callers precompute the spans and
+    clip per window instead)."""
+    merged = busiest_line_spans(
+        planes, plane_filter=plane_filter, line_filter=line_filter,
+        line_exclude=line_exclude, session_end_ns=session_end_ns,
+    )
+    return (
+        clipped_us(merged, t0_ns, t1_ns),
+        sum(e - s for s, e in merged) // 1000,
+    )
+
+
+def chrome_trace(planes: list[Plane], limit: int = 50000) -> dict:
+    """Chrome trace-event JSON from parsed planes — loads directly in
+    Perfetto / chrome://tracing (the GET /debug/profile response body).
+    Planes become processes, lines become threads (named via metadata
+    events); timestamps are each line's own clock in microseconds.
+    `limit` caps the event count so one capture can never produce an
+    unbounded response; the cap is reported when it bites."""
+    events: list[dict] = []
+    truncated = False
+    for pi, plane in enumerate(planes):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pi, "tid": 0,
+            "args": {"name": plane.name or f"plane {pi}"},
+        })
+        for li, line in enumerate(plane.lines):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pi, "tid": li,
+                "args": {"name": line.name or f"line {li}"},
+            })
+            base_us = line.timestamp_ns / 1e3
+            for ev in line.events:
+                if len(events) >= limit:
+                    truncated = True
+                    break
+                events.append({
+                    "name": ev.name, "ph": "X",
+                    "ts": base_us + ev.offset_ps / 1e6,
+                    "dur": max(ev.duration_ps / 1e6, 1e-3),
+                    "pid": pi, "tid": li,
+                })
+            if truncated:
+                break
+        if truncated:
+            break
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "truncated": truncated,
+    }
+
+
 def attribute_device_time(
     planes: list[Plane],
     windows: list[tuple[str, int, int]],

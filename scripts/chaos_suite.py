@@ -222,6 +222,53 @@ def scenario_page_alloc_oom(h: Harness) -> None:
             fail(f"[page_alloc_oom] burst requests failed under "
                  f"injected OOM: {bad}")
         h.assert_triad(srv, base, "page_alloc_oom", ["page_alloc_oom"])
+        # Forensics: every injected OOM must have left one bounded
+        # record in the ring (pool summary reconciled at capture
+        # time, top-K residents named), and the post-incident pool
+        # map must reconcile — the capacity incident is diagnosable
+        # AFTER the fact from /debug/oom alone.
+        from oryx_tpu.utils import faults
+
+        injected = faults.injected_count("page_alloc_oom")
+        import urllib.request as _url
+
+        with _url.urlopen(base + "/metrics", timeout=30) as r:
+            mtext = r.read().decode()
+        m = re.search(
+            r'^oryx_serving_oom_forensics_total\{trigger="oom"\} '
+            r"([0-9.e+-]+)$", mtext, re.M,
+        )
+        raised = float(m.group(1)) if m else 0.0
+        # Every injected raise captures exactly one trigger="oom"
+        # record (genuine free-list-shortfall episodes capture their
+        # own trigger="pool_pressure" records and are not counted
+        # against the injector).
+        if raised != injected:
+            fail(f"[page_alloc_oom] {raised:g} trigger=oom forensic "
+                 f"record(s), injector counted {injected}")
+        status, recs, _ = h.get(base + "/debug/oom?n=64", timeout=30)
+        if status != 200 or recs.get("total", 0) < injected:
+            fail(f"[page_alloc_oom] /debug/oom holds "
+                 f"{recs.get('total')} record(s), want >= {injected}")
+        for rec in recs.get("records") or []:
+            if not rec.get("top_requests"):
+                fail(f"[page_alloc_oom] forensic record "
+                     f"#{rec.get('index')} has an empty top-K")
+            if not (rec.get("pool") or {}).get("reconciled"):
+                fail(f"[page_alloc_oom] forensic record "
+                     f"#{rec.get('index')} captured an unreconciled "
+                     f"pool: {rec.get('pool')}")
+        status, pages, _ = h.get(
+            base + "/debug/pages?format=summary", timeout=30
+        )
+        s = pages.get("summary") or {}
+        if status != 200 or not s.get("reconciled") \
+                or s.get("slot") != 0:
+            fail(f"[page_alloc_oom] post-incident /debug/pages does "
+                 f"not reconcile: {s}")
+        print(f"  [page_alloc_oom] forensics: {injected} injected "
+              f"OOM(s) -> {injected} trigger=oom record(s) "
+              f"({recs.get('total')} total), pool map reconciled")
     finally:
         h.teardown(srv)
 

@@ -165,7 +165,12 @@ def run_checks(base: str) -> str:
     )
     prefixes = (
         ("oryx_router_", "oryx_anomaly_") if kind == "router"
-        else ("oryx_serving_", "oryx_anomaly_")
+        # oryx_pool_/oryx_page_ are the page-pool observatory's raw
+        # families, oryx_device_time_/oryx_profile_ the device-time
+        # attributor's — raw-named like oryx_anomaly_ because their
+        # semantics are engine-independent.
+        else ("oryx_serving_", "oryx_anomaly_", "oryx_pool_",
+              "oryx_page_", "oryx_device_time_", "oryx_profile_")
     )
     info_family = (
         "oryx_router_build_info" if kind == "router"
@@ -380,15 +385,25 @@ def run_checks(base: str) -> str:
     if len(lines) < 4:
         fail(f"?format=jsonl returned {len(lines)} events, want >= 4 "
              "(the burst reached terminal states)")
+    from oryx_tpu.utils.metrics import OOM_EVENT_KEYS
+
     seen_ids = set()
     for ln in lines:
         try:
             ev = json.loads(ln)
         except ValueError:
             fail(f"?format=jsonl line is not JSON: {ln[:80]!r}")
-        extra = set(ev) - set(REQUEST_EVENT_KEYS)
+        # The sink carries two declared schemas, dispatched on `kind`:
+        # request events (no kind) and oom_pressure events.
+        schema = (
+            OOM_EVENT_KEYS if ev.get("kind") == "oom_pressure"
+            else REQUEST_EVENT_KEYS
+        )
+        extra = set(ev) - set(schema)
         if extra:
             fail(f"wide event carries undeclared fields {sorted(extra)}")
+        if ev.get("kind") == "oom_pressure":
+            continue
         if not ev.get("request_id") or "status" not in ev:
             fail(f"wide event missing identity/outcome: {ev}")
         seen_ids.add(ev["request_id"])
@@ -428,6 +443,63 @@ def run_checks(base: str) -> str:
         ]
         if not served:
             fail(f"no replica timeline carries records: {tl}")
+
+    # Page-pool observatory: on the quiesced target the ownership map
+    # must reconcile exactly (free + slot + cache + shared == pool,
+    # the allocator-invariant partition) and the summary must equal
+    # the oryx_pool_* gauges from a scrape of the same quiesced state.
+    with _get(base, "/debug/pages") as r:
+        pm = json.load(r)
+    if kind == "replica":
+        s = pm.get("summary") or {}
+        if not s.get("reconciled") or (
+            s["free"] + s["slot"] + s["cache"] + s["shared"]
+            != pm["num_pages"]
+        ):
+            fail(f"/debug/pages does not reconcile with the pool "
+                 f"partition: {s}")
+        if len(pm.get("pages") or []) != pm["num_pages"]:
+            fail("/debug/pages is not one record per page "
+                 f"({len(pm.get('pages') or [])} of {pm['num_pages']})")
+        for rec in pm["pages"]:
+            if rec["state"] not in ("free", "slot", "cache", "shared"):
+                fail(f"unknown page state in the ownership map: {rec}")
+            if (rec["state"] == "free") != (rec["refcount"] == 0):
+                fail(f"page state/refcount mismatch: {rec}")
+        with _get(base, "/metrics") as r:
+            ptext = r.read().decode()
+        for gname, key in (
+            ("oryx_pool_free_pages", "free"),
+            ("oryx_pool_slot_pages", "slot"),
+            ("oryx_pool_cache_pages", "cache"),
+            ("oryx_pool_shared_pages", "shared"),
+            ("oryx_pool_size_pages", "num_pages"),
+        ):
+            m = re.search(rf"^{gname} ([0-9.e+-]+)$", ptext, re.M)
+            want = s[key] if key != "num_pages" else pm["num_pages"]
+            if not m or float(m.group(1)) != want:
+                fail(f"{gname} ({m.group(1) if m else 'absent'}) does "
+                     f"not equal the /debug/pages summary's {want}")
+        if not re.search(
+            r"^oryx_page_lifetime_seconds_count [1-9]", ptext, re.M
+        ):
+            fail("oryx_page_lifetime_seconds recorded no freed pages "
+                 "after the burst (the free-time observer never fired)")
+    else:
+        reps = pm.get("replicas") or {}
+        if not reps:
+            fail("router /debug/pages returned no replicas")
+        for rid, body in reps.items():
+            if not (body.get("summary") or {}).get("reconciled"):
+                fail(f"replica {rid} page map does not reconcile: "
+                     f"{body}")
+        # The forensic merge answers fleet-wide too (rings empty on a
+        # healthy fleet).
+        with _get(base, "/debug/oom") as r:
+            om = json.load(r)
+        if set(om.get("replicas") or {}) != set(reps):
+            fail(f"router /debug/oom replicas {sorted(om)} do not "
+                 f"match /debug/pages {sorted(reps)}")
     return kind
 
 
@@ -435,6 +507,98 @@ def _shutdown_replica(srv) -> None:
     if srv.scheduler is not None:
         srv.scheduler.close()
     srv.shutdown()
+
+
+def run_oom_forensic_check() -> None:
+    """Boot a fresh tiny replica with ONE injected page_alloc_oom
+    armed (every=2,times=1: the second allocator call fails — by then
+    the first streaming request is resident, so the capture names it)
+    and assert the forensic contract: both requests still answer 200,
+    exactly one /debug/oom record exists, its top-K is non-empty, the
+    oom_pressure wide event rides the request log, and the post-
+    incident page map reconciles."""
+    import threading as threading_lib
+
+    from oryx_tpu.serve import api_server
+    from oryx_tpu.serve.pipeline import OryxInference
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx as oryx_lib
+    import jax
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx_lib.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_Tokenizer(), params, cfg)
+    srv = api_server.build_server(
+        pipe, port=0, engine="continuous", num_slots=2, page_size=16,
+        decode_chunk=4, max_ctx=512, prefill_chunk=32,
+        faults_spec="page_alloc_oom:every=2,times=1",
+    )
+    threading_lib.Thread(target=srv.serve_forever, daemon=True).start()
+    base = _base_of(srv)
+    try:
+        codes: list[int] = []
+
+        def one(i: int, tokens: int) -> None:
+            try:
+                _completion(
+                    base,
+                    [{"role": "user",
+                      "content": f"oom burst request {i} with a "
+                      "longer prompt to prefill and decode"}],
+                    max_tokens=tokens,
+                )
+                codes.append(200)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+                e.close()
+
+        threads = [
+            threading.Thread(target=one, args=(i, t))
+            for i, t in ((0, 64), (1, 8))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if codes != [200, 200]:
+            fail(f"injected-OOM burst did not answer 200/200: {codes}")
+        with _get(base, "/debug/oom?n=64") as r:
+            oom = json.load(r)
+        raised = [
+            rec for rec in oom.get("records") or []
+            if rec.get("trigger") == "oom"
+        ]
+        if len(raised) != 1:
+            fail(f"injected page_alloc_oom produced {len(raised)} "
+                 f"trigger=oom /debug/oom record(s), want exactly 1 "
+                 f"(ring: {oom.get('total')})")
+        rec = raised[0]
+        if not rec.get("top_requests"):
+            fail(f"forensic record has an empty top-K: {rec}")
+        if not (rec.get("pool") or {}).get("reconciled"):
+            fail(f"forensic record captured an unreconciled pool: "
+                 f"{rec.get('pool')}")
+        with _get(base, "/debug/requests?format=jsonl") as r:
+            events = [json.loads(ln) for ln in
+                      r.read().decode().splitlines() if ln]
+        ooms = [e for e in events if e.get("kind") == "oom_pressure"
+                and e.get("trigger") == "oom"]
+        if len(ooms) != 1 \
+                or ooms[0].get("forensic_index") != rec.get("index"):
+            fail(f"expected one trigger=oom wide event joined to "
+                 f"forensic #{rec.get('index')}, got {ooms}")
+        with _get(base, "/debug/pages?format=summary") as r:
+            s = json.load(r)["summary"]
+        if not s.get("reconciled") or s.get("slot") != 0:
+            fail(f"post-incident /debug/pages does not reconcile: {s}")
+        print("oom forensic check OK: 200/200 under one injected "
+              "OOM, 1 forensic record (non-empty top-K), wide event "
+              "joined, pool reconciled")
+    finally:
+        from oryx_tpu.utils import faults
+
+        faults.reset()
+        _shutdown_replica(srv)
 
 
 def run_router_smoke() -> None:
@@ -519,6 +683,10 @@ def main() -> None:
     finally:
         if srv is not None:
             _shutdown_replica(srv)
+    if args.base_url is None:
+        # Self-boot only (the fault registry is process-global and the
+        # scenario needs its own deterministic injection schedule).
+        run_oom_forensic_check()
     print(f"serving endpoints OK ({kind}): /healthz + /readyz + "
           "/metrics (content-type, prefix, build_info"
           + (", aggregate replica labels" if kind == "router"
@@ -527,6 +695,8 @@ def main() -> None:
           "wide-event jsonl) + /debug/trace"
           + (" (merged router+replica)" if kind == "router" else "")
           + " + /debug/timeline (dispatch-kind reconciliation) + "
+          "/debug/pages (ownership-map reconciliation vs the "
+          "oryx_pool_* gauges) + "
           "honored X-Request-Id + prefix-cache family under a "
           "shared-prefix burst + latency quantiles via the shared "
           "histogram helper")

@@ -257,6 +257,102 @@ def fetch_timeline(base: str, n: int = 24, timeout: float = 30.0) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def fetch_pages_summary(base: str, timeout: float = 30.0) -> dict:
+    """One target's /debug/pages?format=summary body (the page-pool
+    observatory). Targets without the endpoint (window engine, old
+    server) degrade to an error entry, never a failed stage."""
+    try:
+        with urllib.request.urlopen(
+            base + "/debug/pages?format=summary", timeout=timeout
+        ) as r:
+            return json.load(r)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _kind_counter_values(text: str, family: str) -> dict[str, float]:
+    """{kind: value} of a kind-labeled counter family in a text
+    exposition (the oryx_device_time_seconds_total shape)."""
+    out: dict[str, float] = {}
+    for m in re.finditer(
+        rf'^{re.escape(family)}\{{kind="([^"]+)"\}} ([0-9.eE+-]+)$',
+        text, re.M,
+    ):
+        out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def memory_block(m0: str, m1: str, pages: dict,
+                 timeline: dict) -> dict:
+    """One stage's `memory` record: pool geometry + end-of-stage
+    occupancy/fragmentation (from the observatory summary), peak
+    occupancy over the stage (min free_pages across the stage's
+    timeline records, floored by the boot-wide watermark), page
+    lifetime/idle quantiles from the oryx_page_lifetime_seconds
+    histogram DELTA across the stage, and the sampled device-time
+    split (per-kind busy seconds vs the sampled wall window —
+    busy <= wall per kind by construction, the gate's sanity bar).
+    This block is what ROADMAP item 3's memory-economics PR will gate
+    its halving claim on (scripts/bench_compare.py memory class)."""
+    from oryx_tpu.utils.metrics import histogram_quantile, \
+        parse_prom_histogram
+
+    summary = pages.get("summary") or {}
+    block: dict = {
+        "pool": {
+            "num_pages": pages.get("num_pages"),
+            "page_size": pages.get("page_size"),
+        },
+        "end": {
+            k: summary.get(k)
+            for k in ("free", "slot", "cache", "shared",
+                      "fragmentation_ratio", "reconciled")
+        },
+        "peak_pages_in_use": summary.get("peak_pages_in_use"),
+    }
+    if "error" in pages:
+        block["error"] = pages["error"]
+    num_pages = pages.get("num_pages")
+    frees = [
+        rec.get("free_pages")
+        for rec in (timeline.get("records") or [])
+        if isinstance(rec, dict) and rec.get("free_pages") is not None
+    ]
+    if num_pages is not None and frees:
+        block["stage_peak_pages_in_use"] = num_pages - min(frees)
+    for name, fam in (
+        ("page_lifetime_s", "oryx_page_lifetime_seconds"),
+        ("page_idle_s", "oryx_page_idle_seconds"),
+    ):
+        h0 = parse_prom_histogram(m0, fam)
+        h1 = parse_prom_histogram(m1, fam)
+        if h0 is None or h1 is None or h0[0] != h1[0]:
+            block[name] = {"count": 0, "p50": None, "p95": None}
+            continue
+        counts = [b - a for a, b in zip(h0[1], h1[1])]
+        total = h1[2] - h0[2]
+        q = {}
+        for p in (0.5, 0.95):
+            v = histogram_quantile(p, h1[0], counts, total)
+            q[f"p{int(p * 100)}"] = None if v != v else round(v, 6)
+        block[name] = {"count": total, **q}
+    dev0 = _kind_counter_values(m0, "oryx_device_time_seconds_total")
+    dev1 = _kind_counter_values(m1, "oryx_device_time_seconds_total")
+    wall0 = _kind_counter_values(
+        m0, "oryx_profile_sampled_wall_seconds_total"
+    )
+    wall1 = _kind_counter_values(
+        m1, "oryx_profile_sampled_wall_seconds_total"
+    )
+    block["device_time_s"] = {
+        k: round(dev1[k] - dev0.get(k, 0.0), 6) for k in sorted(dev1)
+    }
+    block["sampled_wall_s"] = {
+        k: round(wall1[k] - wall0.get(k, 0.0), 6) for k in sorted(wall1)
+    }
+    return block
+
+
 def anomaly_counts(text: str) -> dict[str, float]:
     out = {}
     for kind in ANOMALY_KINDS:
@@ -614,13 +710,25 @@ def run_stage(base: str, rate: float, cfg: dict,
     )
     # Engine step-timeline snapshot at stage end: what the engine(s)
     # were actually doing as this offered load drained — per replica
-    # behind a router (the router has no engine loop of its own).
+    # behind a router (the router has no engine loop of its own). The
+    # memory block rides the same per-target split (pool + page
+    # lifetimes + device-time split live on the engines).
     if replicas:
         st["timeline"] = {
             rid: fetch_timeline(u) for rid, u in replicas.items()
         }
+        st["memory"] = {
+            rid: memory_block(
+                r0.get(rid, ""), r1[rid], fetch_pages_summary(u),
+                st["timeline"].get(rid) or {},
+            )
+            for rid, u in replicas.items()
+        }
     else:
-        st["timeline"] = fetch_timeline(base)
+        st["timeline"] = fetch_timeline(base, n=256)
+        st["memory"] = memory_block(
+            m0, m1, fetch_pages_summary(base), st["timeline"]
+        )
     return st
 
 
@@ -655,8 +763,21 @@ def find_knee(stages: list[dict], good_frac: float = 0.9) -> dict | None:
 _STAGE_KEYS = (
     "offered_rps", "sent", "ok", "good", "slo_good_frac", "goodput_tps",
     "completed_tps", "ttft_s", "per_token_s", "server_ttft_s", "errors",
-    "anomalies", "speculation", "cost", "timeline",
+    "anomalies", "speculation", "cost", "timeline", "memory",
 )
+
+
+def _stage_memory_blocks(st: dict) -> list[dict]:
+    """The stage's memory blocks — one for a single target, one per
+    replica behind a router (error entries excluded)."""
+    mem = st.get("memory")
+    if not isinstance(mem, dict):
+        return []
+    if "pool" in mem:
+        return [mem]
+    return [
+        b for b in mem.values() if isinstance(b, dict) and "pool" in b
+    ]
 
 
 def validate_report(report: dict) -> list[str]:
@@ -735,15 +856,69 @@ def check_cost_ledger(base: str) -> list[str]:
 
 def evaluate_gate(report: dict, *, ledger_problems: list[str],
                   require_affinity: float | None = None,
-                  vs_single: bool = False) -> dict:
+                  vs_single: bool = False,
+                  check_memory: bool = False) -> dict:
     """Pass/fail: schema valid, a knee exists, and ZERO SLO-detector
     firings (and zero hung/transport casualties) at or below it.
     Router sweeps add: the sweep-wide affinity hit rate must exceed
     `require_affinity` (the shared-prefix mix must actually land hot),
     and with `vs_single` the knee must sit at STRICTLY higher offered
-    load than the recorded single-replica baseline's."""
+    load than the recorded single-replica baseline's. `check_memory`
+    (self-booted targets) adds the memory-observatory bars: zero
+    leaked pages after the sweep drains (the end-of-stage snapshot's
+    free + cache must cover the pool with no slot/shared residue),
+    nonzero page-lifetime samples across the sweep, and — when the
+    device-time sampler is armed — a per-kind split that stays within
+    its sampled wall windows."""
     reasons = list(validate_report(report))
     reasons += ledger_problems
+    if check_memory:
+        for rid, a in (report.get("memory_audit") or {}).items():
+            if a.get("leaked"):
+                reasons.append(
+                    f"leaked pages on {rid} after drain: "
+                    f"slot={a.get('slot')} shared={a.get('shared')} "
+                    f"free={a.get('free')} cache={a.get('cache')} of "
+                    f"{a.get('num_pages')} (want slot=shared=0, "
+                    "free+cache==pool)"
+                )
+        blocks = [
+            b for st in report.get("stages", [])
+            for b in _stage_memory_blocks(st)
+        ]
+        if not blocks:
+            reasons.append(
+                "no memory block on any stage (the /debug/pages "
+                "observatory never answered)"
+            )
+        lifetime = sum(
+            (b.get("page_lifetime_s") or {}).get("count") or 0
+            for b in blocks
+        )
+        if blocks and lifetime <= 0:
+            reasons.append(
+                "zero page-lifetime samples across the sweep (the "
+                "allocator's free-time observatory hook never fired)"
+            )
+        for st in report.get("stages", []):
+            for b in _stage_memory_blocks(st):
+                dev = b.get("device_time_s") or {}
+                wall = b.get("sampled_wall_s") or {}
+                for k, v in dev.items():
+                    w = wall.get(k)
+                    if w is not None and v > w * 1.1 + 0.05:
+                        reasons.append(
+                            f"device-time split kind {k!r} "
+                            f"({v:.3f}s) exceeds its sampled wall "
+                            f"window ({w:.3f}s) at offered "
+                            f"{st['offered_rps']:g} rps"
+                        )
+        if (report.get("config") or {}).get("profile_sample_every"):
+            if not any(b.get("sampled_wall_s") for b in blocks):
+                reasons.append(
+                    "device-time sampler armed but no sampled wall "
+                    "windows recorded across the sweep"
+                )
     knee = report.get("knee")
     if require_affinity is not None:
         hits = sum(
@@ -813,9 +988,13 @@ class _CharTokenizer:
 
 
 def boot_tiny_server(args, *, replica_id: str | None = None,
-                     params=None, cfg=None):
+                     params=None, cfg=None,
+                     profile_sample_every: int | None = None):
     """In-process tiny-geometry continuous-engine server with the SLO
-    detectors ARMED (they are the gate). Returns (srv, base_url)."""
+    detectors ARMED (they are the gate). Returns (srv, base_url).
+    profile_sample_every overrides the CLI value (the fleet boot
+    disables sampling per replica — jax's profiler is process-global
+    and N in-process engines would contend for it)."""
     import jax
 
     from oryx_tpu import config as cfg_lib
@@ -829,10 +1008,13 @@ def boot_tiny_server(args, *, replica_id: str | None = None,
         params = oryx.init_params(cfg, jax.random.key(0))
     pipe = OryxInference(_CharTokenizer(), params, cfg)
     speculate = getattr(args, "speculate", 0)
+    if profile_sample_every is None:
+        profile_sample_every = getattr(args, "profile_sample_every", 0)
     srv = api_server.build_server(
         pipe, port=0, engine="continuous", num_slots=2, page_size=16,
         decode_chunk=4, max_ctx=512, prefill_chunk=32,
         ragged=bool(speculate), speculate=speculate,
+        profile_sample_every=profile_sample_every,
         ttft_slo=args.server_ttft_slo,
         queue_depth_slo=args.server_queue_depth_slo,
         replica_id=replica_id,
@@ -856,7 +1038,8 @@ def boot_tiny_fleet(args, n: int):
     servers, bases = [], {}
     for i in range(n):
         srv, base = boot_tiny_server(
-            args, replica_id=f"r{i}", params=params, cfg=cfg
+            args, replica_id=f"r{i}", params=params, cfg=cfg,
+            profile_sample_every=0,
         )
         servers.append(srv)
         bases[f"r{i}"] = base
@@ -941,6 +1124,14 @@ def run(argv=None) -> dict:
                     "speculative ragged engine (--ragged --speculate K "
                     "semantics); the per-stage speculation block then "
                     "reports accepted-tokens/step and draft economics")
+    ap.add_argument("--profile-sample-every", type=int, default=0,
+                    metavar="N",
+                    help="self-booted server only: arm the sampled "
+                    "device-time attributor (every N engine steps one "
+                    "dispatch is profiled; feeds the per-stage memory "
+                    "block's device-time split). Router fleets keep it "
+                    "off per replica — jax's profiler is "
+                    "process-global")
     ap.add_argument("--request-timeout", type=float, default=300.0)
     ap.add_argument("--max-inflight", type=int, default=256)
     ap.add_argument("--out", default="BENCH_loadgen.json",
@@ -982,6 +1173,11 @@ def run(argv=None) -> dict:
         args.max_tokens_choices = "4,6"
         args.prompt_chars_choices = "32,64"
         args.gate = True
+        if not args.router:
+            # The smoke's committed artifact must carry a real
+            # device-time split (the memory block's acceptance bar);
+            # every 5th engine step is cheap on the tiny geometry.
+            args.profile_sample_every = args.profile_sample_every or 5
         if args.router:
             # The router smoke is the AFFINITY gate: emphasize the
             # shared-prefix mix so the >0.5 hit-rate bar measures
@@ -1087,6 +1283,42 @@ def run(argv=None) -> dict:
             build_info_labels(scrape, "oryx_serving_build_info")
             or build_info_labels(scrape, "oryx_router_build_info")
         )
+        # Pool-geometry provenance: the memory blocks are only
+        # comparable across runs serving from the SAME pool shape —
+        # scripts/bench_compare.py refuses a drifted geometry instead
+        # of diffing page counts across different pools.
+        pool_probe = fetch_pages_summary(
+            next(iter(replica_bases.values())) if replica_bases
+            else base
+        )
+        pool_geom = {
+            "num_pages": pool_probe.get("num_pages"),
+            "page_size": pool_probe.get("page_size"),
+        }
+        # End-of-sweep zero-leak audit (self-booted targets only —
+        # a remote server's quiescence is unknowable from here): with
+        # every stage drained, no slot may still hold pages and the
+        # free list plus the prefix cache's references must cover the
+        # whole pool.
+        memory_audit = None
+        if not args.base_url:
+            memory_audit = {}
+            targets = replica_bases or {"self": base}
+            for rid, b in sorted(targets.items()):
+                s = fetch_pages_summary(b).get("summary") or {}
+                memory_audit[rid] = {
+                    **{k: s.get(k) for k in (
+                        "num_pages", "free", "slot", "cache", "shared",
+                        "reconciled",
+                    )},
+                    "leaked": not (
+                        s.get("reconciled")
+                        and s.get("slot") == 0
+                        and s.get("shared") == 0
+                        and (s.get("free", 0) + s.get("cache", 0)
+                             == s.get("num_pages"))
+                    ),
+                }
         if args.base_url:
             backend = "remote"
             # A remote target's engine flags are unknowable from the
@@ -1130,10 +1362,22 @@ def run(argv=None) -> dict:
                 "shared_prefix_chars": args.shared_prefix_chars,
                 "smoke": args.smoke,
                 "router_replicas": args.router or None,
+                "pool": pool_geom,
+                # The EFFECTIVE cadence: router fleets boot every
+                # replica with sampling off (jax's profiler is
+                # process-global), so stamping the CLI value would
+                # false-fail the armed-but-no-windows gate bar and
+                # mis-key bench_compare's provenance refusal.
+                "profile_sample_every": (
+                    None if args.base_url
+                    else 0 if args.router
+                    else args.profile_sample_every
+                ),
             },
             "stages": stages,
             "knee": knee,
             "gate": {},
+            "memory_audit": memory_audit,
         }
         if args.router and single_baseline is not None:
             report["single_baseline"] = single_baseline
@@ -1146,6 +1390,7 @@ def run(argv=None) -> dict:
             require_affinity=0.5
             if args.router and args.shared_prefix_frac >= 0.5 else None,
             vs_single=args.gate_vs_single,
+            check_memory=not args.base_url,
         )
     finally:
         if rsrv is not None:

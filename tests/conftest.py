@@ -322,6 +322,9 @@ _LOCK_SAN_FILES = (
     "test_prefix_cache.py",
     "test_ragged_attention.py",
     "test_speculative.py",
+    "test_pagemap.py",
+    "test_forensics.py",
+    "test_device_time.py",
 )
 
 

@@ -18,7 +18,9 @@ import bench_compare  # noqa: E402
 
 
 def _loadgen_report(knee_rps=4.0, goodput=24.8, tpps=30.0,
-                    backend="cpu_proxy", rates=(1.0, 4.0), seed=0):
+                    backend="cpu_proxy", rates=(1.0, 4.0), seed=0,
+                    pool_pages=64, peak_pages=48, lifetime_p95=2.0,
+                    device_s=0.1):
     stages = []
     for i, r in enumerate(rates):
         stages.append({
@@ -26,6 +28,21 @@ def _loadgen_report(knee_rps=4.0, goodput=24.8, tpps=30.0,
             "slo_good_frac": 1.0,
             "speculation": {"accepted_tokens_per_step": None},
             "cost": {"goodput_tokens_per_page_second": tpps},
+            "memory": {
+                "pool": {"num_pages": pool_pages, "page_size": 16},
+                "end": {"free": pool_pages, "slot": 0, "cache": 0,
+                        "shared": 0, "fragmentation_ratio": 1.0,
+                        "reconciled": True},
+                "peak_pages_in_use": peak_pages,
+                "stage_peak_pages_in_use": peak_pages,
+                "page_lifetime_s": {"count": 20, "p50": 0.5,
+                                    "p95": lifetime_p95},
+                "page_idle_s": {"count": 20, "p50": 0.2, "p95": 1.0},
+                "device_time_s": {"decode": device_s,
+                                  "prefill": device_s / 2},
+                "sampled_wall_s": {"decode": device_s * 1.5,
+                                   "prefill": device_s},
+            },
         })
     return {
         "bench": "loadgen",
@@ -41,6 +58,8 @@ def _loadgen_report(knee_rps=4.0, goodput=24.8, tpps=30.0,
             "shared_prefix_frac": 0.5,
             "router_replicas": None,
             "engine": {"engine": "continuous", "speculate": 0},
+            "pool": {"num_pages": pool_pages, "page_size": 16},
+            "profile_sample_every": 5,
         },
         "stages": stages,
         "knee": {
@@ -134,6 +153,44 @@ def test_cpu_proxy_vs_tpu_is_refused_not_diffed():
     assert rows == []  # refused means NO diff rows at all
     assert refusal is not None
     assert "cpu_proxy" in refusal and "tpu" in refusal
+
+
+def test_pool_geometry_drift_is_refused_not_diffed():
+    """The acceptance bar: a doctored pool geometry is a category
+    error — REFUSED with the field named, producing no diff rows."""
+    base = _loadgen_report(pool_pages=64)
+    cur = _loadgen_report(pool_pages=128)  # doctored geometry
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert refusal is not None and rows == []
+    assert "config.pool.num_pages" in refusal
+
+
+def test_memory_peak_pages_regression_detected():
+    base = _loadgen_report(peak_pages=32)
+    cur = _loadgen_report(peak_pages=48)  # +50% HBM peak
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert refusal is None
+    assert ("loadgen knee-stage memory peak_pages_in_use"
+            in _regressions(rows))
+    # ...and a halving (the item-3 target) reads as improved.
+    rows, _ = bench_compare.compare_loadgen(
+        _loadgen_report(peak_pages=16), base
+    )
+    mem = [r for r in rows
+           if r.series == "loadgen knee-stage memory peak_pages_in_use"]
+    assert mem[0].verdict == "improved"
+
+
+def test_memory_wall_clock_rows_use_wide_band():
+    base = _loadgen_report(lifetime_p95=2.0, device_s=0.1)
+    # 40% worse: inside the wall-clock band, not a regression.
+    cur = _loadgen_report(lifetime_p95=2.8, device_s=0.14)
+    rows, _ = bench_compare.compare_loadgen(cur, base)
+    assert not [s for s in _regressions(rows) if "page_lifetime" in s]
+    # 3x worse page lifetimes: past the band.
+    cur = _loadgen_report(lifetime_p95=6.0)
+    rows, _ = bench_compare.compare_loadgen(cur, base)
+    assert any("page_lifetime" in s for s in _regressions(rows))
 
 
 def test_config_drift_is_refused_with_key_named():

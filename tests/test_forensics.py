@@ -1,0 +1,186 @@
+"""OOM forensics (utils/forensics + the scheduler's capture sites):
+ring bounds and indexing, the oom_pressure wide-event schema, exactly
+one record per injected OutOfPagesError with a non-empty top-K, and a
+degraded-mode escalation capturing the same artifact."""
+
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils import faults
+from oryx_tpu.utils.forensics import ForensicRing
+from oryx_tpu.utils.metrics import OOM_EVENT_KEYS, ServingMetrics
+from oryx_tpu.utils.request_log import RequestLog, build_oom_event
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounds_and_monotone_index():
+    ring = ForensicRing(keep=3)
+    idxs = [ring.append({"kind": "oom_pressure", "n": i})
+            for i in range(5)]
+    assert idxs == [0, 1, 2, 3, 4]
+    assert ring.total == 5
+    recs = ring.snapshot()
+    assert [r["n"] for r in recs] == [4, 3, 2]  # newest first, bounded
+    assert ring.snapshot(1)[0]["n"] == 4
+    body = ring.to_dict(2)
+    assert body["total"] == 5 and len(body["records"]) == 2
+    # Snapshots are copies — mutating one never corrupts the ring.
+    recs[0]["n"] = 99
+    assert ring.snapshot(1)[0]["n"] == 4
+
+
+def test_oom_event_schema_enforced():
+    ev = build_oom_event(trigger="oom", detail="x", free_pages=3)
+    assert ev["kind"] == "oom_pressure" and ev["schema"] == 1
+    assert set(ev) <= set(OOM_EVENT_KEYS)
+    with pytest.raises(ValueError, match="OOM_EVENT_KEYS"):
+        build_oom_event(trigger="oom", bogus_field=1)
+    log = RequestLog()
+    log.append(ev)  # kind dispatches to the OOM schema
+    with pytest.raises(ValueError):
+        # A hand-rolled oom event with an undeclared key fails at the
+        # sink too.
+        log.append({"kind": "oom_pressure", "bogus": 1})
+    with pytest.raises(ValueError):
+        # An unknown kind falls back to the request schema, which has
+        # no "kind" — rejected rather than silently accepted.
+        log.append({"kind": "mystery_event"})
+
+
+# ---------------------------------------------------------------------------
+# Scheduler capture sites
+# ---------------------------------------------------------------------------
+
+
+def test_injected_oom_captures_one_record_with_topk(pipe):
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    faults.configure("page_alloc_oom:every=2,times=1")
+    try:
+        handles = [
+            sched.submit(
+                {"question": f"some longer burst question {i}"}, 24
+            )
+            for i in range(2)
+        ]
+        sched.start()
+        results = [h.result(timeout=600) for h in handles]
+    finally:
+        faults.reset()
+    assert all(r[0] for r in results)
+    assert sched.forensics.total == 1
+    rec = sched.forensics.snapshot()[0]
+    assert rec["trigger"] == "oom"
+    assert "OutOfPagesError" in rec["detail"] or "COW" in rec["detail"]
+    assert rec["top_requests"], "empty top-K"
+    top = rec["top_requests"][0]
+    assert top["request_id"] and "cost" in top
+    assert rec["pool"]["reconciled"]
+    assert isinstance(rec["timeline_tail"], list)
+    assert metrics.get("oom_forensics_total") == 0  # labeled family
+    fam = metrics.registry.existing("oom_forensics_total")
+    assert fam.labels(trigger="oom").value == 1
+    # The flat wide event rode the request-log sink, joined by index.
+    ooms = [
+        e for e in sched.request_log.snapshot()
+        if e.get("kind") == "oom_pressure"
+    ]
+    assert len(ooms) == 1
+    assert ooms[0]["forensic_index"] == rec["index"]
+    assert set(ooms[0]) <= set(OOM_EVENT_KEYS)
+    assert ooms[0]["top_request_pages"] >= 1
+    sched.close()
+
+
+def test_real_shortfall_captures_once_per_episode(pipe):
+    """The REAL capacity path (free list short, no exception) must
+    capture a pool_pressure forensic — and exactly one per pressure
+    EPISODE, not one per engine step, even though the waiting head
+    retries the grow every step."""
+    import math
+
+    qs = ["pressure question A", "pressure question B"]
+    cap = 48
+    ps, chunk = 16, 4
+    need = max(
+        math.ceil(
+            (len(pipe._prepare_request({"question": q})[0]) + cap
+             + chunk) / ps
+        )
+        for q in qs
+    )
+    # One request fits with room to grow; two concurrent cannot —
+    # the second's growth hits the free-list shortfall path.
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=ps, chunk=chunk, max_ctx=512,
+        num_pages=need + 2, prefix_cache=False, autostart=False,
+    )
+    handles = [sched.submit({"question": q}, cap) for q in qs]
+    sched.start()
+    for h in handles:
+        h.result(timeout=600)
+    sched.close()
+    recs = sched.forensics.snapshot()
+    pressure = [r for r in recs if r["trigger"] == "pool_pressure"]
+    assert pressure, "shortfall left no forensic record"
+    # Bounded by episodes (each successful grow closes one), never by
+    # engine steps — the waiting head alone runs dozens of steps.
+    assert len(recs) <= 2 * sched.metrics.get("evicted") + 4, (
+        len(recs), sched.metrics.get("evicted"),
+    )
+    for r in pressure:
+        assert r["top_requests"], r
+        assert "shortfall" in r["detail"]
+
+
+def test_degraded_escalation_captures_forensic(pipe):
+    from oryx_tpu.utils.anomaly import AnomalyMonitor, AnomalyThresholds
+
+    anomaly = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(queue_depth_slo=1),
+    )
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        anomaly=anomaly, autostart=False,
+    )
+    handles = [
+        sched.submit({"question": f"question {i}"}, 4)
+        for i in range(4)
+    ]
+    sched.start()
+    for h in handles:
+        h.result(timeout=600)
+    assert sched.forensics.total >= 1
+    rec = sched.forensics.snapshot()[-1]  # oldest = the escalation
+    assert rec["trigger"] == "degraded_escalation"
+    assert rec["degraded_mode"] >= 1
+    assert rec["pool"]["reconciled"]
+    sched.close()

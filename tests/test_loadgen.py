@@ -95,6 +95,16 @@ def _stage(rate, good_frac, anomalies=0.0, hung=0, transport=0,
         "timeline": {"total_steps": 40,
                      "counts_by_kind": {"prefill": 20, "decode": 20},
                      "records": []},
+        "memory": {
+            "pool": {"num_pages": 64, "page_size": 16},
+            "end": {"free": 60, "slot": 0, "cache": 4, "shared": 0,
+                    "fragmentation_ratio": 1.0, "reconciled": True},
+            "peak_pages_in_use": 10,
+            "page_lifetime_s": {"count": 12, "p50": 0.5, "p95": 2.0},
+            "page_idle_s": {"count": 12, "p50": 0.2, "p95": 1.0},
+            "device_time_s": {"decode": 0.05},
+            "sampled_wall_s": {"decode": 0.08},
+        },
     }
 
 

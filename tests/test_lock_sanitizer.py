@@ -542,10 +542,10 @@ def test_instrumented_prefix_cache_still_works():
         def __init__(self):
             self.shared = []
 
-        def share(self, pages):
+        def share(self, pages, owner=None):
             self.shared.extend(pages)
 
-        def release(self, pages):
+        def release(self, pages, owner=None):
             pass
 
         def refcount(self, page):

@@ -214,6 +214,46 @@ def test_process_and_device_memory_collectors():
     assert v["t_hbm_live_bytes"] >= 0
 
 
+def test_device_memory_collector_rate_limited(monkeypatch):
+    """`jax.live_arrays()` walks every live array, so the HBM
+    collector caches for ~1s (monotonic): an aggressive scraper pays
+    the walk at most once per TTL window, and ttl_s=0 disables the
+    cache. Counting fake pins the contract."""
+    import jax as jax_lib
+
+    calls = {"n": 0}
+
+    def counting_live_arrays():
+        calls["n"] += 1
+        return []
+
+    monkeypatch.setattr(jax_lib, "live_arrays", counting_live_arrays)
+    r = Registry(prefix="t")
+    register_device_memory_collector(r, ttl_s=1000.0)
+    for _ in range(5):
+        r.render()
+    assert calls["n"] == 1, calls  # cached inside the TTL window
+    # Monotonic-clock based: past the TTL the walk refreshes.
+    import time as time_lib
+
+    r2 = Registry(prefix="t2")
+    register_device_memory_collector(r2, ttl_s=0.05)
+    calls["n"] = 0
+    r2.render()
+    r2.render()
+    assert calls["n"] == 1, calls
+    time_lib.sleep(0.06)
+    r2.render()
+    assert calls["n"] == 2, calls
+    # ttl_s=0 disables the cache entirely.
+    r3 = Registry(prefix="t3")
+    register_device_memory_collector(r3, ttl_s=0)
+    calls["n"] = 0
+    for _ in range(3):
+        r3.render()
+    assert calls["n"] == 3, calls
+
+
 def test_serving_metrics_compat_surface():
     """ServingMetrics is now a Registry client; the old call surface
     (inc/set_gauge/observe/get/render, creation-only buckets) must be

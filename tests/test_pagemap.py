@@ -1,0 +1,264 @@
+"""Page-pool observatory (ops/paged_kv ownership map + utils/pagemap):
+owner stamping at every alloc/share/free transition, the snapshot's
+state partition, fragmentation math, the oryx_pool_* gauges + free-time
+lifetime histograms, the scheduler's pool_snapshot reconciliation, and
+the peak_pages cost-ledger extension."""
+
+import time
+
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.ops.paged_kv import OutOfPagesError, PageAllocator
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils import pagemap
+from oryx_tpu.utils.metrics import REQUEST_COST_KEYS, Registry, \
+    ServingMetrics
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Allocator ownership map
+# ---------------------------------------------------------------------------
+
+
+def test_owner_stamps_and_state_partition():
+    a = PageAllocator(8, 4)
+    p = a.alloc(3, owner="req:a")
+    a.share([p[0]], owner="cache")
+    snap = a.snapshot()
+    by_page = {r["page"]: r for r in snap["pages"]}
+    assert by_page[p[0]]["state"] == "shared"
+    assert sorted(by_page[p[0]]["owners"]) == ["cache", "req:a"]
+    assert by_page[p[1]]["state"] == "slot"
+    assert by_page[p[1]]["owners"] == ["req:a"]
+    free_states = [
+        r["state"] for r in snap["pages"] if r["refcount"] == 0
+    ]
+    assert free_states == ["free"] * 5
+    # The four states partition the pool.
+    s = pagemap.summarize(snap)
+    assert (s["free"], s["slot"], s["cache"], s["shared"]) == (5, 2, 0, 1)
+    assert s["reconciled"]
+    # Dropping the request's reference leaves a cache-owned page.
+    a.free([p[0]], owner="req:a")
+    assert a.classify(a.refcount(p[0]), [
+        r for r in a.snapshot()["pages"] if r["page"] == p[0]
+    ][0]["owners"]) == "cache"
+
+
+def test_free_removes_matching_owner_tag_else_newest():
+    a = PageAllocator(4, 2)
+    p = a.alloc(1, owner="req:a")[0]
+    a.share([p], owner="cache")
+    a.share([p], owner="req:b")
+    # Matching tag removed regardless of position...
+    a.free([p], owner="cache")
+    rec = [r for r in a.snapshot()["pages"] if r["page"] == p][0]
+    assert sorted(rec["owners"]) == ["req:a", "req:b"]
+    # ...and an unstamped free drops the newest tag.
+    a.free([p])
+    rec = [r for r in a.snapshot()["pages"] if r["page"] == p][0]
+    assert rec["owners"] == ["req:a"]
+
+
+def test_ages_and_free_time_observer():
+    freed = []
+
+    class Obs:
+        def page_freed(self, lifetime_s, idle_s):
+            freed.append((lifetime_s, idle_s))
+
+    a = PageAllocator(4, 2)
+    a.observer = Obs()
+    p = a.alloc(2, owner="x")
+    time.sleep(0.02)
+    rec = [r for r in a.snapshot()["pages"] if r["page"] == p[0]][0]
+    assert rec["age_s"] >= 0.02 and rec["idle_s"] >= 0.02
+    a.free(p, owner="x")
+    assert len(freed) == 2
+    for lifetime, idle in freed:
+        assert lifetime >= 0.02 and 0 <= idle <= lifetime + 1e-6
+    # A re-allocated page starts a fresh tenancy clock.
+    q = a.alloc(1, owner="y")[0]
+    rec = [r for r in a.snapshot()["pages"] if r["page"] == q][0]
+    assert rec["age_s"] < 0.02
+
+
+def test_min_free_watermark():
+    a = PageAllocator(8, 2)
+    assert a.min_free == 8
+    p = a.alloc(5)
+    assert a.min_free == 3
+    a.free(p)
+    assert a.min_free == 3  # a watermark, not a gauge
+    a.alloc(2)
+    assert a.min_free == 3
+
+
+# ---------------------------------------------------------------------------
+# pagemap math
+# ---------------------------------------------------------------------------
+
+
+def test_fragmentation_ratio():
+    assert pagemap.fragmentation_ratio([]) == 1.0
+    assert pagemap.fragmentation_ratio([0, 1, 2, 3]) == 1.0
+    assert pagemap.fragmentation_ratio([0, 2, 4, 6]) == 0.25
+    assert pagemap.fragmentation_ratio([0, 1, 2, 5, 6]) == 0.6
+    # Fresh pool: one perfect run.
+    a = PageAllocator(16, 2)
+    assert pagemap.fragmentation_ratio(
+        a.snapshot()["free_pages"]
+    ) == 1.0
+
+
+def test_observatory_gauges_and_lifetime_histograms():
+    reg = Registry(prefix="oryx_serving")
+    holder = {"a": PageAllocator(8, 4)}
+    # ttl_s=0: this test pins gauge DERIVATION per render; the TTL
+    # cache has its own test below.
+    obs = pagemap.PoolObservatory(reg, lambda: holder["a"], ttl_s=0)
+    obs.attach(holder["a"])
+    p = holder["a"].alloc(3, owner="req:x")
+    holder["a"].share([p[0]], owner="cache")
+    text = reg.render()
+    assert "oryx_pool_free_pages 5" in text
+    assert "oryx_pool_slot_pages 2" in text
+    assert "oryx_pool_shared_pages 1" in text
+    assert "oryx_pool_size_pages 8" in text
+    holder["a"].free(p, owner="req:x")
+    holder["a"].free([p[0]], owner="cache")
+    text = reg.render()
+    assert "oryx_page_lifetime_seconds_count 3" in text
+    assert "oryx_page_idle_seconds_count 3" in text
+    # A pool rebuild follows through the callable + re-attach.
+    holder["a"] = PageAllocator(8, 4)
+    obs.attach(holder["a"])
+    assert "oryx_pool_free_pages 8" in reg.render()
+
+
+def test_observatory_collector_ttl_and_force():
+    """The pool walk is O(num_pages) per refresh, so the scrape-time
+    collector is TTL-cached like the HBM collector; force=True (the
+    /debug/pages reconciliation path) bypasses it, ttl_s=0 disables
+    it."""
+    a = PageAllocator(8, 4)
+    walks = {"n": 0}
+
+    def fn():
+        walks["n"] += 1
+        return a
+
+    reg = Registry(prefix="oryx_serving")
+    obs = pagemap.PoolObservatory(reg, fn, ttl_s=1000.0)
+    base = walks["n"]  # construction refreshes once
+    for _ in range(4):
+        reg.render()
+    assert walks["n"] == base  # cached inside the TTL window
+    obs.collect(force=True)
+    assert walks["n"] == base + 1
+    reg2 = Registry(prefix="oryx_serving2")
+    walks["n"] = 0
+    pagemap.PoolObservatory(reg2, fn, ttl_s=0)
+    n0 = walks["n"]
+    reg2.render()
+    reg2.render()
+    assert walks["n"] == n0 + 2  # 0 disables the cache
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: pool_snapshot reconciliation + ledger peaks
+# ---------------------------------------------------------------------------
+
+
+def test_pool_snapshot_reconciles_and_ledger_carries_peaks(pipe):
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    handles = [
+        sched.submit({"question": f"question number {i}"}, 6)
+        for i in range(3)
+    ]
+    sched.start()
+    for h in handles:
+        h.result(timeout=600)
+    snap = sched.pool_snapshot()
+    s = snap["summary"]
+    # Quiesced: the snapshot's partition must match the allocator
+    # invariant exactly — no slot/shared residue, free + cache == pool.
+    sched._check_pool_invariant()
+    assert s["reconciled"]
+    assert s["slot"] == 0 and s["shared"] == 0
+    assert s["free"] + s["cache"] == snap["num_pages"]
+    # Cache-owned pages carry the cache's stamp, and only it.
+    for rec in snap["pages"]:
+        if rec["state"] == "cache":
+            assert rec["owners"] == ["cache"]
+    # Every finished ledger carries the HBM high-water mark.
+    for h in handles:
+        cost = h.debug["cost"]
+        assert set(REQUEST_COST_KEYS) <= set(cost)
+        assert cost["peak_pages"] > 0
+        assert 0 <= cost["peak_page_seconds"] <= cost["page_seconds"] \
+            + 1e-6
+    # The free-time histograms saw the finished requests' pages.
+    text = metrics.render()
+    assert "oryx_page_lifetime_seconds_count" in text
+    count = [
+        ln for ln in text.splitlines()
+        if ln.startswith("oryx_page_lifetime_seconds_count")
+    ][0]
+    assert float(count.split()[-1]) > 0
+    sched.close()
+
+
+def test_injected_oom_keeps_ownership_map_exact(pipe):
+    """The chaos bar at unit level: an injected allocation failure
+    mid-burst leaves owner tags exactly as refcounts say (alloc is
+    all-or-nothing; tags follow)."""
+    from oryx_tpu.utils import faults
+
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    faults.configure("page_alloc_oom:every=2,times=2")
+    try:
+        handles = [
+            sched.submit({"question": f"longer question text {i}"}, 8)
+            for i in range(3)
+        ]
+        sched.start()
+        for h in handles:
+            h.result(timeout=600)
+    finally:
+        faults.reset()
+    snap = sched.pool_snapshot()
+    assert snap["summary"]["reconciled"]
+    for rec in snap["pages"]:
+        assert len(rec["owners"]) == rec["refcount"], rec
+    with pytest.raises(OutOfPagesError):
+        sched.allocator.alloc(sched.num_pages + 1)
+    sched.close()

@@ -191,10 +191,18 @@ def test_eviction_count_lands_in_event(pipe):
     h1.result(timeout=600)
     h2.result(timeout=600)
     sched.close()
-    events = {e["request_id"]: e for e in log.snapshot()}
+    # Engineered page pressure also emits pool_pressure forensics
+    # through the same sink (kind-dispatched schema); the request
+    # events are the kind-less ones.
+    events = {
+        e["request_id"]: e for e in log.snapshot() if "kind" not in e
+    }
     assert sum(e["evictions"] for e in events.values()) >= 1
     for e in events.values():
         assert e["status"] == "ok"
+    pressure = [e for e in log.snapshot() if e.get("kind")]
+    assert pressure, "page pressure left no pool_pressure event"
+    assert all(e["kind"] == "oom_pressure" for e in pressure)
 
 
 # ---------------------------------------------------------------------------
