@@ -30,16 +30,19 @@ Rules:
 looks like a registry (`...registry.info` / `reg.info`) so ordinary
 `logger.info("...")` lines never match.
 
-Wide-event schema (PR 12): the same rule also checks every
-``build_request_event(...)`` call site (utils/request_log.py) — each
-literal keyword field must be snake_case AND drawn from the declared
-``REQUEST_EVENT_KEYS`` registry in utils/metrics.py (a superset of
-``REQUEST_COST_KEYS``). The registry is read from the canonical
+Wide-event schema (PR 12, extended PR 14): the same rule also checks
+every wide-event builder call site (utils/request_log.py:
+``build_request_event`` / ``build_oom_event`` / ``build_audit_event``)
+— each literal keyword field must be snake_case AND drawn from that
+builder's declared registry in utils/metrics.py
+(``REQUEST_EVENT_KEYS`` — a superset of ``REQUEST_COST_KEYS`` —
+``OOM_EVENT_KEYS``, ``AUDIT_EVENT_KEYS``; the builder->registry table
+is ``_EVENT_BUILDERS``). The registries are read from the canonical
 metrics module's AST (never imported — metrics.py imports jax), so
 the check works in single-file fixture runs too. A ``**splat`` passes
-statically (runtime validation in build_request_event covers it); a
-literal key outside the registry is exactly the silent-schema-drift
-this catches.
+statically (runtime validation in the builders covers it); a literal
+key outside the registry is exactly the silent-schema-drift this
+catches.
 """
 
 from __future__ import annotations
@@ -67,15 +70,26 @@ _USING = {"inc": "counter", "set_gauge": "gauge",
           "observe": "histogram", "set_info": "info"}
 
 
-_EVENT_BUILDER = "build_request_event"
-_EVENT_KEYS_CACHE: tuple[frozenset[str] | None, bool] = (None, False)
+# Every wide-event builder (utils/request_log.py) and the declared
+# schema registry in utils/metrics.py its literal keyword fields must
+# come from. One table, so adding an event kind means adding its
+# builder + registry pair here and nothing else in the rule.
+_EVENT_BUILDERS = {
+    "build_request_event": "REQUEST_EVENT_KEYS",
+    "build_oom_event": "OOM_EVENT_KEYS",
+    "build_audit_event": "AUDIT_EVENT_KEYS",
+}
+_EVENT_KEYS_CACHE: tuple[dict[str, frozenset[str]] | None, bool] = (
+    None, False,
+)
 
 
-def _event_keys() -> frozenset[str] | None:
-    """REQUEST_EVENT_KEYS resolved from utils/metrics.py by AST (the
-    canonical registry; REQUEST_COST_KEYS + literal extension). None
-    when the module or the assignments can't be found — the check then
-    stays quiet rather than guessing a schema."""
+def _event_keys() -> dict[str, frozenset[str]] | None:
+    """The wide-event schema registries resolved from utils/metrics.py
+    by AST ({registry name: keys}; REQUEST_EVENT_KEYS is
+    REQUEST_COST_KEYS + a literal extension). None when the module or
+    the assignments can't be found — the check then stays quiet rather
+    than guessing a schema."""
     global _EVENT_KEYS_CACHE
     keys, loaded = _EVENT_KEYS_CACHE
     if loaded:
@@ -84,6 +98,7 @@ def _event_keys() -> frozenset[str] | None:
         os.path.dirname(os.path.abspath(__file__)),
         os.pardir, "utils", "metrics.py",
     )
+    wanted = {"REQUEST_COST_KEYS"} | set(_EVENT_BUILDERS.values())
     resolved: dict[str, tuple[str, ...]] = {}
     try:
         with open(path, encoding="utf-8") as f:
@@ -93,9 +108,7 @@ def _event_keys() -> frozenset[str] | None:
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id in (
-                    "REQUEST_COST_KEYS", "REQUEST_EVENT_KEYS"
-                )
+                and node.targets[0].id in wanted
             ):
                 continue
             name = node.targets[0].id
@@ -116,21 +129,24 @@ def _event_keys() -> frozenset[str] | None:
                         and isinstance(e.value, str)
                     ]
             resolved[name] = tuple(parts)
-        keys = (
-            frozenset(resolved["REQUEST_EVENT_KEYS"])
-            if resolved.get("REQUEST_EVENT_KEYS") else None
-        )
+        keys = {
+            reg: frozenset(resolved[reg])
+            for reg in _EVENT_BUILDERS.values()
+            if resolved.get(reg)
+        } or None
     except (OSError, SyntaxError):
         keys = None
     _EVENT_KEYS_CACHE = (keys, True)
     return keys
 
 
-def _is_event_builder(call: ast.Call) -> bool:
+def _event_builder_name(call: ast.Call) -> str | None:
     fn = call.func
-    if isinstance(fn, ast.Name):
-        return fn.id == _EVENT_BUILDER
-    return isinstance(fn, ast.Attribute) and fn.attr == _EVENT_BUILDER
+    if isinstance(fn, ast.Name) and fn.id in _EVENT_BUILDERS:
+        return fn.id
+    if isinstance(fn, ast.Attribute) and fn.attr in _EVENT_BUILDERS:
+        return fn.attr
+    return None
 
 
 def _metric_call(call: ast.Call) -> tuple[str, str, bool] | None:
@@ -186,8 +202,9 @@ class MetricNameChecker(Checker):
         for call in ast.walk(mod.tree):
             if not isinstance(call, ast.Call):
                 continue
-            if _is_event_builder(call):
-                yield from self._check_event_fields(mod, call)
+            builder = _event_builder_name(call)
+            if builder is not None:
+                yield from self._check_event_fields(mod, call, builder)
                 continue
             mk = _metric_call(call)
             if mk is None or not call.args:
@@ -247,15 +264,19 @@ class MetricNameChecker(Checker):
     # ---- wide-event schema (utils/request_log.build_request_event) -------
 
     def _check_event_fields(
-        self, mod: ParsedModule, call: ast.Call
+        self, mod: ParsedModule, call: ast.Call, builder: str
     ) -> Iterator[Finding | None]:
-        """Literal keyword fields of a build_request_event call must be
-        snake_case members of REQUEST_EVENT_KEYS. `**splat` fields pass
-        here (build_request_event re-validates at runtime); the
-        defining module itself (utils/request_log.py, where the name is
-        a def, not a call into the registry contract) contains no call
-        sites, so no special-casing is needed."""
-        registry = _event_keys()
+        """Literal keyword fields of a wide-event builder call
+        (build_request_event / build_oom_event / build_audit_event)
+        must be snake_case members of that builder's declared schema
+        registry. `**splat` fields pass here (the builders re-validate
+        at runtime); the defining module itself (utils/request_log.py,
+        where the names are defs, not calls into the registry
+        contract) contains no call sites, so no special-casing is
+        needed."""
+        registries = _event_keys()
+        reg_name = _EVENT_BUILDERS[builder]
+        registry = (registries or {}).get(reg_name)
         for kw in call.keywords:
             if kw.arg is None:
                 continue  # **splat: runtime-validated
@@ -264,7 +285,7 @@ class MetricNameChecker(Checker):
                     mod,
                     call,
                     f"wide-event field {kw.arg!r} is not lowercase "
-                    "snake_case (the request-event schema is "
+                    "snake_case (the wide-event schemas are "
                     "snake_case throughout)",
                 )
             elif registry is not None and kw.arg not in registry:
@@ -272,7 +293,7 @@ class MetricNameChecker(Checker):
                     mod,
                     call,
                     f"wide-event field {kw.arg!r} is not declared in "
-                    "utils.metrics.REQUEST_EVENT_KEYS — extend the "
+                    f"utils.metrics.{reg_name} — extend the "
                     "registry (and the docs) instead of letting the "
                     "JSONL schema drift",
                 )

@@ -43,7 +43,7 @@ from __future__ import annotations
 
 # The manifest: one declaration, read by the static rule from this
 # comment and by the runtime sanitizer from the tuple beneath it.
-# lock-order: server.stream_lock < scheduler._cond < anomaly._lock < trace._lock < tracer._lock < request_log._lock < forensics._lock < watchdog._lock < router._lock < registry._lock < metrics.family
+# lock-order: server.stream_lock < scheduler._cond < anomaly._lock < trace._lock < tracer._lock < request_log._lock < forensics._lock < audit._lock < watchdog._lock < router._lock < registry._lock < metrics.family
 LOCK_ORDER: tuple[str, ...] = (
     "server.stream_lock",   # window-engine device lock (api_server)
     "scheduler._cond",      # admission queue + control flags
@@ -56,6 +56,10 @@ LOCK_ORDER: tuple[str, ...] = (
     "forensics._lock",      # OOM forensic ring (utils/forensics.py;
                             # a leaf like the request log — captures
                             # hold no other lock while appending)
+    "audit._lock",          # output-audit ring + verdict counts
+                            # (serve/audit.py; same leaf contract as
+                            # the forensic ring — held only for the
+                            # ring/counter edit, never across a replay)
     "watchdog._lock",       # stall-watchdog beat state
     "router._lock",         # front-end router replica table + affinity
                             # trie (serve/router.py; a router process

@@ -22,6 +22,7 @@ import numpy as np
 from oryx_tpu.config import GenerationConfig, LLMConfig
 from oryx_tpu.models import qwen2
 from oryx_tpu.ops import paged_kv as paged_kv_lib
+from oryx_tpu.utils import numerics as numerics_lib
 
 
 def sample_token(
@@ -591,7 +592,9 @@ def paged_prefill_chunks(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "chunk", "eos", "attn_impl", "compute_dtype"),
+    static_argnames=(
+        "cfg", "chunk", "eos", "attn_impl", "compute_dtype", "numerics",
+    ),
     donate_argnames=("kv_pages",),
 )
 def paged_decode_chunk(
@@ -613,6 +616,7 @@ def paged_decode_chunk(
     eos: int,
     attn_impl: str = "xla",
     compute_dtype=None,
+    numerics: bool = False,
 ):
     """`chunk` decode steps over a FIXED-SLOT batch with a paged cache —
     the continuous-batching inner loop. One compiled program per
@@ -626,7 +630,14 @@ def paged_decode_chunk(
     Step semantics mirror `_make_decode_step` exactly (greedy token ids
     are bit-identical to the dense path at equal logical KV width).
     Returns (kv_pages, tok, lengths, finished, recent, keys,
-    toks [S, chunk], fin [S, chunk])."""
+    toks [S, chunk], fin [S, chunk]).
+
+    numerics=True (STATIC — one extra stable compiled program, never a
+    per-step recompile) appends ONE more output: the [6] float32 logit
+    -stat accumulator (utils/numerics.py) folded over the chunk's live
+    rows inside this same dispatch — token streams and every other
+    output are bit-identical to the numerics=False program (the probe
+    only reads the logits the sampler already computed)."""
     page_size = kv_pages["k"].shape[2]
     K = block_tables.shape[1] * page_size
     slot_ar = jnp.arange(K, dtype=jnp.int32)[None, :]
@@ -640,7 +651,10 @@ def paged_decode_chunk(
         return jnp.any(jnp.all(m, axis=-1), axis=-1)
 
     def step(carry, _):
-        kv_pages, tok, cur_len, finished, recent, keys = carry
+        if numerics:
+            kv_pages, tok, cur_len, finished, recent, keys, nstats = carry
+        else:
+            kv_pages, tok, cur_len, finished, recent, keys = carry
         pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
         pos = cur_len[:, None]
         kv_mask = (slot_ar <= cur_len[:, None]).astype(jnp.int32)
@@ -652,6 +666,12 @@ def paged_decode_chunk(
             kv_lengths=cur_len + 1,
             attn_impl=attn_impl, compute_dtype=compute_dtype,
         )
+        if numerics:
+            # Live-row logit probe on the logits the sampler is about
+            # to consume — same dispatch, zero extra device calls.
+            nstats = numerics_lib.accumulate_logit_stats(
+                nstats, logits[:, 0], ~finished
+            )
         nxt = sample_token_rows(
             logits[:, 0], pair[:, 1],
             temperature=temperature, top_p=top_p, top_k=top_k,
@@ -661,15 +681,19 @@ def paged_decode_chunk(
         finished = finished | (tok == eos) | stop_hit(recent)
         nxt = jnp.where(finished, eos, nxt)
         cur_len = cur_len + (~finished).astype(jnp.int32)
-        return (kv_pages, nxt, cur_len, finished, recent, pair[:, 0]), (
-            tok, finished
-        )
+        out = (kv_pages, nxt, cur_len, finished, recent, pair[:, 0])
+        if numerics:
+            out = out + (nstats,)
+        return out, (tok, finished)
 
-    carry, (toks, fin) = jax.lax.scan(
-        step, (kv_pages, tok, lengths, finished, recent, keys), None,
-        length=chunk,
-    )
-    return carry + (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1))
+    carry0 = (kv_pages, tok, lengths, finished, recent, keys)
+    if numerics:
+        carry0 = carry0 + (numerics_lib.init_logit_stats(),)
+    carry, (toks, fin) = jax.lax.scan(step, carry0, None, length=chunk)
+    out = carry[:6] + (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1))
+    if numerics:
+        out = out + (carry[6],)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -712,6 +736,7 @@ def unpack_ragged_rows(
     jax.jit,
     static_argnames=(
         "cfg", "chunk", "pf_width", "eos", "attn_impl", "compute_dtype",
+        "numerics",
     ),
     donate_argnames=("kv_pages",),
 )
@@ -744,6 +769,7 @@ def paged_ragged_step(
     eos: int,
     attn_impl: str = "xla",
     compute_dtype=None,
+    numerics: bool = False,
 ):
     """ONE device dispatch for a mixed prefill+decode engine step — the
     fusion of `paged_prefill` (chunked) and `paged_decode_chunk`.
@@ -771,7 +797,12 @@ def paged_ragged_step(
     Returns (kv_pages, tok, lengths, finished, recent, keys,
     toks [S, chunk], fin [S, chunk], pf_tok0 [] int32, pf_key_next [1]).
     With pf_width=0 this is a pure packed decode step (the shape class
-    dispatched when no admission is in flight)."""
+    dispatched when no admission is in flight).
+
+    numerics=True (STATIC) appends the [6] float32 logit-stat
+    accumulator (utils/numerics.py) over the decode lanes' live rows —
+    same contract as paged_decode_chunk: one extra stable compiled
+    program, bit-identical tokens, zero extra dispatches."""
     from oryx_tpu.parallel.sharding import constrain
 
     S = tok.shape[0]
@@ -792,7 +823,11 @@ def paged_ragged_step(
         return e.astype(compute_dtype) if compute_dtype is not None else e
 
     def step(carry, i):
-        kv_pages, tok, cur_len, finished, recent, keys, pf_tok0 = carry
+        if numerics:
+            (kv_pages, tok, cur_len, finished, recent, keys, pf_tok0,
+             nstats) = carry
+        else:
+            kv_pages, tok, cur_len, finished, recent, keys, pf_tok0 = carry
         pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
         dec_emb = embed(tok)  # [S, H]
         seg = jnp.arange(S, dtype=jnp.int32)
@@ -827,6 +862,12 @@ def paged_ragged_step(
             attn_impl=attn_impl, compute_dtype=compute_dtype,
         )
         lg = logits[0]  # [R, V]
+        if numerics:
+            # Decode lanes only: the prefill lanes' logits are
+            # intermediate prompt positions, not sampling inputs.
+            nstats = numerics_lib.accumulate_logit_stats(
+                nstats, lg[:S], ~finished
+            )
         nxt = sample_token_rows(
             lg[:S], pair[:, 1],
             temperature=temperature, top_p=top_p, top_k=top_k,
@@ -849,23 +890,32 @@ def paged_ragged_step(
                 temperature=pf_temp, top_p=pf_top_p, top_k=pf_top_k,
             )[0]
             pf_tok0 = jnp.where(present, cand, pf_tok0)
-        return (
+        out = (
             kv_pages, nxt, cur_len, finished, recent, pair[:, 0], pf_tok0
-        ), (tok, finished)
+        )
+        if numerics:
+            out = out + (nstats,)
+        return out, (tok, finished)
 
-    carry, (toks, fin) = jax.lax.scan(
-        step,
-        (kv_pages, tok, lengths, finished, recent, keys,
-         jnp.zeros((), jnp.int32)),
-        jnp.arange(chunk, dtype=jnp.int32),
+    carry0 = (
+        kv_pages, tok, lengths, finished, recent, keys,
+        jnp.zeros((), jnp.int32),
     )
-    kv_pages, tok, lengths, finished, recent, keys, pf_tok0 = carry
+    if numerics:
+        carry0 = carry0 + (numerics_lib.init_logit_stats(),)
+    carry, (toks, fin) = jax.lax.scan(
+        step, carry0, jnp.arange(chunk, dtype=jnp.int32),
+    )
+    kv_pages, tok, lengths, finished, recent, keys, pf_tok0 = carry[:7]
     pf_key_next = jax.vmap(lambda k: jax.random.split(k, 2))(pf_key)[:, 0]
-    return (
+    out = (
         kv_pages, tok, lengths, finished, recent, keys,
         jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1),
         pf_tok0, pf_key_next,
     )
+    if numerics:
+        out = out + (carry[7],)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,15 @@ web-framework dependency.
                          ledgers, cache LRU tail, timeline tail —
                          captured at every OutOfPagesError and
                          degraded-mode escalation)
+  GET /debug/audit      (output-quality observatory: ?n= newest audit
+                         records — verdict, first-divergence position,
+                         per-position logit max-abs-diff/KL, top-k
+                         logit table, both token streams' tails — plus
+                         monotone verdict counts that reconcile
+                         exactly with oryx_audit_total{verdict=} and
+                         the pending/dropped sampler view. Armed with
+                         --audit-sample-every N; the ring and counters
+                         render empty/zero when off)
   GET /debug/profile    (on-demand device-time capture: bracket the
                          next ?steps=K dispatches in one jax.profiler
                          capture; returns a Perfetto-loadable Chrome
@@ -591,6 +600,8 @@ def build_server(
     ragged: bool = False,
     speculate: int = 0,
     profile_sample_every: int = 0,
+    audit_sample_every: int = 0,
+    numerics_every: int = 0,
     stall_timeout: float | None = None,
     flight_recorder_size: int = 256,
     ttft_slo: float | None = None,
@@ -679,6 +690,16 @@ def build_server(
             "--profile-sample-every requires a scheduler engine (the "
             "window batcher has no engine step loop to sample)"
         )
+    if engine == "window" and (audit_sample_every or numerics_every):
+        # Same fail-fast contract: the auditor replays through the
+        # scheduler's paged path and the numerics probe rides its
+        # dispatches — accepting the flags on the window batcher would
+        # promise audits/probes that never run.
+        raise ValueError(
+            "--audit-sample-every/--numerics-every require a scheduler "
+            "engine (the window batcher has no paged replay path or "
+            "engine step loop)"
+        )
     # $ORYX_LOCK_SANITIZER=1 arms the lock-order sanitizer + race
     # detector for this server (chaos/test runs). Armed BEFORE the
     # metrics registry and scheduler are built so every named lock
@@ -750,6 +771,8 @@ def build_server(
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
             ragged=ragged, speculate=speculate,
             profile_sample_every=profile_sample_every,
+            audit_sample_every=audit_sample_every,
+            numerics_every=numerics_every,
             max_queue=max_queue, request_timeout=request_timeout,
             degraded_cooldown=degraded_cooldown,
             request_log=request_log, engine_label=engine,
@@ -787,6 +810,32 @@ def build_server(
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet access log
             pass
+
+        def _ring_debug(self, get_ring, *, unavailable: str,
+                        default_n: int) -> None:
+            """Shared shape of the ring-backed debug endpoints
+            (/debug/timeline, /debug/oom, /debug/audit): scheduler-only
+            guard, ONE ?n= contract, engine label + the ring's
+            to_dict(n) body — so the three views can never drift on
+            parsing or error semantics."""
+            if scheduler is None:
+                self._json(400, {"error": unavailable})
+                return
+            q = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query
+            )
+            try:
+                n = int((q.get("n") or [str(default_n)])[0])
+                if n < 0:
+                    raise ValueError
+            except ValueError:
+                self._json(400, {
+                    "error": "n must be a non-negative integer",
+                })
+                return
+            body = {"engine": engine}
+            body.update(get_ring().to_dict(n or None))
+            self._json(200, body)
 
         def _json(self, code: int, body: dict[str, Any],
                   request_id: str | None = None,
@@ -890,28 +939,12 @@ def build_server(
                 # newest-first per-step records plus cumulative
                 # dispatch-kind counts that reconcile against
                 # oryx_serving_dispatches_total.
-                if scheduler is None:
-                    self._json(400, {
-                        "error": "the step timeline requires a "
-                        "scheduler engine (the window batcher has no "
-                        "engine step loop)",
-                    })
-                    return
-                q = urllib.parse.parse_qs(
-                    urllib.parse.urlsplit(self.path).query
+                self._ring_debug(
+                    lambda: scheduler.timeline, default_n=64,
+                    unavailable="the step timeline requires a "
+                    "scheduler engine (the window batcher has no "
+                    "engine step loop)",
                 )
-                try:
-                    n = int((q.get("n") or ["64"])[0])
-                    if n < 0:
-                        raise ValueError
-                except ValueError:
-                    self._json(400, {
-                        "error": "n must be a non-negative integer",
-                    })
-                    return
-                body = {"engine": engine}
-                body.update(scheduler.timeline.to_dict(n or None))
-                self._json(200, body)
             elif self.path.split("?", 1)[0] == "/debug/pages":
                 # Page-pool observatory (utils/pagemap.py): the live
                 # ownership map — per page free/slot/cache/shared,
@@ -951,28 +984,22 @@ def build_server(
                 # top-K residents with ledgers, cache LRU tail,
                 # timeline tail — captured at every OutOfPagesError
                 # and degraded-mode escalation.
-                if scheduler is None:
-                    self._json(400, {
-                        "error": "OOM forensics require a scheduler "
-                        "engine (the window batcher has no paged "
-                        "pool)",
-                    })
-                    return
-                q = urllib.parse.parse_qs(
-                    urllib.parse.urlsplit(self.path).query
+                self._ring_debug(
+                    lambda: scheduler.forensics, default_n=16,
+                    unavailable="OOM forensics require a scheduler "
+                    "engine (the window batcher has no paged pool)",
                 )
-                try:
-                    n = int((q.get("n") or ["16"])[0])
-                    if n < 0:
-                        raise ValueError
-                except ValueError:
-                    self._json(400, {
-                        "error": "n must be a non-negative integer",
-                    })
-                    return
-                body = {"engine": engine}
-                body.update(scheduler.forensics.to_dict(n or None))
-                self._json(200, body)
+            elif self.path.split("?", 1)[0] == "/debug/audit":
+                # Output-quality observatory (serve/audit.py): the
+                # bounded ring of shadow-parity audit records plus the
+                # monotone verdict counts /debug consumers reconcile
+                # against oryx_audit_total{verdict=}.
+                self._ring_debug(
+                    lambda: scheduler.auditor, default_n=16,
+                    unavailable="output audits require a scheduler "
+                    "engine (the window batcher has no paged replay "
+                    "path)",
+                )
             elif self.path.split("?", 1)[0] == "/debug/profile":
                 # On-demand device-time capture: bracket the next
                 # ?steps=K engine dispatches in one jax.profiler
@@ -1445,6 +1472,7 @@ def build_server(
     )
     srv.timeline = scheduler.timeline if scheduler is not None else None
     srv.forensics = scheduler.forensics if scheduler is not None else None
+    srv.auditor = scheduler.auditor if scheduler is not None else None
 
     def begin_drain() -> None:
         """Drain-on-shutdown, step 1: /readyz flips 503 NOW (router
@@ -1539,6 +1567,27 @@ def main(argv: list[str] | None = None) -> None:
         "increments oryx_profile_capture_errors_total). "
         "GET /debug/profile?steps=K serves on-demand captures either "
         "way",
+    )
+    ap.add_argument(
+        "--audit-sample-every", type=int, default=0, metavar="N",
+        help="continuous engine: audit every Nth FINISHED request — "
+        "replay it cold through the split XLA reference path at an "
+        "idle point of the engine loop and compare greedy byte parity "
+        "+ logit drift at sampled positions; verdicts land in "
+        "oryx_audit_total{verdict=}, the record ring at "
+        "GET /debug/audit, and kind=\"audit\" wide events (0 = off; "
+        "audits never perturb live traffic — see "
+        "docs/OBSERVABILITY.md \"Output quality & numerics\")",
+    )
+    ap.add_argument(
+        "--numerics-every", type=int, default=0, metavar="N",
+        help="continuous engine: every N engine steps the dispatch "
+        "carries the in-dispatch logit probe (finite fraction, "
+        "absmax, rms, entropy, top-1 margin -> oryx_numerics_* "
+        "gauges + the entropy_collapse/absmax_explosion sentinels); "
+        "a static program twin — zero extra dispatches, tokens "
+        "bit-identical (0 = off; not supported with --speculate — "
+        "the verify step carries no probe)",
     )
     ap.add_argument(
         "--no-prefix-cache", action="store_true",
@@ -1667,6 +1716,8 @@ def main(argv: list[str] | None = None) -> None:
         ragged=args.ragged,
         speculate=args.speculate,
         profile_sample_every=args.profile_sample_every,
+        audit_sample_every=args.audit_sample_every,
+        numerics_every=args.numerics_every,
         stall_timeout=args.stall_timeout or None,
         flight_recorder_size=args.flight_recorder_size,
         ttft_slo=args.ttft_slo,

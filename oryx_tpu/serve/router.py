@@ -547,6 +547,10 @@ def build_router(
             elif path == "/debug/oom":
                 # The fleet's OOM forensic rings, keyed by replica.
                 self._merged_replica_json("/debug/oom", query)
+            elif path == "/debug/audit":
+                # The fleet's output-audit rings, keyed by replica —
+                # same degrade-to-error-entry merge contract.
+                self._merged_replica_json("/debug/audit", query)
             elif path == "/debug/profile":
                 self._proxy_profile(query)
             elif path == "/debug/trace":

@@ -134,10 +134,12 @@ from oryx_tpu.models import generate as generate_lib
 from oryx_tpu.models import oryx, qwen2
 from oryx_tpu.ops import paged_kv
 from oryx_tpu.ops.packing import round_up_bucket
+from oryx_tpu.serve import audit as audit_lib
 from oryx_tpu.serve import pipeline as pipeline_lib
 from oryx_tpu.serve.prefix_cache import PagedPrefixCache
 from oryx_tpu.utils import faults
 from oryx_tpu.utils import forensics as forensics_lib
+from oryx_tpu.utils import numerics as numerics_lib
 from oryx_tpu.utils import pagemap
 from oryx_tpu.utils import profiling as profiling_lib
 from oryx_tpu.utils import request_log as request_log_lib
@@ -343,6 +345,8 @@ class ContinuousScheduler:
         replica_id: str | None = None,
         profile_sample_every: int = 0,
         forensics: forensics_lib.ForensicRing | None = None,
+        audit_sample_every: int = 0,
+        numerics_every: int = 0,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -634,6 +638,58 @@ class ContinuousScheduler:
         self.request_log = request_log or request_log_lib.RequestLog()
         self.engine_label = engine_label
         self.replica_id = replica_id
+        # Output auditor (serve/audit.py): shadow-parity replays of
+        # every Nth finished request, run on THIS thread at idle
+        # points only. Constructed unconditionally so the oryx_audit_*
+        # ladders render (at zero) even when sampling is off.
+        self.auditor = audit_lib.OutputAuditor(
+            pipe, page_size=page_size, max_ctx=max_ctx,
+            sample_every=audit_sample_every, metrics=self.metrics,
+            request_log=self.request_log, anomaly=self.anomaly,
+            engine_label=engine_label, replica_id=replica_id,
+        )
+        # Numerics sentinels (utils/numerics.py): every
+        # `numerics_every` engine steps the dispatch carries the logit
+        # -stat probe (a static-flag twin of the same program — extra
+        # scalar outputs, zero extra dispatches). 0 = off; the gauges
+        # are pre-registered either way.
+        if not isinstance(numerics_every, int) or numerics_every < 0:
+            raise ValueError(
+                "numerics_every must be a non-negative integer (steps "
+                f"between probe samples; 0 = off), got {numerics_every!r}"
+            )
+        if numerics_every and self.speculate:
+            # Fail fast instead of arming a probe that never samples:
+            # every decode dispatch in speculative mode is a
+            # paged_spec_step, which does not carry the numerics
+            # outputs (yet) — accepting the flag would leave the
+            # oryx_numerics_* gauges silently frozen at zero.
+            raise ValueError(
+                "numerics_every is not supported with speculate>0: the "
+                "speculative verify step carries no numerics probe — "
+                "drop --numerics-every or --speculate"
+            )
+        self.numerics_every = numerics_every
+        # Literal declarations (the greppable source of truth is
+        # numerics_lib.NUMERICS_GAUGES; tests assert the two agree).
+        self._numerics_gauges = {
+            "finite_frac": reg.gauge(
+                "oryx_numerics_logits_finite_frac", raw_name=True
+            ),
+            "absmax": reg.gauge(
+                "oryx_numerics_logits_absmax", raw_name=True
+            ),
+            "rms": reg.gauge("oryx_numerics_logits_rms", raw_name=True),
+            "entropy": reg.gauge(
+                "oryx_numerics_logits_entropy", raw_name=True
+            ),
+            "top1_margin": reg.gauge(
+                "oryx_numerics_logits_top1_margin", raw_name=True
+            ),
+        }
+        self._numerics_samples = reg.counter(
+            "oryx_numerics_samples_total", raw_name=True
+        )
         self.watchdog: trace_lib.StallWatchdog | None = None
         if stall_timeout is not None:
             self.watchdog = trace_lib.StallWatchdog(
@@ -1458,6 +1514,14 @@ class ContinuousScheduler:
                 self._update_degraded()
                 if self.watchdog is not None:
                     self.watchdog.set_active(False)
+                if self.auditor.pending():
+                    # Idle quiesce point: run ONE queued shadow-parity
+                    # replay, then re-check for live work — an arrival
+                    # never waits behind a second replay, and a replay
+                    # can never interleave with a live dispatch (the
+                    # never-perturb contract, serve/audit.py).
+                    self.auditor.run_one()
+                    continue
                 with self._cond:
                     if not self._queue and not self._shutdown:
                         self._cond.wait(timeout=0.1)
@@ -2263,11 +2327,11 @@ class ContinuousScheduler:
         # latency — the runtime twin of the static hot-path rule.
         hot_dispatch("scheduler._step_chunk")
         sampled = self._profile_dispatch_begin()
+        numer = self._numerics_due()
         t0 = time.monotonic()
         t0_ns = trace_lib.now_ns()
         with self.pipe._mesh_scope():
-            (self.kv_pages, tok, lengths, finished, recent, self.keys,
-             toks, fin) = generate_lib.paged_decode_chunk(
+            out = generate_lib.paged_decode_chunk(
                 self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
                 jnp.asarray(self.bt),
                 jnp.asarray(self.tok),
@@ -2282,12 +2346,17 @@ class ContinuousScheduler:
                 chunk=self.chunk, eos=self.cfg.generation.eos_token_id,
                 attn_impl=self.cfg.attn_impl,
                 compute_dtype=oryx.compute_dtype(self.cfg),
+                numerics=numer,
             )
+        nstats = out[8] if numer else None
+        (self.kv_pages, tok, lengths, finished, recent, self.keys,
+         toks, fin) = out[:8]
         toks, fin = self._harvest_chunk(
             tok, lengths, finished, recent, toks, fin
         )
         dt = time.monotonic() - t0
         dev_us = self._profile_dispatch_end(sampled, "decode", t0_ns)
+        self._record_numerics(nstats)
         live = [
             s for s, r in enumerate(self.slots)
             if r is not None and r.activated
@@ -2364,6 +2433,14 @@ class ContinuousScheduler:
             req.cost_decode_steps += lane_steps
             self._accrue_page_seconds(s)
             useful += self._advance(s, tokens)
+        if live and n_new is not None and self.anomaly is not None:
+            # Speculation drift guard (default-armed whenever
+            # --speculate is set): the mean tokens a live slot advanced
+            # this dispatch, against its own rolling baseline — a
+            # degraded drafter pages once per collapse episode.
+            self.anomaly.observe_spec_accept(
+                emitted / len(live), step=self.chunks_run,
+            )
         if live:
             # Per-token latency: tokens per slot this dispatch is
             # `chunk` for the scan paths, the mean accepted advance for
@@ -2383,6 +2460,36 @@ class ContinuousScheduler:
             accepted=emitted if n_new is not None else useful,
             device_us=device_us,
         )
+
+    def _numerics_due(self) -> bool:
+        """Host-side cadence for the in-dispatch logit probe: every
+        `numerics_every` engine steps the dispatch runs the probe-armed
+        twin of its compiled program (a STATIC flag — two stable
+        programs per shape class, tokens bit-identical either way)."""
+        return (
+            self.numerics_every > 0
+            and self.chunks_run % self.numerics_every == 0
+        )
+
+    def _record_numerics(self, nstats) -> None:
+        """Publish one probe sample (engine thread, post-harvest):
+        oryx_numerics_* gauges + the entropy_collapse /
+        absmax_explosion sentinels. None / zero-row accumulators (a
+        probe-armed dispatch where nothing decoded) are silently
+        skipped."""
+        if nstats is None:
+            return
+        stats = numerics_lib.finalize_logit_stats(nstats)
+        if stats is None:
+            return
+        for key, gauge in self._numerics_gauges.items():
+            gauge.set(stats[key])
+        self._numerics_samples.inc()
+        if self.anomaly is not None:
+            self.anomaly.observe_numerics(
+                entropy=stats["entropy"], absmax=stats["absmax"],
+                source_step=self.chunks_run,
+            )
 
     def _timeline_record(self, *, dur_s: float, kind: str, rows: int,
                          accepted: int,
@@ -2581,9 +2688,9 @@ class ContinuousScheduler:
                 device_us=dev_us,
             )
         else:
+            numer = self._numerics_due() and bool(live)
             with self.pipe._mesh_scope():
-                (self.kv_pages, tok, lengths, finished, recent, self.keys,
-                 toks, fin, pf_tok0, pf_key) = generate_lib.paged_ragged_step(
+                out = generate_lib.paged_ragged_step(
                     self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
                     jnp.asarray(self.bt),
                     jnp.asarray(self.tok),
@@ -2600,12 +2707,17 @@ class ContinuousScheduler:
                     eos=self.cfg.generation.eos_token_id,
                     attn_impl=self.cfg.attn_impl,
                     compute_dtype=dtype,
+                    numerics=numer,
                 )
+            nstats = out[10] if numer else None
+            (self.kv_pages, tok, lengths, finished, recent, self.keys,
+             toks, fin, pf_tok0, pf_key) = out[:10]
             toks, fin = self._harvest_chunk(
                 tok, lengths, finished, recent, toks, fin
             )
             dt = time.monotonic() - t0
             dev_us = self._profile_dispatch_end(sampled, "ragged", t0_ns)
+            self._record_numerics(nstats)
             # Decode billing covers only slots live DURING the dispatch
             # — a slot activated below joins the next dispatch, and its
             # toks row this time was frozen filler.
@@ -2829,6 +2941,10 @@ class ContinuousScheduler:
             completion_tokens=completion, cost=cost,
         )
         self._emit_request_event(req, status="ok")
+        # Output-audit sampling: every Nth finished request queues a
+        # shadow-parity replay job (host copies only; the replay runs
+        # later, at an idle point of this same thread).
+        self.auditor.observe_finished(req)
         _LOG.info(
             "request %s finished (%s, %d tokens)",
             req.trace.id, reason, completion,

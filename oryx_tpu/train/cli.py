@@ -65,6 +65,13 @@ def build_argparser() -> argparse.ArgumentParser:
         "'halt' raises out of the step loop (the pod restarts from the "
         "last checkpoint instead of burning chips on a poisoned run)",
     )
+    ap.add_argument(
+        "--numerics-every", type=int, default=0, metavar="N",
+        help="every N steps the jitted step runs its numerics-probe "
+        "twin (per-layer grad absmax, activation/param absmax -> "
+        "oryx_numerics_* gauges + the absmax_explosion sentinel); "
+        "0 = off",
+    )
     ap.add_argument("--num-steps", type=int, default=None)
     ap.add_argument("--video-frames", type=int, default=64)
     # Multi-host rendezvous (auto-detected on TPU pods; explicit for tests).
@@ -166,6 +173,7 @@ def main(argv: list[str] | None = None) -> None:
         metrics_port=args.metrics_port,
         events_path=args.events_path,
         on_anomaly=args.on_anomaly,
+        numerics_every=args.numerics_every,
     )
     if trainer.telemetry is not None and trainer.telemetry.port is not None:
         rank0_print(

@@ -57,6 +57,7 @@ def init_state(
 def microbatch_loss(
     params: Params, cfg: OryxConfig, mb: dict[str, jnp.ndarray],
     sharding_mode: str = "fsdp",
+    numerics: bool = False,
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
     # One sharded-constrained cast of the whole tree to the compute
     # dtype (sharding.cast_params_for_compute): ZeRO-3 use-site
@@ -90,10 +91,18 @@ def microbatch_loss(
         w, transpose = llm_p["embed"]["weight"], True
     else:
         w, transpose = llm_p["lm_head"]["kernel"], False
-    return chunked_causal_lm_loss(
+    loss, metrics = chunked_causal_lm_loss(
         hidden, w, mb["labels"],
         chunk=cfg.train.loss_chunk, transpose=transpose,
     )
+    if numerics:
+        # Activation absmax (the final hidden state — the residual
+        # stream every layer feeds): an fp16/bf16 range excursion shows
+        # here before the loss goes non-finite.
+        from oryx_tpu.utils import numerics as numerics_lib
+
+        metrics = dict(metrics, act_absmax=numerics_lib.tree_absmax(hidden))
+    return loss, metrics
 
 
 def train_step_fn(
@@ -102,8 +111,18 @@ def train_step_fn(
     cfg: OryxConfig,
     tx: optax.GradientTransformation,
     sharding_mode: str = "fsdp",
+    numerics: bool = False,
 ) -> tuple[TrainState, dict[str, jnp.ndarray]]:
     """One optimizer step over `accum` microbatches (unjitted body).
+
+    numerics=True (STATIC — the Trainer samples it every
+    `--numerics-every` steps, so at most two stable compiled programs
+    exist) adds the utils/numerics.py probes to the metrics dict:
+    `act_absmax` (final hidden state), `grad_absmax` (whole grad
+    tree), `param_absmax`, and `grad_layer_absmax` ([L] over the
+    stacked decoder layers — the "which layer is exploding" vector).
+    Params/opt-state updates are bit-identical either way (the probes
+    only read values the step already computed).
 
     batch: each leaf has leading [accum, ...] microbatch axis (accum == 1
     for plain steps); visual buffers are packed per-microbatch.
@@ -119,10 +138,11 @@ def train_step_fn(
     ZeRO-2's replicated params silently become fsdp-sharded after step 1).
     """
     grad_fn = jax.value_and_grad(
-        lambda p, c, m: microbatch_loss(p, c, m, sharding_mode),
+        lambda p, c, m: microbatch_loss(p, c, m, sharding_mode, numerics),
         has_aux=True,
     )
     accum = jax.tree.leaves(batch)[0].shape[0]
+    act_absmax = None
 
     # named_scope: phase names land in the XLA op metadata, so xplane
     # profiles (scripts/capture_trace.py) and the span<->device join can
@@ -137,6 +157,8 @@ def train_step_fn(
             )
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         ntok = metrics["num_tokens"]
+        if numerics:
+            act_absmax = metrics["act_absmax"]
     else:
         def one_micro(carry, mb):
             grads_acc, loss_acc, ntok_acc = carry
@@ -152,13 +174,17 @@ def train_step_fn(
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
-            (grads, loss_sum, ntok), _ = jax.lax.scan(
+            (grads, loss_sum, ntok), micro_metrics = jax.lax.scan(
                 one_micro,
                 (zeros, jnp.zeros((), jnp.float32),
                  jnp.zeros((), jnp.int32)),
                 batch,
             )
             grads = jax.tree.map(lambda g: g / accum, grads)
+        if numerics:
+            # The scan stacked each microbatch's probe: the step's
+            # activation absmax is the max across them.
+            act_absmax = jnp.max(micro_metrics["act_absmax"])
 
     with jax.named_scope("optimizer_update"):
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -169,6 +195,17 @@ def train_step_fn(
         "grad_norm": gnorm,
         "num_tokens": ntok,
     }
+    if numerics:
+        from oryx_tpu.utils import numerics as numerics_lib
+
+        metrics["act_absmax"] = act_absmax
+        metrics["grad_absmax"] = numerics_lib.tree_absmax(grads)
+        metrics["param_absmax"] = numerics_lib.tree_absmax(state.params)
+        layer_absmax = numerics_lib.stacked_layer_absmax(
+            grads.get("llm", {}).get("layers", {})
+        )
+        if layer_absmax is not None:
+            metrics["grad_layer_absmax"] = layer_absmax
     if cfg.train.skip_nonfinite_steps:
         # Anomalous-step guard (DeepSpeed's skip-on-overflow analog for
         # bf16: a poisoned batch or data-driven spike must not write NaNs
@@ -196,6 +233,6 @@ def train_step_fn(
 
 
 train_step = partial(
-    jax.jit, static_argnames=("cfg", "tx", "sharding_mode"),
+    jax.jit, static_argnames=("cfg", "tx", "sharding_mode", "numerics"),
     donate_argnames=("state",),
 )(train_step_fn)

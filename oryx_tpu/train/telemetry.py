@@ -133,6 +133,56 @@ class TrainTelemetry:
     def record_restore(self, seconds: float) -> None:
         self._phase["restore"].inc(max(0.0, seconds))
 
+    def record_numerics(
+        self,
+        step: int,
+        metrics: dict[str, Any],
+        *,
+        layer_absmax=None,
+    ) -> list:
+        """Publish one sampled numerics probe (utils/numerics.py via
+        train_step_fn's static `numerics` flag): the absmax scalars as
+        raw-named oryx_numerics_* gauges (the SAME family names the
+        serving registry publishes — one dashboard row covers both),
+        the per-layer grad absmax as a layer-labeled gauge, and the
+        absmax_explosion sentinel. Returns the anomalies fired, after
+        honoring the halt policy like record_step."""
+        r = self.registry
+        grad_absmax = metrics.get("grad_absmax")
+        for name, fam in (
+            ("grad_absmax", r.gauge(
+                "oryx_numerics_grad_absmax", raw_name=True
+            )),
+            ("act_absmax", r.gauge(
+                "oryx_numerics_act_absmax", raw_name=True
+            )),
+            ("param_absmax", r.gauge(
+                "oryx_numerics_param_absmax", raw_name=True
+            )),
+        ):
+            v = metrics.get(name)
+            if v is not None:
+                v = float(v)
+                fam.set(v if np.isfinite(v) else float("nan"))
+        r.counter("oryx_numerics_samples_total", raw_name=True).inc()
+        if layer_absmax is not None:
+            fam = r.gauge(
+                "oryx_numerics_grad_layer_absmax", ("layer",),
+                raw_name=True,
+            )
+            for i, v in enumerate(np.asarray(layer_absmax).tolist()):
+                fam.labels(layer=str(i)).set(float(v))
+        events = self.anomaly.observe_numerics(
+            absmax=(
+                float(grad_absmax) if grad_absmax is not None else None
+            ),
+            step=step,
+        )
+        if events and self.on_anomaly == "halt":
+            self.mark_ready(False, f"halted: {events[0].kind}")
+            raise AnomalyHalt(events)
+        return events
+
     def record_step(
         self,
         step: int,

@@ -80,8 +80,21 @@ class Trainer:
         anomaly_thresholds: AnomalyThresholds | None = None,
         telemetry: telemetry_lib.TrainTelemetry | None = None,
         max_data_faults: int = 8,
+        numerics_every: int = 0,
     ) -> None:
         self.cfg = cfg
+        # Numerics sentinels (utils/numerics.py): every N steps the
+        # jitted step runs its probe-armed static twin — per-layer
+        # grad absmax, activation/param absmax — feeding the
+        # oryx_numerics_* gauges and the absmax_explosion detector.
+        # 0 = off (the default: the probe tree-maps the whole grad
+        # tree, which is cheap but not free on giant models).
+        if not isinstance(numerics_every, int) or numerics_every < 0:
+            raise ValueError(
+                "numerics_every must be a non-negative integer (steps "
+                f"between probe samples; 0 = off), got {numerics_every!r}"
+            )
+        self.numerics_every = numerics_every
         # Data-loader containment: a transient loader failure skips
         # that fetch and pulls the next batch (bounded by
         # max_data_faults consecutive failures — a dead loader still
@@ -197,7 +210,7 @@ class Trainer:
             )
             self._step = jax.jit(
                 step_lib.train_step_fn,
-                static_argnames=("cfg", "tx", "sharding_mode"),
+                static_argnames=("cfg", "tx", "sharding_mode", "numerics"),
                 donate_argnames=("state",),
                 out_shardings=(state_shardings, None),
             )
@@ -355,10 +368,15 @@ class Trainer:
                     # step_lib.train_step jit lets GSPMD reshard zero2's
                     # replicated params to the fsdp opt-state spec after
                     # step 1 (see train_step_fn docstring).
+                    numer = (
+                        self.numerics_every > 0
+                        and step_i % self.numerics_every == 0
+                    )
                     with tr.span("step_dispatch") as sp_disp:
                         self.state, metrics = self._step(
                             self.state, batch, cfg=cfg, tx=self.tx,
                             sharding_mode=self.sharding_mode,
+                            numerics=numer,
                         )
                     # Async dispatch returns immediately; the sync span
                     # is where the device actually runs the step (plus
@@ -369,6 +387,18 @@ class Trainer:
                         host_metrics = jax.device_get(metrics)  # oryxlint: disable=host-sync
                     if self.watchdog is not None:
                         self.watchdog.beat()
+                    # The per-layer probe vector is telemetry-only: the
+                    # MetricLogger record holds scalars (the absmax
+                    # scalars ride it; the [L] vector would not
+                    # serialize as one number).
+                    layer_absmax = host_metrics.pop(
+                        "grad_layer_absmax", None
+                    )
+                    if numer and self.telemetry is not None:
+                        self.telemetry.record_numerics(
+                            step_i + 1, host_metrics,
+                            layer_absmax=layer_absmax,
+                        )
                     # Phase seconds ride the metric record too, so the
                     # JSONL/TensorBoard stream shows where a slow step
                     # went without pulling the flight recorder.

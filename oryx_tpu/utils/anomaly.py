@@ -17,6 +17,18 @@ Detectors (all host-side, O(window) memory, no deps):
                           rolling median.
   * ``ttft_slo``        — serving time-to-first-token above the SLO.
   * ``queue_depth_slo`` — serving admission queue above the SLO.
+  * ``entropy_collapse`` — logits entropy < `entropy_floor_frac` x
+                          rolling median (the distribution collapsing
+                          to a delta; utils/numerics.py probes feed it).
+  * ``absmax_explosion`` — logits/grad absmax > `absmax_factor` x
+                          rolling median (fp overflow on approach).
+  * ``audit_drift``     — the output auditor (serve/audit.py) returned
+                          a non-pass verdict; re-arms on the next pass
+                          (one event per drift EPISODE, not per audit).
+  * ``spec_accept_collapse`` — speculative accept-rate <
+                          `spec_accept_floor_frac` x rolling baseline
+                          (the drafter stopped earning its lanes);
+                          default-armed whenever --speculate is set.
 
 Every firing produces exactly one of each, not a flood: a detector is
 ARMED, fires once when its condition becomes true, and re-arms only
@@ -84,6 +96,13 @@ class AnomalyThresholds:
     throughput_floor_frac: float = 0.3
     ttft_slo_s: float | None = None
     queue_depth_slo: int | None = None
+    # Numerics sentinels (utils/numerics.py probes feed these): logits
+    # entropy collapsing toward a delta, absmax heading for overflow.
+    entropy_floor_frac: float = 0.25
+    absmax_factor: float = 10.0
+    # Speculation drift guard: accept-rate (tokens advanced per spec
+    # step) falling off its own rolling baseline.
+    spec_accept_floor_frac: float = 0.5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,9 +200,13 @@ class AnomalyMonitor:
         self._loss = _Window(t.window)
         self._gnorm = _Window(t.window)
         self._tput = _Window(t.window)
+        self._entropy = _Window(t.window)
+        self._absmax = _Window(t.window)
+        self._spec = _Window(t.window)
         self._nan_armed = True
         self._ttft_armed = True
         self._queue_armed = True
+        self._audit_armed = True
 
     # ---- firing ----------------------------------------------------------
 
@@ -342,6 +365,117 @@ class AnomalyMonitor:
             # Hysteresis: re-arm only once the backlog has genuinely
             # drained, not on every oscillation around the line.
             self._queue_armed = True
+        return []
+
+    # ---- numerics & output-quality signals -------------------------------
+
+    def observe_numerics(self, *, entropy: float | None = None,
+                         absmax: float | None = None,
+                         **context: Any) -> list[AnomalyEvent]:
+        """Feed one numerics probe sample (utils/numerics.py, from the
+        serving dispatch or a sampled train step). entropy_collapse
+        mirrors throughput_collapse (collapsed values never enter the
+        window — they would re-baseline the detector onto the collapsed
+        level); absmax_explosion mirrors grad_norm_explosion (spikes
+        enter the window: a new, genuinely higher plateau should stop
+        firing once it IS the baseline)."""
+        t = self.thresholds
+        out: list[AnomalyEvent] = []
+        if entropy is not None:
+            e = float(entropy)
+            if math.isfinite(e) and e >= 0:
+                med = self._entropy.median()
+                if (
+                    med is not None and med > 0
+                    and len(self._entropy.values) >= t.min_window
+                    and e < t.entropy_floor_frac * med
+                ):
+                    if self._entropy.armed:
+                        self._entropy.armed = False
+                        out.append(self._fire(
+                            "entropy_collapse",
+                            f"logits entropy {e:.4g} < "
+                            f"{t.entropy_floor_frac:g}x rolling median "
+                            f"{med:.4g}",
+                            e, t.entropy_floor_frac * med,
+                            window_median=med, **context,
+                        ))
+                else:
+                    self._entropy.armed = True
+                    self._entropy.values.append(e)
+        if absmax is not None:
+            a = float(absmax)
+            if math.isfinite(a):
+                med = self._absmax.median()
+                if (
+                    med is not None and med > 0
+                    and len(self._absmax.values) >= t.min_window
+                    and a > t.absmax_factor * med
+                ):
+                    if self._absmax.armed:
+                        self._absmax.armed = False
+                        out.append(self._fire(
+                            "absmax_explosion",
+                            f"absmax {a:.4g} > {t.absmax_factor:g}x "
+                            f"rolling median {med:.4g}",
+                            a, t.absmax_factor * med,
+                            window_median=med, **context,
+                        ))
+                else:
+                    self._absmax.armed = True
+                self._absmax.values.append(a)
+        return out
+
+    def observe_audit(self, verdict: str, *,
+                      request_id: str = "",
+                      **context: Any) -> list[AnomalyEvent]:
+        """Feed one output-audit verdict (serve/audit.py). Fires
+        `audit_drift` once per drift EPISODE: armed, fires on the first
+        non-pass verdict, re-arms only after a pass — a systematically
+        drifting path produces one page, not one per sampled request."""
+        if verdict == "pass":
+            self._audit_armed = True
+            return []
+        if not self._audit_armed:
+            return []
+        self._audit_armed = False
+        return [self._fire(
+            "audit_drift",
+            f"output audit verdict {verdict!r}"
+            + (f" (request {request_id})" if request_id else ""),
+            1.0, 0.0, verdict=verdict, request_id=request_id, **context,
+        )]
+
+    def observe_spec_accept(self, rate: float,
+                            **context: Any) -> list[AnomalyEvent]:
+        """Feed one speculative step's accept signal (mean tokens a
+        live slot advanced this dispatch, 1.0 = every draft rejected).
+        Same collapsed-values-stay-out-of-the-window contract as
+        throughput_collapse: a degraded drafter must not silently
+        become its own baseline."""
+        t = self.thresholds
+        r = float(rate)
+        if not (math.isfinite(r) and r > 0):
+            return []
+        med = self._spec.median()
+        if (
+            med is not None and med > 0
+            and len(self._spec.values) >= t.min_window
+            and r < t.spec_accept_floor_frac * med
+        ):
+            if self._spec.armed:
+                self._spec.armed = False
+                return [self._fire(
+                    "spec_accept_collapse",
+                    f"speculative accept {r:.4g} tokens/step < "
+                    f"{t.spec_accept_floor_frac:g}x rolling baseline "
+                    f"{med:.4g}",
+                    r, t.spec_accept_floor_frac * med,
+                    window_median=med, **context,
+                )]
+        else:
+            self._spec.armed = True
+            self._spec.values.append(r)
         return []
 
     def close(self) -> None:

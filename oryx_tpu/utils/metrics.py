@@ -519,6 +519,39 @@ REQUEST_EVENT_KEYS = REQUEST_COST_KEYS + (
 # this event is the greppable one-liner in requests.jsonl). Declared
 # next to REQUEST_EVENT_KEYS for the same reason: one source of truth
 # for sink validation.
+# Logit-drift ladders for the output auditor (serve/audit.py): the
+# max-abs-diff ladder spans exact parity (the fp path's expected 0)
+# through bf16 rounding noise to "a different model"; the KL ladder is
+# the same story in distribution space. Both are raw-named
+# oryx_audit_* families, pre-registered so the ladders render at zero
+# before the first audit.
+AUDIT_DIFF_BUCKETS = (0.0, 1e-6, 1e-5, 1e-4, 1e-3, 5e-3, 0.025, 0.1,
+                      0.5, 1.0, 4.0, 16.0)
+AUDIT_KL_BUCKETS = (0.0, 1e-8, 1e-6, 1e-5, 1e-4, 1e-3, 0.01, 0.1, 0.5,
+                    1.0, 4.0)
+
+# The output-audit wide-event schema (kind="audit"): one flat line per
+# completed audit through the request-log sink, joining the verdict
+# counters to the forensic ring (`audit_index` is the /debug/audit
+# join key, like `forensic_index` for oom_pressure). Declared next to
+# the other schemas so sink validation and oryxlint's event-builder
+# check share one source of truth.
+AUDIT_EVENT_KEYS = (
+    "schema", "ts_unix_s",
+    "kind",                   # always "audit"
+    "request_id",             # the audited request (joins its trace)
+    "engine", "replica",
+    "verdict",                # pass | drift | fail
+    "first_divergence",       # token index of the first mismatch, -1
+    "replayed_tokens",        # tokens the replay regenerated
+    "positions_checked",      # logit positions compared
+    "logit_max_abs_diff",     # max over the checked positions
+    "kl",                     # max KL over the checked positions
+    "evictions",              # replays the LIVE request paid (the
+                              # determinism the auditor leans on)
+    "audit_index",            # index of the full record in /debug/audit
+)
+
 OOM_EVENT_KEYS = (
     "schema", "ts_unix_s",
     "kind",                  # always "oom_pressure"

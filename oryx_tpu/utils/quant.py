@@ -123,6 +123,71 @@ def quantize_params(params: Params, cast=None) -> Params:
     return walk(params, ())
 
 
+# ---------------------------------------------------------------------------
+# Round-trip error statistics (the int8 paged-KV groundwork)
+# ---------------------------------------------------------------------------
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
+               dtype=jnp.float32) -> jnp.ndarray:
+    """Invert `quantize_array`'s mapping (or any symmetric int8 +
+    scale pair, e.g. a per-page KV quantizer's output)."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def roundtrip_error_stats(
+    w: jnp.ndarray, *, axis: int = -2
+) -> dict[str, float]:
+    """Quantize-dequantize `w` through the symmetric int8 path and
+    report the reconstruction error: max-abs and rms, absolute and
+    relative to the tensor's own absmax. One call answers "is int8
+    good enough for THIS tensor" — the standing spot-check ROADMAP
+    item 3's quantized-KV PR gates against (and what test_quant.py
+    pins so the quantizer's error envelope cannot drift silently).
+
+    axis: the reduction axis the scale spans (-2 = per-output-channel,
+    the weight path's convention)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = amax / 127.0 + jnp.finfo(jnp.float32).tiny
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    err = jnp.abs(dequantize(q, scale) - w)
+    overall = float(jnp.max(jnp.abs(w)))
+    max_abs = float(jnp.max(err))
+    rms = float(jnp.sqrt(jnp.mean(err * err)))
+    return {
+        "max_abs_err": max_abs,
+        "rms_err": rms,
+        "rel_max_abs_err": max_abs / overall if overall else 0.0,
+        "rel_rms_err": rms / overall if overall else 0.0,
+    }
+
+
+def page_roundtrip_error(
+    pages: jnp.ndarray,  # [P, page, Hk, D] one layer's K or V pool
+) -> dict[str, jnp.ndarray]:
+    """PER-PAGE symmetric-int8 round-trip error over a paged KV pool
+    layer: one scale per page (the int8 paged-KV design — quantize on
+    page write, dequantize inside the kernel's page walk), errors
+    reduced per page so the answer is a [P] vector an operator (or the
+    audit plane) can rank: which resident's pages would int8 hurt
+    most. Returns {"max_abs_err": [P], "rms_err": [P], "scale": [P]}."""
+    x = jnp.asarray(pages, jnp.float32)
+    P = x.shape[0]
+    flat = x.reshape(P, -1)
+    amax = jnp.max(jnp.abs(flat), axis=1)
+    scale = amax / 127.0 + jnp.finfo(jnp.float32).tiny
+    q = jnp.clip(
+        jnp.round(flat / scale[:, None]), -127, 127
+    ).astype(jnp.int8)
+    err = jnp.abs(q.astype(jnp.float32) * scale[:, None] - flat)
+    return {
+        "max_abs_err": jnp.max(err, axis=1),
+        "rms_err": jnp.sqrt(jnp.mean(err * err, axis=1)),
+        "scale": scale,
+    }
+
+
 def quantized_bytes(params: Params) -> int:
     """Total serving bytes of a (possibly quantized) param tree."""
     total = 0

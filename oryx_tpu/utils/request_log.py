@@ -40,7 +40,11 @@ from collections import deque
 from typing import Any
 
 from oryx_tpu.analysis.sanitizers import named_lock
-from oryx_tpu.utils.metrics import OOM_EVENT_KEYS, REQUEST_EVENT_KEYS
+from oryx_tpu.utils.metrics import (
+    AUDIT_EVENT_KEYS,
+    OOM_EVENT_KEYS,
+    REQUEST_EVENT_KEYS,
+)
 
 # The current wide-event schema version, stamped into every event so
 # offline consumers can dispatch on it when fields are added.
@@ -53,6 +57,7 @@ _KEYSET = frozenset(REQUEST_EVENT_KEYS)
 # request-event key, so a request event can never be mistaken for one).
 _KIND_KEYSETS = {
     "oom_pressure": frozenset(OOM_EVENT_KEYS),
+    "audit": frozenset(AUDIT_EVENT_KEYS),
 }
 
 
@@ -101,6 +106,30 @@ def build_oom_event(**fields: Any) -> dict[str, Any]:
     return ev
 
 
+def build_audit_event(**fields: Any) -> dict[str, Any]:
+    """Assemble one output-audit wide event (`kind="audit"`), validated
+    against utils.metrics.AUDIT_EVENT_KEYS — the flat one-line spelling
+    of an audit record (serve/audit.py holds the full artifact at
+    /debug/audit; `audit_index` joins the two). Same loud-failure
+    contract as build_request_event."""
+    bad = sorted(
+        k for k in fields
+        if k not in _KIND_KEYSETS["audit"] or not _SNAKE_RE.match(k)
+    )
+    if bad:
+        raise ValueError(
+            f"undeclared audit-event field(s) {bad}: add them to "
+            "utils.metrics.AUDIT_EVENT_KEYS (the output-audit schema "
+            "registry) or fix the name"
+        )
+    ev: dict[str, Any] = {
+        "schema": EVENT_SCHEMA, "ts_unix_s": time.time(),
+        "kind": "audit",
+    }
+    ev.update(fields)
+    return ev
+
+
 class RequestLog:
     """Bounded ring + optional rotating JSONL file of wide events.
 
@@ -128,9 +157,10 @@ class RequestLog:
 
     def append(self, event: dict[str, Any]) -> None:
         """Record one event (normally built by build_request_event /
-        build_oom_event; re-validated here so a hand-rolled dict can't
-        bypass a registry). The schema is dispatched on `kind`: absent
-        = a request event, "oom_pressure" = the memory-pressure
+        build_oom_event / build_audit_event; re-validated here so a
+        hand-rolled dict can't bypass a registry). The schema is
+        dispatched on `kind`: absent = a request event, "oom_pressure"
+        = the memory-pressure schema, "audit" = the output-audit
         schema."""
         keyset = _KIND_KEYSETS.get(event.get("kind"), _KEYSET)
         bad = sorted(k for k in event if k not in keyset)

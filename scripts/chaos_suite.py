@@ -16,6 +16,11 @@ Scenarios (one armed `utils/faults.py` spec each, fully deterministic):
   * ``client_disconnect`` the SSE write path raises BrokenPipeError
                           (the dropped-socket code path) — the request
                           cancels, pages and cache shares freed.
+  * ``spec_drift``        an oracle drafter degrades mid-run into
+                          proposing garbage — the spec_accept_collapse
+                          detector fires EXACTLY ONE event for the
+                          whole episode, replies stay byte-identical
+                          (rejected drafts are dead lanes), zero leaks.
   * ``checkpoint_save``   injected save failures — bounded
                           exponential-backoff retry lands the
                           checkpoint; the schedule is pinned (no
@@ -361,6 +366,110 @@ def scenario_client_disconnect(h: Harness) -> None:
         h.teardown(srv)
 
 
+def scenario_spec_drift(h: Harness) -> None:
+    """Speculation drift guard (ISSUE 14 satellite): an ORACLE drafter
+    (proposes the request's known future — accept rate k+1) degrades
+    mid-run into proposing garbage (accept rate collapses to 1.0).
+    The spec_accept_collapse detector — default-armed whenever
+    --speculate is set — must fire EXACTLY ONE event for the whole
+    degraded phase (one page per episode, not one per dispatch), and
+    the engine must stay healthy: every reply byte-identical to the
+    solo pipeline, pool invariant intact, zero leaks."""
+    from oryx_tpu.models import generate as gen_lib
+    from oryx_tpu.serve.scheduler import ContinuousScheduler
+    from oryx_tpu.utils.anomaly import AnomalyMonitor
+
+    q, cap = "tell me a long story please", 40
+    ref = h.pipe.chat(q, max_new_tokens=cap)
+    ids = len(h.pipe._prepare_request({"question": q})[0])
+
+    class Tap(gen_lib.Drafter):
+        def __init__(self):
+            self.longest: list[int] = []
+
+        def propose(self, context, k):
+            ctx = [int(x) for x in context]
+            if len(ctx) > len(self.longest):
+                self.longest = ctx
+            return []
+
+    # Record the greedy reply's token stream with a pure-observer
+    # drafter (the engine then behaves exactly like the plain path).
+    tap = Tap()
+    sched = ContinuousScheduler(
+        h.pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        prefill_chunk=8, ragged=True, speculate=1, drafter=tap,
+        autostart=False, prefix_cache=False,
+    )
+    hd = sched.submit({"question": q}, cap)
+    sched.start()
+    if hd.result(timeout=600)[0] != ref:
+        fail("[spec_drift] tap run diverged from the solo pipeline")
+    sched.close()
+    stream = tap.longest[ids:]
+
+    class DegradableOracle(gen_lib.Drafter):
+        """Perfect drafts until degrade(); garbage after."""
+
+        def __init__(self, prompt_len: int, stream: list[int]):
+            self.prompt_len = prompt_len
+            self.stream = stream
+            self.degraded = False
+
+        def degrade(self):
+            self.degraded = True
+
+        def propose(self, context, k):
+            if self.degraded:
+                return [7] * k  # (almost) always rejected on greedy
+            done = len(context) - self.prompt_len
+            return self.stream[done: done + k]
+
+    oracle = DegradableOracle(ids, stream)
+    monitor = AnomalyMonitor(source="serve")
+    sched = ContinuousScheduler(
+        h.pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        prefill_chunk=8, ragged=True, speculate=3, drafter=oracle,
+        anomaly=monitor, autostart=False, prefix_cache=False,
+    )
+    sched.start()
+    try:
+        # Healthy phase: enough spec dispatches to build the rolling
+        # baseline (min_window) at the oracle's high accept rate.
+        for _ in range(2):
+            hd = sched.submit({"question": q}, cap)
+            if hd.result(timeout=600)[0] != ref:
+                fail("[spec_drift] healthy-phase reply diverged")
+        if monitor.counts.get("spec_accept_collapse", 0):
+            fail("[spec_drift] detector fired during the HEALTHY phase")
+        # Mid-run degradation: the drafter starts proposing garbage.
+        oracle.degrade()
+        for _ in range(2):
+            hd = sched.submit({"question": q}, cap)
+            if hd.result(timeout=600)[0] != ref:
+                fail("[spec_drift] degraded-phase reply diverged — "
+                     "rejected drafts must not corrupt the stream")
+        fired = monitor.counts.get("spec_accept_collapse", 0)
+        if fired != 1:
+            fail(f"[spec_drift] spec_accept_collapse fired {fired} "
+                 "time(s) across the degraded phase, want exactly 1 "
+                 "(one event per episode)")
+        sched._check_pool_invariant()
+        held = sum(
+            1 for p in range(sched.allocator.num_pages)
+            if sched.allocator.refcount(p) > 0
+        )
+        if held:
+            fail(f"[spec_drift] {held} page(s) still held after the "
+                 "degraded phase drained")
+    finally:
+        sched.close()
+        monitor.close()
+    print("  [spec_drift] contained: oracle degraded mid-run -> "
+          "exactly 1 spec_accept_collapse event, replies "
+          "byte-identical, 0 leaks")
+
+
 def scenario_checkpoint_save(h: Harness) -> None:
     """Two injected save failures: bounded backoff retries land the
     checkpoint on the third attempt, schedule pinned (no wall-clock
@@ -442,12 +551,13 @@ def main() -> None:
     params = oryx.init_params(cfg, jax.random.key(0))
     pipe = OryxInference(_Tokenizer(), params, cfg)
     h = Harness(pipe)
-    print("chaos suite: 5 scenarios against a live tiny server")
+    print("chaos suite: 6 scenarios against a live tiny server")
     for scenario in (
         scenario_page_alloc_oom,
         scenario_engine_crash,
         scenario_hung_dispatch,
         scenario_client_disconnect,
+        scenario_spec_drift,
         scenario_checkpoint_save,
     ):
         scenario(h)

@@ -167,10 +167,12 @@ def run_checks(base: str) -> str:
         ("oryx_router_", "oryx_anomaly_") if kind == "router"
         # oryx_pool_/oryx_page_ are the page-pool observatory's raw
         # families, oryx_device_time_/oryx_profile_ the device-time
-        # attributor's — raw-named like oryx_anomaly_ because their
+        # attributor's, oryx_audit_/oryx_numerics_ the output-quality
+        # observatory's — raw-named like oryx_anomaly_ because their
         # semantics are engine-independent.
         else ("oryx_serving_", "oryx_anomaly_", "oryx_pool_",
-              "oryx_page_", "oryx_device_time_", "oryx_profile_")
+              "oryx_page_", "oryx_device_time_", "oryx_profile_",
+              "oryx_audit_", "oryx_numerics_")
     )
     info_family = (
         "oryx_router_build_info" if kind == "router"
@@ -217,6 +219,35 @@ def run_checks(base: str) -> str:
         if "oryx_serving_hbm_live_bytes" not in metrics_text:
             fail("device-memory gauge oryx_serving_hbm_live_bytes "
                  "missing from /metrics")
+        # Output-quality & numerics families: pre-registered so the
+        # ladders render (at zero) on an UNARMED default boot — the
+        # dashboard row must exist before the first audit/probe.
+        for verdict in ("pass", "drift", "fail"):
+            if not re.search(
+                rf'^oryx_audit_total\{{verdict="{verdict}"\}} ',
+                metrics_text, re.M,
+            ):
+                fail(f"oryx_audit_total{{verdict=\"{verdict}\"}} not "
+                     "pre-registered on an unarmed boot")
+        for fam in (
+            "oryx_audit_sampled_total",
+            "oryx_audit_dropped_total",
+            "oryx_audit_pending",
+            "oryx_audit_replayed_tokens_total",
+            "oryx_numerics_logits_finite_frac",
+            "oryx_numerics_logits_absmax",
+            "oryx_numerics_logits_rms",
+            "oryx_numerics_logits_entropy",
+            "oryx_numerics_logits_top1_margin",
+            "oryx_numerics_samples_total",
+        ):
+            if not re.search(rf"^{fam} ", metrics_text, re.M):
+                fail(f"{fam} not pre-registered on an unarmed boot")
+        for fam in ("oryx_audit_logit_max_abs_diff", "oryx_audit_kl"):
+            if not re.search(
+                rf'^{fam}_bucket\{{le="\+Inf"\}} ', metrics_text, re.M
+            ):
+                fail(f"{fam} histogram ladder not pre-registered")
     else:
         # The router has no HBM of its own; the fleet's shows through
         # the aggregation endpoint, every sample line replica-labeled.
@@ -500,6 +531,30 @@ def run_checks(base: str) -> str:
         if set(om.get("replicas") or {}) != set(reps):
             fail(f"router /debug/oom replicas {sorted(om)} do not "
                  f"match /debug/pages {sorted(reps)}")
+    # Output-quality observatory surface: /debug/audit answers on an
+    # UNARMED target (empty ring, zero verdicts that reconcile with the
+    # zero counters); the router merges it per replica.
+    with _get(base, "/debug/audit") as r:
+        au = json.load(r)
+    if kind == "replica":
+        verdicts = au.get("verdicts") or {}
+        if au.get("total") != sum(verdicts.values()):
+            fail(f"/debug/audit total {au.get('total')} != sum of "
+                 f"verdicts {verdicts}")
+        with _get(base, "/metrics") as r:
+            atext = r.read().decode()
+        for verdict, want in verdicts.items():
+            m = re.search(
+                rf'^oryx_audit_total\{{verdict="{verdict}"\}} '
+                rf"([0-9.e+-]+)$", atext, re.M,
+            )
+            if not m or float(m.group(1)) != want:
+                fail(f"/debug/audit verdict {verdict!r}={want} does "
+                     "not reconcile with oryx_audit_total "
+                     f"({m.group(1) if m else 'absent'})")
+    else:
+        if not au.get("replicas"):
+            fail("router /debug/audit returned no replicas")
     return kind
 
 
@@ -601,6 +656,162 @@ def run_oom_forensic_check() -> None:
         _shutdown_replica(srv)
 
 
+def run_audit_check() -> None:
+    """The output-quality observatory gate (ISSUE 14): the SAME
+    sequential greedy burst against an ARMED (--audit-sample-every 1)
+    and an UNARMED tiny replica, gating:
+
+      * every sampled request audits verdict=pass on the fp path —
+        zero fail, zero drift;
+      * the /debug/audit ring/verdict counts reconcile EXACTLY with
+        oryx_audit_total{verdict=};
+      * every kind="audit" wide event validates against the declared
+        schema (utils.metrics.AUDIT_EVENT_KEYS) and joins the ring by
+        audit_index;
+      * the auditor observes, never perturbs: live-traffic reply bytes
+        AND oryx_serving_dispatches_total{kind=} are identical between
+        the armed and unarmed runs (sequential requests — the dispatch
+        schedule is deterministic).
+    """
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx as oryx_lib
+    from oryx_tpu.serve import api_server
+    from oryx_tpu.serve.pipeline import OryxInference
+    from oryx_tpu.utils.metrics import AUDIT_EVENT_KEYS
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx_lib.init_params(cfg, jax.random.key(0))
+
+    bursts = [
+        ("hello there, audit me", 6),
+        ("a different question now", 4),
+        ("hello there, audit me", 6),  # repeat: splice path audited too
+        ("one more to finish the burst", 5),
+    ]
+
+    def boot(audit_every: int):
+        pipe = OryxInference(_Tokenizer(), params, cfg)
+        srv = api_server.build_server(
+            pipe, port=0, engine="continuous", num_slots=2,
+            page_size=16, decode_chunk=4, max_ctx=512, prefill_chunk=32,
+            audit_sample_every=audit_every,
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def drive(srv) -> tuple[list[str], dict[str, float]]:
+        base = _base_of(srv)
+        replies = []
+        for i, (q, toks) in enumerate(bursts):
+            req = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [{"role": "user", "content": q}],
+                    "max_tokens": toks,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                body = json.load(r)
+            replies.append(body["choices"][0]["message"]["content"])
+        with _get(base, "/metrics") as r:
+            text = r.read().decode()
+        dispatches = {
+            m.group(1): float(m.group(2))
+            for m in re.finditer(
+                r'^oryx_serving_dispatches_total\{kind="([^"]+)"\} '
+                r"([0-9.e+-]+)$", text, re.M,
+            )
+        }
+        return replies, dispatches
+
+    armed = boot(1)
+    plain = boot(0)
+    try:
+        armed_replies, armed_disp = drive(armed)
+        base = _base_of(armed)
+        # Drain the audit backlog: replays run at engine idle points,
+        # so after the last reply they complete within a poll window.
+        import time as time_lib
+
+        deadline = time_lib.monotonic() + 120
+        while time_lib.monotonic() < deadline:
+            with _get(base, "/debug/audit?n=64") as r:
+                au = json.load(r)
+            if au.get("pending") == 0 and au.get("total", 0) >= len(
+                bursts
+            ):
+                break
+            time_lib.sleep(0.1)
+        if au.get("pending") != 0:
+            fail(f"audit backlog never drained: {au.get('pending')} "
+                 "pending after the burst")
+        verdicts = au.get("verdicts") or {}
+        if verdicts.get("fail") or verdicts.get("drift"):
+            fail(f"non-pass audit verdict(s) on the fp path: "
+                 f"{verdicts} (records: {au.get('records')})")
+        if au.get("total") != len(bursts) \
+                or verdicts.get("pass") != len(bursts):
+            fail(f"expected {len(bursts)} pass audits, got total="
+                 f"{au.get('total')} verdicts={verdicts}")
+        # Ring <-> counter reconciliation on the quiesced replica.
+        with _get(base, "/metrics") as r:
+            atext = r.read().decode()
+        for verdict, want in verdicts.items():
+            m = re.search(
+                rf'^oryx_audit_total\{{verdict="{verdict}"\}} '
+                rf"([0-9.e+-]+)$", atext, re.M,
+            )
+            if not m or float(m.group(1)) != want:
+                fail(f"oryx_audit_total verdict {verdict!r} "
+                     f"({m.group(1) if m else 'absent'}) does not "
+                     f"reconcile with /debug/audit's {want}")
+        if not re.search(
+            r"^oryx_audit_logit_max_abs_diff_count [1-9]", atext, re.M
+        ):
+            fail("oryx_audit_logit_max_abs_diff recorded no samples "
+                 "over an armed burst")
+        # Every audit's wide event validates and joins the ring.
+        with _get(base, "/debug/requests?format=jsonl") as r:
+            events = [json.loads(ln) for ln in
+                      r.read().decode().splitlines() if ln]
+        audits = [e for e in events if e.get("kind") == "audit"]
+        if len(audits) != len(bursts):
+            fail(f"{len(audits)} kind=audit wide event(s), want "
+                 f"{len(bursts)}")
+        indices = {rec["index"] for rec in au.get("records") or []}
+        for ev in audits:
+            extra = set(ev) - set(AUDIT_EVENT_KEYS)
+            if extra:
+                fail(f"audit wide event carries undeclared fields "
+                     f"{sorted(extra)}")
+            if ev.get("verdict") != "pass":
+                fail(f"audit wide event is not a pass: {ev}")
+            if ev.get("audit_index") not in indices:
+                fail(f"audit wide event index {ev.get('audit_index')} "
+                     "does not join the /debug/audit ring")
+        # Never-perturb A/B: byte parity + identical dispatch schedule
+        # against the unarmed twin.
+        plain_replies, plain_disp = drive(plain)
+        if armed_replies != plain_replies:
+            fail("armed vs unarmed replies diverged — the auditor "
+                 f"perturbed live traffic: {armed_replies} vs "
+                 f"{plain_replies}")
+        if armed_disp != plain_disp:
+            fail("armed vs unarmed dispatch counters diverged — the "
+                 f"auditor perturbed the engine: {armed_disp} vs "
+                 f"{plain_disp}")
+        print(f"audit smoke OK: {len(bursts)}/{len(bursts)} audits "
+              "pass, ring==counters, wide events schema-valid and "
+              "joined, armed==unarmed byte parity and dispatch "
+              f"schedule ({armed_disp})")
+    finally:
+        _shutdown_replica(armed)
+        _shutdown_replica(plain)
+
+
 def run_router_smoke() -> None:
     """Two tiny replicas + a router: the full gate against the ROUTER,
     then the affinity assertion — the shared-prefix burst must
@@ -666,11 +877,24 @@ def main() -> None:
         help="boot 2 tiny replicas + a router, run the gate against "
         "the router, and assert shared-prefix affinity dominance",
     )
+    ap.add_argument(
+        "--audit-smoke", action="store_true",
+        help="boot an --audit-sample-every 1 replica and an unarmed "
+        "twin, run the same sequential burst against both, and gate "
+        "all-pass verdicts, ring<->counter reconciliation, audit "
+        "wide-event schema, and armed==unarmed byte parity + "
+        "dispatch schedule (the auditor observes, never perturbs)",
+    )
     args = ap.parse_args()
     if args.router_smoke:
         if args.base_url:
             ap.error("--router-smoke self-boots; drop --base-url")
         run_router_smoke()
+        return
+    if args.audit_smoke:
+        if args.base_url:
+            ap.error("--audit-smoke self-boots; drop --base-url")
+        run_audit_check()
         return
 
     srv = None

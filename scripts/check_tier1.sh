@@ -10,17 +10,19 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 620 = the 600 recorded at PR 12 plus the memory/device-time
-# observatory suites added in PR 13 (page-ownership map + oryx_pool_*
-# gauges + peak_pages ledger in tests/test_pagemap.py, OOM forensic
-# ring + oom_pressure wide events in tests/test_forensics.py, the
-# device-time attributor — kind bucketing, sampling cadence,
-# capture-failure degradation, CPU capture smoke — in
-# tests/test_device_time.py, plus the HBM-scrape TTL and the
-# memory-class/pool-geometry sentinel rows; ~655 observed), with
-# headroom for load-dependent flakes (bench-supervisor probes on one
-# CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-620}
+# 650 = the 620 recorded at PR 13 plus the output-quality & numerics
+# observatory suites added in PR 14 (shadow-parity audits — pass on
+# the fp path and through eviction replay, fail/drift classification,
+# ring<->counter reconciliation, kind="audit" wide events — in
+# tests/test_audit.py; the in-dispatch logit probe's stat math,
+# bit-identical-tokens contract on the split AND ragged paths, and
+# the trainer-side grad/activation probes in tests/test_numerics.py;
+# the entropy_collapse/absmax_explosion/audit_drift/
+# spec_accept_collapse sentinels in tests/test_anomaly.py; the int8
+# round-trip error helpers in tests/test_quant.py; ~690 observed),
+# with headroom for load-dependent flakes (bench-supervisor probes on
+# one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-650}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -80,6 +82,7 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     tests/test_router.py tests/test_ragged_attention.py \
     tests/test_speculative.py tests/test_pagemap.py \
     tests/test_forensics.py tests/test_device_time.py \
+    tests/test_audit.py tests/test_numerics.py \
     -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "LOCK SANITIZER SUITE FAILED (a concurrency violation above)" >&2
@@ -95,6 +98,21 @@ echo "checking serving endpoints (/healthz, /readyz, /metrics, /debug/*)"
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/check_serving_endpoints.py; then
     echo "SERVING ENDPOINT CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- output-quality observatory gate ----------------------------------------
+# The ISSUE-14 acceptance bar: an --audit-sample-every 1 replica under a
+# sequential greedy burst — every sampled request audits verdict=pass on
+# the fp path, the /debug/audit ring reconciles exactly with
+# oryx_audit_total{verdict=}, kind="audit" wide events validate against
+# the schema registry, and live-traffic reply bytes + dispatch counters
+# are identical to an unarmed twin (the auditor observes, never
+# perturbs).
+echo "checking output-quality observatory (--audit-smoke)"
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_serving_endpoints.py --audit-smoke; then
+    echo "AUDIT OBSERVATORY CHECK FAILED" >&2
     exit 1
 fi
 

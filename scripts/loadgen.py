@@ -62,7 +62,13 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-ANOMALY_KINDS = ("ttft_slo", "queue_depth_slo")
+ANOMALY_KINDS = (
+    "ttft_slo", "queue_depth_slo",
+    # Output-quality sentinels (ISSUE 14): zero firings at/below the
+    # knee is part of the gate — a drifting audit or collapsing accept
+    # rate under healthy load is a correctness regression, not noise.
+    "audit_drift", "spec_accept_collapse",
+)
 
 WORDS = (
     "capacity goodput latency saturation paged prefill decode cache "
@@ -426,6 +432,34 @@ def speculation_block(scrape_pairs: list[tuple[str, str]]) -> dict:
     }
 
 
+def audit_block(scrape_pairs: list[tuple[str, str]]) -> dict:
+    """Per-stage output-audit report from server scrape deltas (one
+    (before, after) pair per backend; a fleet sums across replicas):
+    sampled/pass/drift/fail counts from oryx_audit_total{verdict=} and
+    the derived pass_rate — bench_compare treats it as an EXACT-class
+    metric (any non-pass on the fp path is a regression, not noise).
+    Schema-stable with auditing off: zero counts, pass_rate None."""
+
+    def verdict_value(text: str, verdict: str) -> float:
+        m = re.search(
+            rf'^oryx_audit_total\{{verdict="{verdict}"\}} '
+            rf"([0-9.eE+-]+)$", text, re.M,
+        )
+        return float(m.group(1)) if m else 0.0
+
+    out = {"sampled": 0.0, "pass": 0.0, "drift": 0.0, "fail": 0.0}
+    for m0, m1 in scrape_pairs:
+        out["sampled"] += (
+            _counter_value(m1, "oryx_audit_sampled_total")
+            - _counter_value(m0, "oryx_audit_sampled_total")
+        )
+        for v in ("pass", "drift", "fail"):
+            out[v] += verdict_value(m1, v) - verdict_value(m0, v)
+    done = out["pass"] + out["drift"] + out["fail"]
+    out["pass_rate"] = round(out["pass"] / done, 4) if done else None
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Stage runner + aggregation
 # ---------------------------------------------------------------------------
@@ -613,6 +647,11 @@ def aggregate_stage(rate: float, duration: float, results: list[dict],
              for rid in replica_scrapes[1]]
             if replica_scrapes is not None else [(m0, m1)]
         ),
+        "audit": audit_block(
+            [(replica_scrapes[0].get(rid, ""), replica_scrapes[1][rid])
+             for rid in replica_scrapes[1]]
+            if replica_scrapes is not None else [(m0, m1)]
+        ),
         "cost": {
             "requests_with_cost": len(costs),
             "prefill_tokens": prefill,
@@ -763,7 +802,7 @@ def find_knee(stages: list[dict], good_frac: float = 0.9) -> dict | None:
 _STAGE_KEYS = (
     "offered_rps", "sent", "ok", "good", "slo_good_frac", "goodput_tps",
     "completed_tps", "ttft_s", "per_token_s", "server_ttft_s", "errors",
-    "anomalies", "speculation", "cost", "timeline", "memory",
+    "anomalies", "speculation", "audit", "cost", "timeline", "memory",
 )
 
 

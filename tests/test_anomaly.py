@@ -277,3 +277,117 @@ def test_events_jsonl_rotation_disabled_with_zero_cap(tmp_path):
     mon.close()
     assert not (tmp_path / "events.jsonl.1").exists()
     assert len(_events(path)) == 10
+
+
+# ---------------------------------------------------------------------------
+# Numerics & output-quality sentinels (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_entropy_collapse_one_shot_no_rebaseline():
+    """A collapsing logits entropy fires once per episode, collapsed
+    values never enter the rolling window (no silent re-baselining),
+    and a recovery re-arms."""
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(min_window=4, entropy_floor_frac=0.5),
+    )
+    for _ in range(6):
+        assert mon.observe_numerics(entropy=4.0) == []
+    fired = mon.observe_numerics(entropy=0.5)
+    assert [e.kind for e in fired] == ["entropy_collapse"]
+    # Still collapsed: silent (episode), and the window median is
+    # untouched by the collapsed samples.
+    for _ in range(10):
+        assert mon.observe_numerics(entropy=0.4) == []
+    assert mon.counts["entropy_collapse"] == 1
+    # Recovery re-arms; a second collapse is a second episode.
+    for _ in range(3):
+        assert mon.observe_numerics(entropy=4.0) == []
+    assert [e.kind for e in mon.observe_numerics(entropy=0.3)] == [
+        "entropy_collapse"
+    ]
+    assert mon.counts["entropy_collapse"] == 2
+    mon.close()
+
+
+def test_absmax_explosion_spikes_enter_window():
+    """absmax mirrors grad_norm_explosion: one event per episode, and
+    spikes DO enter the window (a genuinely higher plateau becomes the
+    baseline instead of firing forever)."""
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(min_window=4, absmax_factor=4.0),
+    )
+    for _ in range(6):
+        assert mon.observe_numerics(absmax=10.0) == []
+    fired = mon.observe_numerics(absmax=100.0)
+    assert [e.kind for e in fired] == ["absmax_explosion"]
+    assert mon.observe_numerics(absmax=100.0) == []  # same episode
+    # Keep feeding the new plateau: it enters the window, the median
+    # climbs, and the detector stops considering it anomalous.
+    for _ in range(12):
+        mon.observe_numerics(absmax=100.0)
+    assert mon.observe_numerics(absmax=100.0) == []
+    assert mon.counts["absmax_explosion"] == 1
+    mon.close()
+
+
+def test_audit_drift_episode_semantics():
+    mon = AnomalyMonitor(source="serve")
+    assert [e.kind for e in mon.observe_audit("drift")] == ["audit_drift"]
+    assert mon.observe_audit("fail") == []  # same episode
+    assert mon.observe_audit("pass") == []  # re-arms
+    assert [e.kind for e in mon.observe_audit("fail")] == ["audit_drift"]
+    assert mon.counts["audit_drift"] == 2
+    ev = mon.recent[-1]
+    assert ev.context["verdict"] == "fail"
+    mon.close()
+
+
+def test_spec_accept_collapse_rolling_baseline():
+    """Accept-rate off its own rolling baseline: one event per
+    collapse episode, collapsed rates stay out of the window, recovery
+    re-arms — and a drafter that was never good (baseline ~1.0) can
+    never fire (1.0 is the floor of the signal)."""
+    mon = AnomalyMonitor(
+        source="serve",
+        thresholds=AnomalyThresholds(
+            min_window=4, spec_accept_floor_frac=0.5,
+        ),
+    )
+    for _ in range(8):
+        assert mon.observe_spec_accept(4.0) == []
+    fired = mon.observe_spec_accept(1.0)
+    assert [e.kind for e in fired] == ["spec_accept_collapse"]
+    for _ in range(5):
+        assert mon.observe_spec_accept(1.0) == []
+    assert mon.counts["spec_accept_collapse"] == 1
+    for _ in range(3):
+        assert mon.observe_spec_accept(4.0) == []
+    assert [e.kind for e in mon.observe_spec_accept(1.5)] == [
+        "spec_accept_collapse"
+    ]
+    mon.close()
+    # Never-good drafter: baseline 1.0, rate can't go below 0.5x it.
+    mon2 = AnomalyMonitor(source="serve")
+    for _ in range(40):
+        assert mon2.observe_spec_accept(1.0) == []
+    assert mon2.counts.get("spec_accept_collapse", 0) == 0
+    mon2.close()
+
+
+def test_window_engine_rejects_audit_and_numerics_flags():
+    """--audit-sample-every/--numerics-every on the window batcher must
+    fail fast (no paged replay path / engine step loop), same contract
+    as the SLO flags."""
+    from oryx_tpu.serve import api_server
+
+    with pytest.raises(ValueError, match="audit-sample-every"):
+        api_server.build_server(
+            object(), engine="window", audit_sample_every=1, port=0,
+        )
+    with pytest.raises(ValueError, match="numerics-every"):
+        api_server.build_server(
+            object(), engine="window", numerics_every=4, port=0,
+        )

@@ -881,11 +881,13 @@ def test_metrics_content_type_and_build_info(continuous_server):
     with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
         assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
         text = r.read().decode()
-    # oryx_pool_/oryx_page_ (page-pool observatory) and
-    # oryx_device_time_/oryx_profile_ (device-time attributor) are
+    # oryx_pool_/oryx_page_ (page-pool observatory),
+    # oryx_device_time_/oryx_profile_ (device-time attributor) and
+    # oryx_audit_/oryx_numerics_ (output-quality observatory) are
     # raw-named like oryx_anomaly_: engine-independent semantics.
     allowed = ("oryx_serving_", "oryx_anomaly_", "oryx_pool_",
-               "oryx_page_", "oryx_device_time_", "oryx_profile_")
+               "oryx_page_", "oryx_device_time_", "oryx_profile_",
+               "oryx_audit_", "oryx_numerics_")
     for line in text.splitlines():
         if line and not line.startswith("#"):
             assert line.startswith(allowed), line

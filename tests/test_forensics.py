@@ -58,7 +58,10 @@ def test_oom_event_schema_enforced():
     assert ev["kind"] == "oom_pressure" and ev["schema"] == 1
     assert set(ev) <= set(OOM_EVENT_KEYS)
     with pytest.raises(ValueError, match="OOM_EVENT_KEYS"):
-        build_oom_event(trigger="oom", bogus_field=1)
+        # Splat-spelled so oryxlint's static schema check (which now
+        # covers build_oom_event call sites too) defers to exactly the
+        # runtime validation this line exists to prove.
+        build_oom_event(**{"trigger": "oom", "bogus_field": 1})
     log = RequestLog()
     log.append(ev)  # kind dispatches to the OOM schema
     with pytest.raises(ValueError):

@@ -81,11 +81,14 @@ def _stage(rate, good_frac, anomalies=0.0, hung=0, transport=0,
         "errors": {"429": 0, "503": 0, "504": 0, "other_http": 0,
                    "transport": transport, "stream_error": 0,
                    "harness_inflight_cap": capped},
-        "anomalies": {"ttft_slo": anomalies, "queue_depth_slo": 0.0},
+        "anomalies": {"ttft_slo": anomalies, "queue_depth_slo": 0.0,
+                      "audit_drift": 0.0, "spec_accept_collapse": 0.0},
         "speculation": {"active": False,
                         "accepted_tokens_per_step": None,
                         "draft_proposed": 0.0, "draft_accepted": 0.0,
                         "draft_accept_rate": None},
+        "audit": {"sampled": 0.0, "pass": 0.0, "drift": 0.0,
+                  "fail": 0.0, "pass_rate": None},
         "cost": {"requests_with_cost": 20, "prefill_tokens": 100,
                  "cached_tokens": 50, "cache_hit_frac": 0.33,
                  "decode_steps": 80, "decode_tokens": 75,
@@ -276,7 +279,10 @@ def test_single_stage_against_live_server(live_server):
     assert st["ttft_s"]["p50"] > 0
     assert st["cost"]["requests_with_cost"] == st["ok"]
     assert st["cost"]["page_seconds"] > 0
-    assert st["anomalies"] == {"ttft_slo": 0.0, "queue_depth_slo": 0.0}
+    assert st["anomalies"] == {
+        "ttft_slo": 0.0, "queue_depth_slo": 0.0,
+        "audit_drift": 0.0, "spec_accept_collapse": 0.0,
+    }
     # Stage record is schema-complete (the report validator's unit).
     for k in loadgen._STAGE_KEYS:
         assert k in st, k

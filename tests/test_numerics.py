@@ -1,0 +1,260 @@
+"""Numerics sentinels (utils/numerics.py): the in-dispatch logit probe
+(stat math, bit-identical tokens with the probe armed, scheduler
+cadence and gauges) and the trainer-side grad/activation probes."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils import numerics as numerics_lib
+from oryx_tpu.utils.metrics import ServingMetrics
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Stat math
+# ---------------------------------------------------------------------------
+
+
+def _stats_of(logits, live):
+    acc = numerics_lib.accumulate_logit_stats(
+        numerics_lib.init_logit_stats(),
+        jnp.asarray(logits, jnp.float32),
+        jnp.asarray(live),
+    )
+    return numerics_lib.finalize_logit_stats(acc)
+
+
+def test_uniform_logits_entropy_is_log_v():
+    V = 64
+    s = _stats_of(np.zeros((2, V)), [True, True])
+    assert s["rows"] == 2
+    assert s["entropy"] == pytest.approx(math.log(V), rel=1e-5)
+    assert s["top1_margin"] == pytest.approx(0.0, abs=1e-6)
+    assert s["finite_frac"] == 1.0
+    assert s["absmax"] == 0.0 and s["rms"] == 0.0
+
+
+def test_peaked_logits_low_entropy_high_margin():
+    row = np.zeros((1, 16), np.float32)
+    row[0, 3] = 30.0
+    s = _stats_of(row, [True])
+    assert s["entropy"] < 1e-3
+    assert s["top1_margin"] == pytest.approx(30.0)
+    assert s["absmax"] == pytest.approx(30.0)
+
+
+def test_nan_rows_report_finite_frac_without_poisoning():
+    rows = np.zeros((2, 8), np.float32)
+    rows[1, :4] = np.nan
+    s = _stats_of(rows, [True, True])
+    assert s["finite_frac"] == pytest.approx(1.0 - 4 / 16)
+    # Every reported stat stays finite — the probe survives the
+    # corruption it exists to detect.
+    assert all(math.isfinite(v) for v in s.values())
+
+
+def test_dead_rows_excluded_and_empty_is_none():
+    rows = np.stack([np.zeros(8, np.float32),
+                     np.full(8, 100.0, np.float32)])
+    s = _stats_of(rows, [True, False])
+    assert s["rows"] == 1
+    assert s["absmax"] == 0.0  # the dead row's 100s never counted
+    assert _stats_of(rows, [False, False]) is None
+
+
+def test_accumulates_across_steps_with_running_max():
+    acc = numerics_lib.init_logit_stats()
+    a = np.zeros((1, 8), np.float32)
+    b = np.full((1, 8), 2.0, np.float32)
+    acc = numerics_lib.accumulate_logit_stats(
+        acc, jnp.asarray(b), jnp.asarray([True])
+    )
+    acc = numerics_lib.accumulate_logit_stats(
+        acc, jnp.asarray(a), jnp.asarray([True])
+    )
+    s = numerics_lib.finalize_logit_stats(acc)
+    assert s["rows"] == 2
+    assert s["absmax"] == pytest.approx(2.0)  # max, not mean
+    assert s["rms"] == pytest.approx(1.0)  # (2 + 0) / 2
+
+
+def test_tree_and_stacked_layer_absmax():
+    tree = {
+        "a": jnp.asarray([[1.0, -3.0]]),
+        "b": {"c": jnp.asarray([0.5]), "ints": jnp.asarray([7])},
+    }
+    assert float(numerics_lib.tree_absmax(tree)) == 3.0
+    layers = {
+        "w": jnp.asarray(
+            np.stack([np.full((2, 2), 1.0), np.full((2, 2), 4.0)])
+        ),
+        "v": jnp.asarray(np.stack([np.full((3,), 9.0),
+                                   np.full((3,), 0.1)])[:, None]),
+    }
+    per_layer = np.asarray(numerics_lib.stacked_layer_absmax(layers))
+    np.testing.assert_allclose(per_layer, [9.0, 4.0])
+    assert numerics_lib.stacked_layer_absmax({}) is None
+
+
+# ---------------------------------------------------------------------------
+# Serving wiring
+# ---------------------------------------------------------------------------
+
+
+def _run(pipe, reqs, **kw):
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        metrics=ServingMetrics(), autostart=False, **kw,
+    )
+    handles = [sched.submit({"question": q}, cap) for q, cap in reqs]
+    sched.start()
+    results = [h.result(timeout=600)[0] for h in handles]
+    sched.close()
+    return sched, results
+
+
+@pytest.mark.parametrize("engine_kw", [
+    {},
+    {"ragged": True, "prefill_chunk": 8},
+])
+def test_probe_armed_tokens_bit_identical(pipe, engine_kw):
+    """The core numerics contract: numerics_every on/off produce the
+    SAME replies (the probe reads logits the sampler already computed;
+    it must never touch the stream) — on the split AND ragged paths."""
+    reqs = [("hello there", 6), ("tell me more", 5)]
+    _, base = _run(pipe, reqs, **engine_kw)
+    sched, probed = _run(pipe, reqs, numerics_every=1, **engine_kw)
+    assert probed == base
+    reg = sched.metrics.registry
+    assert reg.get("oryx_numerics_samples_total", raw_name=True) >= 1
+    text = sched.metrics.render()
+    for fam in numerics_lib.NUMERICS_GAUGES:
+        assert any(
+            line.startswith(f"{fam} ") for line in text.splitlines()
+        ), f"{fam} missing from the exposition"
+    # The probe saw real logits: entropy positive and finite.
+    ent = reg.get("oryx_numerics_logits_entropy", raw_name=True)
+    assert ent > 0 and math.isfinite(ent)
+    assert reg.get(
+        "oryx_numerics_logits_finite_frac", raw_name=True
+    ) == 1.0
+
+
+def test_numerics_gauges_table_matches_declarations(pipe):
+    """NUMERICS_GAUGES (the docs/CI source of truth) and the
+    scheduler's literal declarations must agree."""
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        autostart=False,
+    )
+    text = sched.metrics.render()
+    sched.close()
+    for fam in numerics_lib.NUMERICS_GAUGES:
+        assert f"{fam} 0" in text, (
+            f"{fam} not pre-registered at zero on an unarmed boot"
+        )
+
+
+def test_numerics_cadence(pipe):
+    """numerics_every=N samples every Nth dispatch, not every one."""
+    sched, _ = _run(pipe, [("hello there", 12)], numerics_every=3)
+    reg = sched.metrics.registry
+    samples = reg.get("oryx_numerics_samples_total", raw_name=True)
+    dispatches = sched.metrics.get("chunks")
+    assert 0 < samples <= dispatches / 3 + 1
+
+
+def test_invalid_numerics_every_rejected(pipe):
+    with pytest.raises(ValueError, match="numerics_every"):
+        ContinuousScheduler(
+            pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+            autostart=False, numerics_every=-1,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Trainer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_train_step_numerics_probes_and_bit_identity():
+    from oryx_tpu.train import step as step_lib
+    from oryx_tpu.train.optimizer import make_optimizer
+    from tests.test_trainer_modes import _batch
+
+    cfg = cfg_lib.oryx_tiny()
+    host = _batch(cfg)
+    batch = {k: jnp.asarray(v)[None] for k, v in host.items()}
+
+    def one_step(numerics):
+        params = oryx.init_params(cfg, jax.random.key(0))
+        tx = make_optimizer(cfg.train, params)
+        state = step_lib.TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=tx.init(params),
+        )
+        state, metrics = step_lib.train_step(
+            state, batch, cfg, tx, numerics=numerics
+        )
+        return state, jax.device_get(metrics)
+
+    s0, m0 = one_step(False)
+    s1, m1 = one_step(True)
+    for k in ("act_absmax", "grad_absmax", "param_absmax"):
+        assert k in m1 and np.isfinite(m1[k]) and m1[k] > 0
+    assert "grad_layer_absmax" in m1
+    assert m1["grad_layer_absmax"].shape == (cfg.llm.num_layers,)
+    assert "act_absmax" not in m0
+    # Probe-armed updates are bit-identical: same loss, same params.
+    assert float(m0["loss"]) == float(m1["loss"])
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_telemetry_record_numerics_gauges_and_halt():
+    from oryx_tpu.train.telemetry import TrainTelemetry
+    from oryx_tpu.utils.anomaly import AnomalyHalt, AnomalyThresholds
+
+    tel = TrainTelemetry(
+        port=None, on_anomaly="halt",
+        thresholds=AnomalyThresholds(min_window=4, absmax_factor=5.0),
+    )
+    try:
+        for step in range(6):
+            tel.record_numerics(
+                step, {"grad_absmax": 1.0, "act_absmax": 2.0,
+                       "param_absmax": 3.0},
+                layer_absmax=np.asarray([0.5, 1.0]),
+            )
+        text = tel.registry.render()
+        assert "oryx_numerics_grad_absmax 1" in text
+        assert "oryx_numerics_act_absmax 2" in text
+        assert 'oryx_numerics_grad_layer_absmax{layer="1"} 1' in text
+        with pytest.raises(AnomalyHalt):
+            tel.record_numerics(99, {"grad_absmax": 100.0})
+        assert tel.anomaly.counts.get("absmax_explosion") == 1
+    finally:
+        tel.close()

@@ -215,3 +215,62 @@ def test_quantized_loglikelihood_scoring(tiny_quantized):
     # delta, and the pick itself must not flip on this case.
     assert np.abs(s_q8 - s_fp).max() < 0.5, (s_fp, s_q8)
     assert int(np.argmax(s_q8)) == int(np.argmax(s_fp)), (s_fp, s_q8)
+
+
+# ---------------------------------------------------------------------------
+# Round-trip error statistics (ISSUE 14 satellite: the helpers the
+# int8 paged-KV PR reuses for its quantized-vs-fp tolerance gate)
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_error_stats_bounds_and_exact_grid():
+    from oryx_tpu.utils.quant import roundtrip_error_stats
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    s = roundtrip_error_stats(w)
+    # Symmetric int8: the worst reconstruction error is half a
+    # quantization step, scale = amax/127 per output channel.
+    step = np.abs(w).max(axis=0) / 127.0
+    assert 0 < s["max_abs_err"] <= step.max() / 2 + 1e-6
+    assert 0 < s["rms_err"] <= s["max_abs_err"]
+    assert s["rel_max_abs_err"] <= 1.0 / 127.0 + 1e-6
+    assert s["rel_rms_err"] <= s["rel_max_abs_err"]
+    # An exactly representable grid round-trips with zero error.
+    grid = np.arange(-127, 128, dtype=np.float32)[:, None] * 0.5
+    z = roundtrip_error_stats(grid)
+    assert z["max_abs_err"] == 0.0 and z["rms_err"] == 0.0
+
+
+def test_page_roundtrip_error_per_page_independence():
+    from oryx_tpu.utils.quant import page_roundtrip_error
+
+    rng = np.random.default_rng(1)
+    pages = rng.standard_normal((4, 8, 2, 4)).astype(np.float32)
+    a = {k: np.asarray(v) for k, v in page_roundtrip_error(pages).items()}
+    assert a["max_abs_err"].shape == (4,)
+    assert (a["max_abs_err"] > 0).all()
+    assert (a["rms_err"] <= a["max_abs_err"]).all()
+    # Scales are per page: blowing up ONE page's values changes only
+    # that page's error stats.
+    pages2 = pages.copy()
+    pages2[2] *= 100.0
+    b = {k: np.asarray(v)
+         for k, v in page_roundtrip_error(pages2).items()}
+    np.testing.assert_allclose(
+        b["max_abs_err"][[0, 1, 3]], a["max_abs_err"][[0, 1, 3]],
+        rtol=1e-6,
+    )
+    assert b["max_abs_err"][2] > a["max_abs_err"][2]
+    assert b["scale"][2] == pytest.approx(a["scale"][2] * 100.0, rel=1e-5)
+
+
+def test_dequantize_inverts_quantize_array():
+    from oryx_tpu.utils.quant import dequantize, quantize_array
+
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((300, 40)).astype(np.float32)
+    qw = quantize_array(jnp.asarray(w))
+    back = np.asarray(dequantize(qw.q, qw.scale))
+    step = np.abs(w).max(axis=0, keepdims=True) / 127.0
+    assert np.abs(back - w).max() <= (step / 2).max() + 1e-6
