@@ -1351,11 +1351,20 @@ def generate_paged(
     prefill_chunk: int | None = None,
     mesh=None,
     ragged: bool = False,
+    kv_dtype: str | None = None,
 ):
     """`generate`, but over a paged KV cache in `chunk`-step compiled
     dispatches — the reference driver for the continuous-batching path
     (the scheduler runs the same `paged_prefill`/`paged_decode_chunk`
     programs with slots owned by different requests).
+
+    kv_dtype: None/"bf16" = dense pages in the compute dtype (today's
+    byte-exact path); "int8" = quantized pool with per-page scale
+    blocks (qwen2.init_paged_kv_cache kv_dtype=) — quantize on page
+    write, dequantize in the page walk; replies drift within the
+    utils/quant.roundtrip_error_stats envelope instead of matching the
+    dense path bit-for-bit. Ignored when a prior `state` is passed
+    (the pool already exists).
 
     ragged: route every decode chunk through `paged_ragged_step` — the
     PACKED one-dispatch program (all rows ride one [1, B] query buffer
@@ -1421,7 +1430,7 @@ def generate_paged(
             num_pages = sum(alloc_probe.pages_for(n) for n in row_tokens)
         allocator = paged_kv_lib.PageAllocator(num_pages, page_size)
         kv_pages = qwen2.init_paged_kv_cache(
-            cfg, num_pages, page_size, dtype=dtype
+            cfg, num_pages, page_size, dtype=dtype, kv_dtype=kv_dtype
         )
         if mesh is not None:
             kv_pages = shard_paged_kv(kv_pages, mesh)

@@ -96,15 +96,32 @@ def init_kv_cache(
 def init_paged_kv_cache(
     cfg: LLMConfig, num_pages: int, page_size: int,
     dtype: jnp.dtype = jnp.bfloat16,
+    kv_dtype: str | None = None,
 ) -> Params:
     """Page-pool KV cache (ops/paged_kv.py): one pool of fixed-size
     pages shared by every sequence; rows address it through per-row
     block tables passed to `forward`. HBM cost is the POOL size, not
-    batch × max_len."""
+    batch × max_len.
+
+    kv_dtype: None/"bf16" stores pages densely in `dtype` (the
+    compute dtype — today's path, byte-for-byte). "int8" (or
+    "fp8_e4m3") stores QUANTIZED pages — ops/paged_kv.QuantPages
+    planes: codes + per-page scale blocks, quantize-on-write /
+    dequantize-in-the-page-walk — roughly doubling resident KV tokens
+    per HBM byte; `dtype` then names the dequant target the kernels
+    multiply out into."""
     shape = (
         cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim
     )
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if kv_dtype in (None, "bf16", "fp"):
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    from oryx_tpu.ops import paged_kv
+
+    mk = lambda: paged_kv.init_quant_pages(  # noqa: E731
+        cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+        cfg.head_dim, fmt=kv_dtype, dequant_dtype=dtype,
+    )
+    return {"k": mk(), "v": mk()}
 
 
 def _cache_write(cache_layer: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray):

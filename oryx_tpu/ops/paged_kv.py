@@ -57,9 +57,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from oryx_tpu.ops.attention import attention
 from oryx_tpu.utils import faults
+from oryx_tpu.utils import quant as quant_lib
 
 
 class OutOfPagesError(RuntimeError):
@@ -318,6 +320,104 @@ class PageAllocator:
         }
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantPages:
+    """A quantized paged KV pool (one plane — K or V — of the pool
+    pytree): storage-dtype codes plus a PER-PAGE SCALE BLOCK.
+
+      q:     [..., P, page_size, Hk, D] int8 (or fp8-e4m3) codes
+      scale: [..., P, page_size] fp32 — one scale per token row,
+             stored page-major so every page carries its own scale
+             block: COW (`copy_pages`), host spill (`fetch_page`) and
+             reload (`upload_page`) move q-bytes and scales together,
+             verbatim, with zero special-casing.
+
+    Scale granularity (docs/DESIGN.md "KV quantization & cache
+    tiering"): the scale is per token ROW within the page block, not
+    one scalar per page. A single per-page scalar would have to grow
+    as later tokens land in the page (pages fill incrementally across
+    prefill chunks and decode steps), forcing an in-place requantize
+    of earlier rows — making the stored bytes depend on write
+    GROUPING, which would break the cold-vs-cached, eviction-replay
+    and spill/reload byte-parity contracts the serving engine leans
+    on. Per-row scales make the encoding a pure function of the
+    token's own value; the storage overhead is 4 bytes per Hk*D-byte
+    row (<1%), and the layout is what rides the block-table stream
+    into the Pallas kernel (scales are fetched per page tile alongside
+    the code tile, addressed by the same scalar-prefetched table).
+
+    Registered as a pytree node, so everything downstream — the layer
+    scan in qwen2.forward, jit donation, `copy_pages`' tree_map, host
+    fetch/upload — treats the pool transparently; `dequant_dtype` (the
+    logical dtype consumers see, static aux data) is what the ops
+    dequantize into."""
+
+    def __init__(self, q, scale, dequant_dtype=jnp.float32):
+        self.q = q
+        self.scale = scale
+        self.dequant_dtype = jnp.dtype(dequant_dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), str(self.dequant_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    # Shape/dtype impersonation: callers read pool geometry off the
+    # leaf (`kv_pages["k"].shape[2]` is the page size everywhere).
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):  # the LOGICAL dtype consumers see after dequant
+        return self.dequant_dtype
+
+    @property
+    def storage_dtype(self):
+        return self.q.dtype
+
+    def __repr__(self):
+        return (
+            f"QuantPages(q={self.q.shape}:{self.q.dtype}, "
+            f"scale={self.scale.shape}, dequant={self.dequant_dtype})"
+        )
+
+
+def init_quant_pages(
+    num_layers: int, num_pages: int, page_size: int, num_kv_heads: int,
+    head_dim: int, *, fmt: str = "int8", dequant_dtype=jnp.float32,
+) -> QuantPages:
+    """A zeroed quantized pool plane (the int8 counterpart of one
+    jnp.zeros leaf of qwen2.init_paged_kv_cache)."""
+    storage, _ = quant_lib.kv_storage_dtype(fmt)
+    return QuantPages(
+        jnp.zeros(
+            (num_layers, num_pages, page_size, num_kv_heads, head_dim),
+            storage,
+        ),
+        jnp.zeros((num_layers, num_pages, page_size), jnp.float32),
+        dequant_dtype=dequant_dtype,
+    )
+
+
+def kv_pool_dtype(kv_pages) -> str:
+    """The pool's wire format: "int8" / "fp8_e4m3" for a quantized
+    pool, else the dense leaf dtype's name (e.g. "float32")."""
+    leaf = kv_pages["k"] if isinstance(kv_pages, dict) else kv_pages
+    if isinstance(leaf, QuantPages):
+        try:
+            return _quant_fmt(leaf)
+        except ValueError:
+            return str(leaf.storage_dtype)
+    return str(leaf.dtype)
+
+
 @partial(jax.jit, donate_argnums=0)
 def copy_pages(kv_pages, src: jnp.ndarray, dst: jnp.ndarray):
     """Copy page `src` onto page `dst` across every layer of a paged KV
@@ -326,9 +426,44 @@ def copy_pages(kv_pages, src: jnp.ndarray, dst: jnp.ndarray):
     allocates a fresh page, copies the shared contents here, and swaps
     the fresh page into its block table before writing. Donates the
     pool, so the copy is in place; src/dst are traced scalars (one
-    compiled program per pool shape)."""
+    compiled program per pool shape). On a QUANTIZED pool the tree_map
+    descends into each plane's (codes, scales) children — both carry
+    the page axis at position 1 — so COW moves the raw quantized bytes
+    AND the page's scale block verbatim: share/splice/eviction-replay/
+    spec-rollback semantics are untouched by the storage format."""
     return jax.tree_util.tree_map(
         lambda a: a.at[:, dst].set(a[:, src]), kv_pages
+    )
+
+
+def fetch_page(kv_pages, page: int):
+    """Host-side byte-verbatim copy of ONE page across the whole pool
+    pytree (every layer, K and V — and, on a quantized pool, the
+    page's scale blocks): the spill half of the host-RAM prefix-cache
+    tier. Returns a pytree of numpy arrays shaped [L, page_size, ...];
+    `upload_page` is its exact inverse, so spill -> reload is lossless
+    by construction (same dtype, same bytes, no re-encode)."""
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a[:, page]), kv_pages
+    )
+
+
+def host_blob_bytes(blob) -> int:
+    """Total host bytes of a `fetch_page` blob (the --host-cache-bytes
+    accounting unit)."""
+    return int(sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(blob)
+    ))
+
+
+@partial(jax.jit, donate_argnums=0)
+def upload_page(kv_pages, dst: jnp.ndarray, blob):
+    """Write a `fetch_page` host blob back into page `dst` of the pool
+    (donated, in place; dst is a traced scalar — one compiled program
+    per pool shape). The astype is a no-op by contract (same dtype
+    both ways): the reload is byte-verbatim."""
+    return jax.tree_util.tree_map(
+        lambda a, b: a.at[:, dst].set(b.astype(a.dtype)), kv_pages, blob
     )
 
 
@@ -347,7 +482,18 @@ def write_pages(
     with write_mask False — and any slot routed through the sentinel —
     scatter out of bounds and are dropped (the masked-decode idiom:
     finished/empty slots cost no branch).
+
+    Quantized pool (`cache_layer` a QuantPages plane): the incoming fp
+    rows are quantized ON WRITE — per-token-row symmetric scales
+    (utils/quant.quantize_kv_rows) — and the codes + scales scatter
+    through the SAME flat slot indices, so masked/sentinel rows drop
+    both identically and the scale blocks always describe exactly the
+    codes that landed.
     """
+    if isinstance(cache_layer, QuantPages):
+        return _write_pages_quant(
+            cache_layer, new, block_tables, start, write_mask=write_mask
+        )
     P, ps, Hk, D = cache_layer.shape
     B, T, _, _ = new.shape
     slots = start[:, None].astype(jnp.int32) + jnp.arange(T, dtype=jnp.int32)
@@ -362,6 +508,58 @@ def write_pages(
     return pool.reshape(P, ps, Hk, D)
 
 
+def _quant_fmt(pages: QuantPages) -> str:
+    """The quant format name of a QuantPages plane (for the shared
+    quantize helpers)."""
+    for name, (dt, _) in quant_lib.KV_STORAGE_DTYPES.items():
+        if pages.storage_dtype == jnp.dtype(dt):
+            return name
+    raise ValueError(
+        f"QuantPages carries unknown storage dtype {pages.storage_dtype}"
+    )
+
+
+def _scatter_quant(
+    pages: QuantPages, flat: jnp.ndarray, rows: jnp.ndarray
+) -> QuantPages:
+    """Scatter packed fp rows [N, Hk, D] into a quantized pool plane at
+    flat slot indices [N] (one shared index stream for codes AND
+    scales; OOB -> dropped for both)."""
+    P, ps, Hk, D = pages.q.shape
+    codes, scale = quant_lib.quantize_kv_rows(rows, _quant_fmt(pages))
+    qpool = pages.q.reshape(P * ps, Hk, D)
+    qpool = qpool.at[flat].set(codes, mode="drop")
+    spool = pages.scale.reshape(P * ps)
+    spool = spool.at[flat].set(scale, mode="drop")
+    return QuantPages(
+        qpool.reshape(P, ps, Hk, D), spool.reshape(P, ps),
+        dequant_dtype=pages.dequant_dtype,
+    )
+
+
+def _write_pages_quant(
+    pages: QuantPages,
+    new: jnp.ndarray,  # [B, T, Hk, D]
+    block_tables: jnp.ndarray,
+    start: jnp.ndarray,
+    *,
+    write_mask: jnp.ndarray | None = None,
+) -> QuantPages:
+    """Quantize-on-write twin of the dense `write_pages` body: same
+    slot routing, same drop semantics, codes + per-row scales written
+    by one shared index stream."""
+    P, ps, Hk, D = pages.q.shape
+    B, T, _, _ = new.shape
+    slots = start[:, None].astype(jnp.int32) + jnp.arange(T, dtype=jnp.int32)
+    page = jnp.take_along_axis(block_tables, slots // ps, axis=1)  # [B, T]
+    flat = page * ps + slots % ps
+    if write_mask is not None:
+        flat = jnp.where(write_mask[:, None], flat, P * ps)
+    return _scatter_quant(
+        pages, flat.reshape(-1), new.reshape(B * T, Hk, D)
+    )
+
+
 def gather_pages(
     cache_layer: jnp.ndarray,  # [P, page_size, Hk, D]
     block_tables: jnp.ndarray,  # [B, max_pages]
@@ -372,8 +570,22 @@ def gather_pages(
     past every row's valid length and masked out of attention. This is
     the portable reference path — the Pallas kernel replaces it with
     in-place page reads on TPU.
+
+    Quantized pool: the gathered codes are DEQUANTIZED here — each
+    page's scale block rides the same block-table gather — so every
+    consumer downstream (the stock attention reference, the ragged
+    reference) sees a plain fp stream. The Pallas kernels instead
+    dequantize inside the page walk (the tile's scale block is fetched
+    alongside its code tile), multiplying out identically.
     """
     B, maxp = block_tables.shape
+    if isinstance(cache_layer, QuantPages):
+        P, ps, Hk, D = cache_layer.q.shape
+        dt = cache_layer.dequant_dtype
+        codes = cache_layer.q[block_tables]  # OOB gather clips
+        scale = cache_layer.scale[block_tables]  # [B, maxp, ps]
+        out = codes.astype(dt) * scale[..., None, None].astype(dt)
+        return out.reshape(B, maxp * ps, Hk, D)
     P, ps, Hk, D = cache_layer.shape
     out = cache_layer[block_tables]  # OOB gather clips
     return out.reshape(B, maxp * ps, Hk, D)
@@ -435,8 +647,11 @@ def write_pages_packed(
     decode token and a prefill-chunk token of two different sequences
     sit side by side in one buffer and one scatter places both. Rows
     with write_mask False — and any slot routed through the sentinel —
-    drop, exactly as in `write_pages`."""
-    P, ps, Hk, D = cache_layer.shape
+    drop, exactly as in `write_pages` (quantized pools quantize on
+    write with per-row scales, same routing — see `write_pages`)."""
+    P, ps, Hk, D = cache_layer.q.shape if isinstance(
+        cache_layer, QuantPages
+    ) else cache_layer.shape
     S, maxp = block_tables.shape
     seg = jnp.clip(q_segments.astype(jnp.int32), 0, S - 1)
     pos = q_positions.astype(jnp.int32)
@@ -447,6 +662,8 @@ def write_pages_packed(
     flat = page * ps + pos % ps
     if write_mask is not None:
         flat = jnp.where(write_mask, flat, P * ps)
+    if isinstance(cache_layer, QuantPages):
+        return _scatter_quant(cache_layer, flat, new)
     pool = cache_layer.reshape(P * ps, Hk, D)
     pool = pool.at[flat].set(new.astype(pool.dtype), mode="drop")
     return pool.reshape(P, ps, Hk, D)

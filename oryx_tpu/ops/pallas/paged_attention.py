@@ -67,16 +67,24 @@ NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 def _decode_kernel(
     bt_ref,  # [B, maxp] SMEM (scalar prefetch)
     len_ref,  # [B] SMEM (scalar prefetch)
-    q_ref,  # [1, 1, G, D]
-    k_ref,  # [1, ps, 1, D]
-    v_ref,
-    o_ref,  # [1, 1, G, D]
-    m_scr, l_scr, acc_scr,
-    *,
+    *refs,  # q, k, [k_scale], v, [v_scale], o, scratch x3
     scale: float,
     page_size: int,
     num_groups: int,
+    dequant_dtype: str | None = None,
 ):
+    # Quantized pool: each page tile arrives as storage-dtype codes
+    # plus its [1, ps] scale block (fetched through the SAME
+    # block-table-driven index map), and the dequant happens HERE, in
+    # the page walk — int8 is what crossed HBM. The multiply matches
+    # ops.paged_kv.gather_pages' dequant elementwise (same dtype, same
+    # broadcast), preserving the kernels' bit-parity contract.
+    if dequant_dtype is None:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
+    else:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
     b, ik = pl.program_id(0), pl.program_id(2)
     nk = pl.num_programs(2)
     G = num_groups
@@ -95,6 +103,10 @@ def _decode_kernel(
         q = q_ref[0, 0]  # [G, D]
         k = k_ref[0, :, 0, :]  # [ps, D]
         v = v_ref[0, :, 0, :]
+        if ks_ref is not None:
+            dq = jnp.dtype(dequant_dtype)
+            k = k.astype(dq) * ks_ref[0].astype(dq)[:, None]
+            v = v.astype(dq) * vs_ref[0].astype(dq)[:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -126,46 +138,67 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "page_size", "interpret")
+    jax.jit,
+    static_argnames=("scale", "page_size", "interpret", "dequant_dtype"),
 )
 def _paged_decode(
     q,  # [B, Hk, G, D]
-    k_pages,  # [P, ps, Hk, D]
+    k_pages,  # [P, ps, Hk, D] (codes when quantized)
     v_pages,
     block_tables,  # [B, maxp] int32
     kv_lengths,  # [B] int32
+    k_scale=None,  # [P, ps] fp32 per-page scale blocks (quantized pool)
+    v_scale=None,
     *,
     scale: float,
     page_size: int,
     interpret: bool,
+    dequant_dtype: str | None = None,
 ):
     B, Hk, G, D = q.shape
     P = k_pages.shape[0]
     maxp = block_tables.shape[1]
 
-    def kv_map(b, hk, ik, bt_ref, len_ref):
+    def _page(b, ik, bt_ref, len_ref):
         # Clamp dead tiles onto the last live page (DMA elision — see
         # module docstring) and sentinel entries into the pool.
         last = jnp.maximum(len_ref[b] - 1, 0) // page_size
         page = bt_ref[b, jnp.minimum(ik, last)]
-        return (jnp.minimum(page, P - 1), 0, hk, 0)
+        return jnp.minimum(page, P - 1)
+
+    def kv_map(b, hk, ik, bt_ref, len_ref):
+        return (_page(b, ik, bt_ref, len_ref), 0, hk, 0)
+
+    def sc_map(b, hk, ik, bt_ref, len_ref):
+        # The page's scale block rides the same block-table-driven
+        # stream as its code tile (one address computation, two DMAs).
+        return (_page(b, ik, bt_ref, len_ref), 0)
 
     grid = (B, Hk, maxp)
     Gp = max(G, 8)  # scratch sublane floor
+    quant = dequant_dtype is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), lambda b, hk, ik, *_: (b, hk, 0, 0)),
+        pl.BlockSpec((1, page_size, 1, D), kv_map),
+    ]
+    operands = [q, k_pages]
+    if quant:
+        in_specs.append(pl.BlockSpec((1, page_size), sc_map))
+        operands.append(k_scale)
+    in_specs.append(pl.BlockSpec((1, page_size, 1, D), kv_map))
+    operands.append(v_pages)
+    if quant:
+        in_specs.append(pl.BlockSpec((1, page_size), sc_map))
+        operands.append(v_scale)
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, scale=scale, page_size=page_size, num_groups=G
+            _decode_kernel, scale=scale, page_size=page_size,
+            num_groups=G, dequant_dtype=dequant_dtype,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, G, D), lambda b, hk, ik, *_: (b, hk, 0, 0)
-                ),
-                pl.BlockSpec((1, page_size, 1, D), kv_map),
-                pl.BlockSpec((1, page_size, 1, D), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, G, D), lambda b, hk, ik, *_: (b, hk, 0, 0)
             ),
@@ -178,7 +211,7 @@ def _paged_decode(
         out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), kv_lengths.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)
     return out
 
 
@@ -193,7 +226,9 @@ def ragged_decode_attention(
     interpret: bool | None = None,
 ):
     """Drop-in for ops.paged_kv.ragged_decode_attention (same contract);
-    pages are read in place through the block table."""
+    pages are read in place through the block table. A quantized pool
+    (ops.paged_kv.QuantPages planes) is read as codes + per-page scale
+    blocks and dequantized inside the page walk."""
     squeezed = q.ndim == 3
     if squeezed:
         q = q[:, None]
@@ -206,15 +241,45 @@ def ragged_decode_attention(
         scale = D**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    k_scale = v_scale = None
+    dequant = None
+    if _is_quant(k_pages):
+        k_pages, k_scale, v_pages, v_scale, dequant = _split_quant(
+            k_pages, v_pages
+        )
     # h = hk * G + g (the repo's GQA head order: h // G == hk).
     qg = q[:, 0].reshape(B, Hk, G, D)
     out = _paged_decode(
         qg, k_pages, v_pages, block_tables, kv_lengths,
+        k_scale, v_scale,
         scale=float(scale), page_size=int(k_pages.shape[1]),
-        interpret=bool(interpret),
+        interpret=bool(interpret), dequant_dtype=dequant,
     )
     out = out.reshape(B, Hq, D)
     return out if squeezed else out[:, None]
+
+
+def _is_quant(k_pages) -> bool:
+    from oryx_tpu.ops import paged_kv as _pk
+
+    return isinstance(k_pages, _pk.QuantPages)
+
+
+def _split_quant(k_pages, v_pages):
+    """(k_codes, k_scale, v_codes, v_scale, dequant_dtype_str) of a
+    quantized pool pair — both planes must be quantized together (a
+    mixed pool would silently misread one side's bytes)."""
+    from oryx_tpu.ops import paged_kv as _pk
+
+    if not isinstance(v_pages, _pk.QuantPages):
+        raise ValueError(
+            "quantized K pages with dense V pages: the pool must "
+            "quantize both planes (qwen2.init_paged_kv_cache kv_dtype=)"
+        )
+    return (
+        k_pages.q, k_pages.scale, v_pages.q, v_pages.scale,
+        str(k_pages.dequant_dtype),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -367,17 +432,23 @@ def _ragged_kernel(
     bt_ref,  # [S, maxp] SMEM (scalar prefetch)
     seg_ref,  # [R] SMEM
     pos_ref,  # [R] SMEM
-    q_ref,  # [1, HB, G, D]
-    k_ref,  # [1, ps, HB, D]
-    v_ref,
-    o_ref,  # [1, HB, G, D]
-    m_scr, l_scr, acc_scr,  # [HB*Gp, ...]
-    *,
+    *refs,  # q, k, [k_scale], v, [v_scale], o, scratch x3
     scale: float,
     page_size: int,
     num_groups: int,
     heads_per_block: int,
+    dequant_dtype: str | None = None,
 ):
+    # Quantized pool: code tiles + their [1, ps] per-page scale blocks
+    # arrive through the same scalar-prefetched block-table stream and
+    # dequantize HERE (see _decode_kernel) — the page walk reads int8
+    # off HBM and multiplies out to the logical dtype per tile.
+    if dequant_dtype is None:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
+    else:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
     r, ik = pl.program_id(0), pl.program_id(2)
     nk = pl.num_programs(2)
     G, HB = num_groups, heads_per_block
@@ -401,6 +472,10 @@ def _ragged_kernel(
             q = q_ref[0, h]  # [G, D]
             k = k_ref[0, :, h, :]  # [ps, D]
             v = v_ref[0, :, h, :]
+            if ks_ref is not None:
+                dq = jnp.dtype(dequant_dtype)
+                k = k.astype(dq) * ks_ref[0].astype(dq)[:, None]
+                v = v.astype(dq) * vs_ref[0].astype(dq)[:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -440,52 +515,75 @@ def _ragged_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "page_size", "heads_per_block", "interpret"),
+    static_argnames=(
+        "scale", "page_size", "heads_per_block", "interpret",
+        "dequant_dtype",
+    ),
 )
 def _ragged_paged(
     q,  # [R, Hk, G, D]
-    k_pages,  # [P, ps, Hk, D]
+    k_pages,  # [P, ps, Hk, D] (codes when quantized)
     v_pages,
     block_tables,  # [S, maxp] int32
     q_segments,  # [R] int32
     q_positions,  # [R] int32
+    k_scale=None,  # [P, ps] fp32 per-page scale blocks (quantized pool)
+    v_scale=None,
     *,
     scale: float,
     page_size: int,
     heads_per_block: int,
     interpret: bool,
+    dequant_dtype: str | None = None,
 ):
     R, Hk, G, D = q.shape
     P = k_pages.shape[0]
     S, maxp = block_tables.shape
     HB = heads_per_block
 
-    def kv_map(r, hb, ik, bt_ref, seg_ref, pos_ref):
+    def _page(r, ik, bt_ref, seg_ref, pos_ref):
         # Clamp dead tiles onto the row's last live page (DMA elision)
         # and sentinel entries into the pool; the segment picks WHICH
         # sequence's table this row walks.
         s = jnp.clip(seg_ref[r], 0, S - 1)
         last = jnp.maximum(pos_ref[r], 0) // page_size
         page = bt_ref[s, jnp.minimum(ik, last)]
-        return (jnp.minimum(page, P - 1), 0, hb, 0)
+        return jnp.minimum(page, P - 1)
+
+    def kv_map(r, hb, ik, bt_ref, seg_ref, pos_ref):
+        return (_page(r, ik, bt_ref, seg_ref, pos_ref), 0, hb, 0)
+
+    def sc_map(r, hb, ik, bt_ref, seg_ref, pos_ref):
+        # The scale block rides the same block-table stream as its
+        # code tile.
+        return (_page(r, ik, bt_ref, seg_ref, pos_ref), 0)
 
     grid = (R, Hk // HB, maxp)
     Gp = max(G, 8)  # scratch sublane floor
+    quant = dequant_dtype is not None
+    in_specs = [
+        pl.BlockSpec((1, HB, G, D), lambda r, hb, ik, *_: (r, hb, 0, 0)),
+        pl.BlockSpec((1, page_size, HB, D), kv_map),
+    ]
+    operands = [q, k_pages]
+    if quant:
+        in_specs.append(pl.BlockSpec((1, page_size), sc_map))
+        operands.append(k_scale)
+    in_specs.append(pl.BlockSpec((1, page_size, HB, D), kv_map))
+    operands.append(v_pages)
+    if quant:
+        in_specs.append(pl.BlockSpec((1, page_size), sc_map))
+        operands.append(v_scale)
     out = pl.pallas_call(
         functools.partial(
             _ragged_kernel, scale=scale, page_size=page_size,
             num_groups=G, heads_per_block=HB,
+            dequant_dtype=dequant_dtype,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, HB, G, D), lambda r, hb, ik, *_: (r, hb, 0, 0)
-                ),
-                pl.BlockSpec((1, page_size, HB, D), kv_map),
-                pl.BlockSpec((1, page_size, HB, D), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, HB, G, D), lambda r, hb, ik, *_: (r, hb, 0, 0)
             ),
@@ -498,7 +596,7 @@ def _ragged_paged(
         out_shape=jax.ShapeDtypeStruct((R, Hk, G, D), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), q_segments.astype(jnp.int32),
-      q_positions.astype(jnp.int32), q, k_pages, v_pages)
+      q_positions.astype(jnp.int32), *operands)
     return out
 
 
@@ -517,7 +615,9 @@ def ragged_paged_attention(
     """Drop-in for ops.paged_kv.ragged_paged_attention (same contract):
     R packed query rows with mixed query lengths, each reading its own
     sequence's pages in place through the block table. Tile parameters
-    come from the (head_dim, page_size) grid table unless pinned."""
+    come from the (head_dim, page_size) grid table unless pinned. A
+    quantized pool (ops.paged_kv.QuantPages planes) is read as codes +
+    per-page scale blocks and dequantized inside the page walk."""
     R, Hq, D = q.shape
     Hk = k_pages.shape[2]
     assert Hq % Hk == 0, f"GQA requires Hq % Hk == 0, got {Hq=} {Hk=}"
@@ -533,11 +633,19 @@ def ragged_paged_attention(
     import math
 
     heads_per_block = max(1, math.gcd(int(heads_per_block), Hk))
+    k_scale = v_scale = None
+    dequant = None
+    if _is_quant(k_pages):
+        k_pages, k_scale, v_pages, v_scale, dequant = _split_quant(
+            k_pages, v_pages
+        )
     # h = hk * G + g (the repo's GQA head order: h // G == hk).
     qg = q.reshape(R, Hk, G, D)
     out = _ragged_paged(
         qg, k_pages, v_pages, block_tables, q_segments, q_positions,
+        k_scale, v_scale,
         scale=float(scale), page_size=int(k_pages.shape[1]),
         heads_per_block=int(heads_per_block), interpret=bool(interpret),
+        dequant_dtype=dequant,
     )
     return out.reshape(R, Hq, D)

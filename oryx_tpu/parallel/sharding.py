@@ -240,12 +240,27 @@ def shard_paged_kv(kv_pages, mesh, *, num_kv_heads: int | None = None):
         return kv_pages
     heads = num_kv_heads
     if heads is None:
-        leaf = jax.tree_util.tree_leaves(kv_pages)[0]
-        heads = leaf.shape[3]
+        # A quantized pool carries 3-D per-page scale leaves next to
+        # the 5-D code leaves; the head count lives on the 5-D ones.
+        heads = next(
+            leaf.shape[3]
+            for leaf in jax.tree_util.tree_leaves(kv_pages)
+            if getattr(leaf, "ndim", 0) == 5
+        )
     if heads % mesh.shape["tp"]:
         return kv_pages
     sharding = NamedSharding(mesh, spec)
-    return jax.tree.map(lambda a: jax.device_put(a, sharding), kv_pages)
+    replicated = NamedSharding(mesh, P())
+
+    def place(a):
+        # Only the [L, P, ps, Hk, D] code/value leaves split by heads;
+        # per-page scale blocks ([L, P, ps]) have no head axis and
+        # replicate — they are <1% of the pool's bytes.
+        return jax.device_put(
+            a, sharding if getattr(a, "ndim", 0) == 5 else replicated
+        )
+
+    return jax.tree.map(place, kv_pages)
 
 
 def ambient_mesh():

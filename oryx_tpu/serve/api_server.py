@@ -599,6 +599,10 @@ def build_server(
     prefix_cache: bool = True,
     ragged: bool = False,
     speculate: int = 0,
+    kv_dtype: str = "bf16",
+    host_cache_bytes: int = 0,
+    audit_tol_maxdiff: float | None = None,
+    audit_tol_kl: float | None = None,
     profile_sample_every: int = 0,
     audit_sample_every: int = 0,
     numerics_every: int = 0,
@@ -700,6 +704,13 @@ def build_server(
             "engine (the window batcher has no paged replay path or "
             "engine step loop)"
         )
+    if engine == "window" and (kv_dtype != "bf16" or host_cache_bytes):
+        # Same fail-fast contract: only the scheduler family owns a
+        # paged pool to quantize or a prefix cache to tier.
+        raise ValueError(
+            "--kv-dtype/--host-cache-bytes require a scheduler engine "
+            "(the window batcher has no paged KV pool or prefix cache)"
+        )
     # $ORYX_LOCK_SANITIZER=1 arms the lock-order sanitizer + race
     # detector for this server (chaos/test runs). Armed BEFORE the
     # metrics registry and scheduler are built so every named lock
@@ -770,6 +781,9 @@ def build_server(
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
             ragged=ragged, speculate=speculate,
+            kv_dtype=kv_dtype, host_cache_bytes=host_cache_bytes,
+            audit_tol_maxdiff=audit_tol_maxdiff,
+            audit_tol_kl=audit_tol_kl,
             profile_sample_every=profile_sample_every,
             audit_sample_every=audit_sample_every,
             numerics_every=numerics_every,
@@ -973,6 +987,11 @@ def build_server(
                     "engine": engine,
                     "num_pages": snap["num_pages"],
                     "page_size": snap["page_size"],
+                    # Wire format + device byte cost of the pool: what
+                    # turns page counts into the HBM bytes the
+                    # --kv-dtype lever actually halves.
+                    "kv_dtype": snap.get("kv_dtype"),
+                    "kv_pool_bytes": snap.get("kv_pool_bytes"),
                     "summary": snap["summary"],
                 }
                 if fmt == "json":
@@ -1558,6 +1577,39 @@ def main(argv: list[str] | None = None) -> None:
         "rejection sampling (distribution-exact). Requires --ragged.",
     )
     ap.add_argument(
+        "--kv-dtype", choices=["bf16", "int8"], default="bf16",
+        help="continuous engine: paged KV pool storage format. bf16 = "
+        "dense pages in the compute dtype (byte-exact). int8 = "
+        "quantized pages with per-page scale blocks — quantize on "
+        "page write, dequantize in the kernel's page walk — roughly "
+        "doubling resident KV tokens per HBM byte; replies drift "
+        "within the audit plane's roundtrip-derived tolerances "
+        "(--audit-tol-maxdiff/--audit-tol-kl) instead of matching the "
+        "bf16 pool bit-for-bit",
+    )
+    ap.add_argument(
+        "--host-cache-bytes", type=int, default=0,
+        help="continuous engine: host-RAM prefix-cache spill tier "
+        "budget in bytes (0 = off). LRU-evicted cache pages spill to "
+        "host RAM instead of dying; a hit on a spilled prefix "
+        "re-uploads its pages ahead of the suffix prefill — cache "
+        "capacity becomes host-bounded, not HBM-bounded",
+    )
+    ap.add_argument(
+        "--audit-tol-maxdiff", type=float, default=None,
+        help="output auditor: logit max-abs-diff above which a "
+        "production-vs-reference drift is a FAIL verdict (default "
+        "derives from utils/quant.roundtrip_error_stats on "
+        "--kv-dtype; drift at or below it — but above the pass "
+        "tolerance — is the `drift` verdict)",
+    )
+    ap.add_argument(
+        "--audit-tol-kl", type=float, default=None,
+        help="output auditor: per-position KL above which drift is a "
+        "FAIL verdict (default derives from roundtrip_error_stats on "
+        "--kv-dtype)",
+    )
+    ap.add_argument(
         "--profile-sample-every", type=int, default=0, metavar="N",
         help="continuous engine: every N engine steps, bracket ONE "
         "dispatch in a jax.profiler capture and attribute its device "
@@ -1715,6 +1767,10 @@ def main(argv: list[str] | None = None) -> None:
         prefix_cache=not args.no_prefix_cache,
         ragged=args.ragged,
         speculate=args.speculate,
+        kv_dtype=args.kv_dtype,
+        host_cache_bytes=args.host_cache_bytes,
+        audit_tol_maxdiff=args.audit_tol_maxdiff,
+        audit_tol_kl=args.audit_tol_kl,
         profile_sample_every=args.profile_sample_every,
         audit_sample_every=args.audit_sample_every,
         numerics_every=args.numerics_every,

@@ -17,11 +17,16 @@ prefix-cache splice — then compares:
   * **logit drift**: at K sampled reply positions, the full logit row
     from the reference replay against the row from a second replay run
     under the PRODUCTION configuration (the engine's attn_impl — e.g.
-    the Pallas ragged kernel — and, once int8 paged KV lands, the
-    quantized pool): per-position max-abs-diff and KL. On today's fp
-    path the two programs are bit-identical and the diff is exactly 0;
-    the histograms are the standing tolerance surface ROADMAP item 3's
-    "quantized-vs-fp greedy tolerance spot-check" gates against.
+    the Pallas ragged kernel — and its pool format: with
+    ``--kv-dtype int8`` the twin replays through a private QUANTIZED
+    pool and the fp reference is teacher-forced on the live stream so
+    every compared row shares the twin's context): per-position
+    max-abs-diff and KL. On the fp path the two programs are
+    bit-identical and the diff is exactly 0; on the quantized path the
+    drift gates against the ``--audit-tol-maxdiff``/``--audit-tol-kl``
+    boundary (defaults derived from utils/quant.roundtrip_error_stats
+    — ``drift`` within it, ``fail`` above it), which is ROADMAP item
+    3's standing quantized-vs-fp tolerance gate.
 
 Verdicts land in ``oryx_audit_total{verdict=pass|drift|fail}`` plus the
 ``oryx_audit_logit_max_abs_diff`` / ``oryx_audit_kl`` histograms, a
@@ -159,6 +164,38 @@ def sample_positions(reply_tokens: int, k: int) -> list[int]:
     })
 
 
+def drift_fail_tolerances(kv_dtype: str) -> tuple[float, float]:
+    """Default (max_abs_diff, kl) boundary between the `drift` and
+    `fail` verdicts — the --audit-tol-maxdiff / --audit-tol-kl
+    defaults, derived from utils.quant.roundtrip_error_stats on the
+    pool's storage format so the gate's looseness is BACKED BY the
+    quantizer's measured error envelope, not a magic number.
+
+    fp pools reproduce the reference bit-for-bit, so any nonzero
+    drift is already suspicious: the boundary sits one decade above
+    the pass/drift tolerance class. Quantized pools legitimately
+    drift: a seeded unit-normal [N, Hk, D] probe pushed through the
+    pool's OWN quantizer (quantize_kv_rows — one scale per token row
+    over the joint head x dim axes, exactly the write path's
+    granularity) gives the format's relative rms error, and the
+    boundary is that error scaled into logit units with a safety
+    factor of 64 (logits accumulate many quantized inner products;
+    empirically the tiny-model drift sits 1-2 decades below this
+    line, and a kernel/layout bug sits well above it)."""
+    if kv_dtype in (None, "bf16", "fp"):
+        return 1e-2, 1e-3
+    from oryx_tpu.utils import quant as quant_lib
+
+    probe = jax.random.normal(jax.random.key(0), (256, 4, 32))
+    codes, scale = quant_lib.quantize_kv_rows(probe, kv_dtype)
+    err = quant_lib.dequantize_kv_rows(codes, scale) - probe
+    rel = float(
+        jnp.sqrt(jnp.mean(err * err)) / jnp.max(jnp.abs(probe))
+    )
+    rel = max(rel, 1e-6)
+    return 64.0 * rel, 8.0 * rel
+
+
 def logit_divergence(ref: np.ndarray, cmp: np.ndarray
                      ) -> tuple[float, float]:
     """(max_abs_diff, KL(ref || cmp)) of two logit rows, fp64 softmax
@@ -206,6 +243,9 @@ class OutputAuditor:
         anomaly=None,
         engine_label: str = "continuous",
         replica_id: str | None = None,
+        kv_dtype: str = "bf16",
+        fail_abs_tol: float | None = None,
+        fail_kl_tol: float | None = None,
     ):
         if not isinstance(sample_every, int) or sample_every < 0:
             raise ValueError(
@@ -222,18 +262,40 @@ class OutputAuditor:
         self.positions = max(1, int(positions))
         self.abs_tol = float(abs_tol)
         self.kl_tol = float(kl_tol)
+        # The drift-vs-fail boundary (--audit-tol-maxdiff /
+        # --audit-tol-kl): drift above THESE lines is a `fail`
+        # verdict, not just `drift`. Defaults derive from the pool
+        # format's measured round-trip error (drift_fail_tolerances).
+        d_abs, d_kl = drift_fail_tolerances(kv_dtype)
+        self.fail_abs_tol = (
+            float(fail_abs_tol) if fail_abs_tol is not None else d_abs
+        )
+        self.fail_kl_tol = (
+            float(fail_kl_tol) if fail_kl_tol is not None else d_kl
+        )
         self.metrics = metrics or ServingMetrics()
         self.request_log = request_log
         self.anomaly = anomaly
         self.engine_label = engine_label
         self.replica_id = replica_id
         # The production-config twin: a second replay under the
-        # engine's own attention impl when it differs from the split
-        # XLA reference (and, later, the quantized pool dtype). On the
-        # plain XLA path the reference IS the production program and
-        # the drift is exactly 0 without a second replay.
+        # engine's own configuration when it differs from the split
+        # fp XLA reference — its attention impl (e.g. the Pallas
+        # ragged kernel), its pool dtype (the int8 paged pool), or
+        # both. On the plain fp XLA path the reference IS the
+        # production program and the drift is exactly 0 without a
+        # second replay. With a QUANTIZED pool the twin's replay is
+        # what must reproduce the client's bytes (the engine served
+        # from the quantized pool); the fp reference's token stream
+        # may legitimately diverge, and the ref-vs-twin logit drift
+        # against the fail tolerances is the standing numerics gate
+        # (ROADMAP item 3).
+        self.kv_dtype = kv_dtype
+        self.compare_quant = kv_dtype == "int8"
         self.compare_impl = (
-            self.cfg.attn_impl if self.cfg.attn_impl != "xla" else None
+            self.cfg.attn_impl
+            if (self.cfg.attn_impl != "xla" or self.compare_quant)
+            else None
         )
         # Pre-registered raw-named families: the whole audit surface
         # renders (at zero) from the first scrape, armed or not.
@@ -258,6 +320,7 @@ class OutputAuditor:
         self._pending: deque[dict[str, Any]] = deque()  # thread-owned: engine
         self.max_pending = max(1, int(max_pending))
         self._kv = None  # thread-owned: engine (lazy private pool)
+        self._kv_prod = None  # thread-owned: engine (quantized twin pool)
         self._bt = None  # thread-owned: engine
         # Ring + monotone verdict counts, shared with debug threads.
         self._lock = named_lock("audit._lock")
@@ -340,16 +403,39 @@ class OutputAuditor:
             self._bt = jnp.asarray(
                 np.arange(self.max_pages, dtype=np.int32)[None]
             )
+        if self.compare_quant and self._kv_prod is None:
+            # The production twin's pool: same geometry, the engine's
+            # quantized wire format — what makes the twin's replay an
+            # honest reproduction of what the client was served from.
+            self._kv_prod = qwen2.init_paged_kv_cache(
+                self.cfg.llm, self.max_pages, self.page_size,
+                dtype=oryx.compute_dtype(self.cfg),
+                kv_dtype=self.kv_dtype,
+            )
 
     def _replay(self, job: dict[str, Any], attn_impl: str,
-                want_positions: list[int]):
+                want_positions: list[int], pool: str = "_kv",
+                force: list[int] | None = None):
         """One cold replay of `job` through the split path under
         `attn_impl`: paged_prefill seeded with the request's own key0,
         then one audit_decode_step per reply token, mirroring the
         host consume loop of `scheduler._advance` (EOS -> "stop",
-        max_new -> "length"). Returns (emitted tokens, finish reason
-        or None at the divergence-guard cap, {position: logits [V]},
-        replayed token count)."""
+        max_new -> "length"). `pool` names the private pool attr the
+        replay dispatches donate ("_kv" = the fp reference pool,
+        "_kv_prod" = the quantized production twin). Returns (emitted
+        tokens, finish reason or None at the divergence-guard cap,
+        {position: logits [V]}, replayed token count, first index
+        where the model's own greedy choice departed from `force`).
+
+        force: TEACHER-FORCED mode (the quantized-pool reference
+        replay): feed this token stream — the client's live reply —
+        instead of the replay's own samples, so every recorded logit
+        row is computed in the SAME context the production twin
+        decodes in. Without it, the fp reference's greedy stream can
+        legitimately depart from a drifting quantized stream, and
+        rows past the departure would compare logits of DIFFERENT
+        prefixes — an apples-to-oranges diff that explodes for a
+        structural reason, not a numeric one."""
         self._ensure_pool()
         gen = self.cfg.generation
         eos = gen.eos_token_id
@@ -367,12 +453,12 @@ class OutputAuditor:
         key0 = jax.random.key(job["seed"])
         B1 = np.newaxis
         with self.pipe._mesh_scope():
-            self._kv, tok0, key = generate_lib.paged_prefill(
+            kv, tok0, key = generate_lib.paged_prefill(
                 self.pipe.params["llm"], self.cfg.llm,
                 jnp.asarray(emb),
                 jnp.asarray([L], np.int32),
                 self._bt,
-                self._kv,
+                getattr(self, pool),
                 jnp.asarray([0], np.int32),
                 key0[B1],
                 jnp.zeros((1,), np.float32),  # greedy-only audits
@@ -381,12 +467,18 @@ class OutputAuditor:
                 attn_impl=attn_impl,
                 compute_dtype=dtype,
             )
+        setattr(self, pool, kv)
         want = set(want_positions)
         # Divergence guard: one token past the live reply is enough to
         # expose any mismatch; without the cap a diverged replay could
         # run to max_new.
         target = len(job["emitted"]) + 1
         t = int(np.asarray(tok0)[0])
+        choice_div = -1
+        if force is not None and force:
+            if t != force[0]:
+                choice_div = 0
+            t = force[0]
         cur_len = L
         emitted: list[int] = []
         reason: str | None = None
@@ -404,9 +496,9 @@ class OutputAuditor:
             if len(emitted) >= target:
                 break
             with self.pipe._mesh_scope():
-                self._kv, nxt, lg, key = audit_decode_step(
+                kv, nxt, lg, key = audit_decode_step(
                     self.pipe.params["llm"], self.cfg.llm,
-                    self._kv, self._bt,
+                    getattr(self, pool), self._bt,
                     jnp.asarray([t], np.int32),
                     jnp.asarray([cur_len], np.int32),
                     key,
@@ -416,13 +508,20 @@ class OutputAuditor:
                     attn_impl=attn_impl,
                     compute_dtype=dtype,
                 )
+            setattr(self, pool, kv)
             steps += 1
             cur_len += 1
             pos += 1
             if pos in want:
                 rows[pos] = np.asarray(lg[0])
             t = int(np.asarray(nxt)[0])
-        return emitted, reason, rows, steps
+            if force is not None:
+                idx = len(emitted)
+                if idx < len(force):
+                    if t != force[idx] and choice_div < 0:
+                        choice_div = idx
+                    t = force[idx]
+        return emitted, reason, rows, steps, choice_div
 
     def run_one(self) -> bool:
         """Run ONE queued audit to completion (engine thread, idle
@@ -439,11 +538,12 @@ class OutputAuditor:
         # fault-boundary: a failed replay is itself an audit FAILURE
         # verdict, never an engine-loop exception
         except Exception as e:
-            # The replay donates the private pool into its dispatches:
-            # a raise mid-dispatch may have invalidated it. Drop it so
-            # the NEXT audit rebuilds from fresh buffers instead of
+            # The replay donates the private pools into its dispatches:
+            # a raise mid-dispatch may have invalidated them. Drop both
+            # so the NEXT audit rebuilds from fresh buffers instead of
             # converting one transient into a permanent fail loop.
             self._kv = None
+            self._kv_prod = None
             self._bt = None
             record = {
                 "request_id": job["request_id"],
@@ -467,15 +567,26 @@ class OutputAuditor:
     def _audit_one(self, job: dict[str, Any]) -> dict[str, Any]:
         live = job["emitted"]
         want = sample_positions(len(live), self.positions)
-        ref_emitted, ref_reason, ref_rows, ref_steps = self._replay(
-            job, "xla", want
+        # Quantized pool: the fp reference replays TEACHER-FORCED on
+        # the live stream, so its logit rows share the twin's context
+        # at every compared position (see _replay's force doc); its
+        # own greedy choices vs the live stream land in choice_div as
+        # information, not a verdict.
+        ref_emitted, ref_reason, ref_rows, ref_steps, ref_choice_div = (
+            self._replay(
+                job, "xla", want,
+                force=live if self.compare_quant else None,
+            )
         )
         replayed = ref_steps + 1  # tok0 rides the prefill dispatch
         cmp_emitted, cmp_reason = ref_emitted, ref_reason
         cmp_rows = ref_rows
         if self.compare_impl is not None:
-            cmp_emitted, cmp_reason, cmp_rows, cmp_steps = self._replay(
-                job, self.compare_impl, want
+            cmp_emitted, cmp_reason, cmp_rows, cmp_steps, _ = (
+                self._replay(
+                    job, self.compare_impl, want,
+                    pool="_kv_prod" if self.compare_quant else "_kv",
+                )
             )
             replayed += cmp_steps + 1
         # Byte parity: the replayed stream must reproduce the client's
@@ -497,11 +608,30 @@ class OutputAuditor:
                 return len(live)
             return -1
 
-        first_div = diverges(ref_emitted, ref_reason)
-        if first_div < 0 and self.compare_impl is not None:
+        # Byte parity: on a QUANTIZED pool the client's bytes came off
+        # the quantized program, so the production TWIN is what must
+        # reproduce them exactly (a twin mismatch is nondeterminism —
+        # a hard fail); the fp reference's stream may legitimately
+        # pick a different argmax under drift, which is recorded
+        # informationally, not failed. On the fp path the reference
+        # and the twin are bit-identical programs and either mismatch
+        # fails, exactly as before.
+        if self.compare_quant:
+            # The forced reference's own stream is the live stream by
+            # construction; parity is judged against the production
+            # twin, and the fp argmax departures are informational.
+            ref_div = ref_choice_div
             first_div = diverges(cmp_emitted, cmp_reason)
+        else:
+            ref_div = diverges(ref_emitted, ref_reason)
+            first_div = ref_div
+            if first_div < 0 and self.compare_impl is not None:
+                first_div = diverges(cmp_emitted, cmp_reason)
         # Logit drift across the sampled positions (reference vs the
-        # production-config twin; identical programs -> exact zeros).
+        # production-config twin; identical programs -> exact zeros;
+        # a quantized twin drifts within the fail tolerances — the
+        # roundtrip_error_stats-derived boundary — or FAILS above
+        # them).
         max_abs = 0.0
         max_kl = 0.0
         worst = None
@@ -517,7 +647,10 @@ class OutputAuditor:
                 worst = p
             max_abs = max(max_abs, d_abs)
             max_kl = max(max_kl, d_kl)
-        if first_div >= 0 or not finite:
+        if (
+            first_div >= 0 or not finite
+            or max_abs > self.fail_abs_tol or max_kl > self.fail_kl_tol
+        ):
             verdict = "fail"
         elif max_abs > self.abs_tol or max_kl > self.kl_tol:
             verdict = "drift"
@@ -537,6 +670,11 @@ class OutputAuditor:
             "live_tail": live[-TAIL_TOKENS:],
             "replay_tail": ref_emitted[-TAIL_TOKENS:],
         }
+        if self.compare_quant and ref_div >= 0:
+            # Informational: where the fp reference's greedy stream
+            # departed from the quantized serving stream (expected
+            # under drift; the tolerance gate above is the judge).
+            record["ref_first_divergence"] = ref_div
         if worst is not None:
             record["top_logits"] = {
                 "position": worst,

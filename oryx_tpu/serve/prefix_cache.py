@@ -37,6 +37,8 @@ from typing import Any, Iterable
 
 import numpy as np
 
+from oryx_tpu.utils import faults
+
 
 class TrieNode:
     __slots__ = ("children", "payload", "stamp", "parent", "key")
@@ -147,6 +149,19 @@ class TokenTrie:
         return sum(1 for _ in self.nodes())
 
 
+class HostEntry:
+    """One spilled cache page living in host RAM: the byte-verbatim
+    device blob (every layer's K/V — and scale blocks on a quantized
+    pool — for one page, from ops/paged_kv.fetch_page) plus its byte
+    size for the --host-cache-bytes budget."""
+
+    __slots__ = ("blob", "nbytes")
+
+    def __init__(self, blob, nbytes: int):
+        self.blob = blob
+        self.nbytes = int(nbytes)
+
+
 class PagedPrefixCache:
     """The continuous scheduler's shared-prefix page cache.
 
@@ -158,11 +173,50 @@ class PagedPrefixCache:
     `evict` walks leaves least-recently-used first and frees pages only
     the cache still holds (refcount 1); entries shared with a live slot
     are pinned until that slot releases them.
+
+    Host-RAM spill tier (docs/DESIGN.md "KV quantization & cache
+    tiering"): with `host_cache_bytes > 0` and the two device-copy
+    callbacks wired, an LRU-evicted entry SPILLS to pinned host RAM —
+    a byte-verbatim copy of the page (and, on a quantized pool, its
+    scale block) — instead of dying. The device page still returns to
+    the free list (eviction's whole point), but the prefix survives in
+    a parallel host-side trie: a later lookup that walks past the
+    device-resident prefix into spilled blocks re-uploads those pages
+    ahead of the suffix prefill (`reload`), so cache capacity is
+    bounded by HOST RAM, not HBM. Spill/reload is lossless by
+    construction (same dtype both ways, no re-encode), so a reloaded
+    splice is byte-identical to never having evicted. A failed
+    re-upload (fault site `host_spill_upload`, or pool pressure at
+    reload time) just shortens the match — the suffix recomputes cold,
+    never crashes.
+
+      spill_fetch(page) -> (blob, nbytes): device -> host page copy.
+      spill_upload(blob, page) -> None: host -> device, into a page
+        the cache just allocated.
     """
 
-    def __init__(self, allocator, *, metrics=None):
+    def __init__(self, allocator, *, metrics=None,
+                 host_cache_bytes: int = 0,
+                 spill_fetch=None, spill_upload=None):
         self.allocator = allocator
         self.page_size = allocator.page_size
+        if host_cache_bytes < 0:
+            raise ValueError(
+                f"host_cache_bytes must be >= 0, got {host_cache_bytes}"
+            )
+        self.host_cache_bytes = int(host_cache_bytes)
+        self.spill_fetch = spill_fetch
+        self.spill_upload = spill_upload
+        self.spill_enabled = bool(
+            host_cache_bytes > 0
+            and spill_fetch is not None and spill_upload is not None
+        )
+        # The host tier's own trie (same block geometry; payloads are
+        # HostEntry blobs, no pool pages) + its byte ledger. Engine-
+        # thread-owned like the device trie.
+        self._host = TokenTrie(allocator.page_size)  # thread-owned: engine
+        self._host_bytes = 0  # thread-owned: engine
+        self._spilled = 0  # thread-owned: engine
         # No locks BY DESIGN: the cache (trie + page accounting) is
         # engine-thread-owned — admission splice, insert-at-donate,
         # LRU eviction and clear all run on the engine loop. That
@@ -209,19 +263,240 @@ class PagedPrefixCache:
             and self.allocator.refcount(n.payload) == 1
         )
 
+    @property
+    def spilled_pages(self) -> int:
+        """Host-tier entries (pages living in host RAM only)."""
+        return self._spilled
+
+    @property
+    def host_bytes(self) -> int:
+        """Host RAM the spill tier currently holds."""
+        return self._host_bytes
+
     def _gauges(self) -> None:
         if self.metrics is not None:
             self.metrics.set_gauge("prefix_cache_pages", self._pages)
             self.metrics.set_gauge("prefix_cache_entries", self.entries)
+            reg = self.metrics.registry
+            reg.gauge("oryx_cache_spilled_pages", raw_name=True).set(
+                self._spilled
+            )
+            reg.gauge("oryx_cache_host_bytes", raw_name=True).set(
+                self._host_bytes
+            )
 
     # ---- the cache surface -----------------------------------------------
 
     def lookup(self, tokens, root_key: tuple = ()) -> tuple[int, list[int]]:
         """Longest page-aligned cached prefix of `tokens` →
         (matched_tokens, pages). pages[i] holds tokens
-        [i*page_size, (i+1)*page_size). Takes no page references."""
-        path = self.trie.walk(tokens, root_key)
-        return len(path) * self.page_size, [n.payload for n in path]
+        [i*page_size, (i+1)*page_size). Takes no page references.
+        Device tier only — `lookup_tiered` also surfaces the host-side
+        continuation."""
+        pages = self._device_pages(self.trie.walk(tokens, root_key))
+        return len(pages) * self.page_size, pages
+
+    @staticmethod
+    def _device_pages(path: list[TrieNode]) -> list[int]:
+        """The walked path's page ids, truncated at the first node
+        without one. Payload-less device nodes cannot arise through
+        the public surface (insert/reload always set payloads along
+        the path), but a hole must shorten the match, never reach the
+        splice as int(None)."""
+        pages: list[int] = []
+        for n in path:
+            if n.payload is None:
+                break
+            pages.append(n.payload)
+        return pages
+
+    def lookup_tiered(
+        self, tokens, root_key: tuple = ()
+    ) -> tuple[int, list[int], list[TrieNode]]:
+        """`lookup` plus the spilled continuation: (device_matched
+    tokens, device_pages, host_nodes) where host_nodes are the
+    host-tier trie nodes for the blocks immediately FOLLOWING the
+    device-resident prefix, contiguous and each holding a HostEntry
+    (a hole — a hard-evicted block — ends the run: everything past
+    it must recompute anyway). Takes no references; pass the nodes
+    to `reload` to bring them back on device."""
+        pages = self._device_pages(self.trie.walk(tokens, root_key))
+        host_nodes: list[TrieNode] = []
+        if self.spill_enabled:
+            hpath = self._host.walk(tokens, root_key)
+            for node in hpath[len(pages):]:
+                if node.payload is None:
+                    break
+                host_nodes.append(node)
+        return len(pages) * self.page_size, pages, host_nodes
+
+    def reload(self, tokens, host_nodes: list[TrieNode],
+               root_key: tuple = ()) -> list[int]:
+        """Re-upload spilled blocks onto fresh device pages, ahead of
+        the caller's suffix prefill: for each host node in order,
+        allocate one page (cache-owned), upload the blob byte-verbatim
+        (fault site `host_spill_upload`), and re-index the block in the
+        DEVICE trie — the entry is device-resident again, exactly as if
+        it had never been evicted. Stops at the first failure
+        (allocation or upload) and returns the device pages of the
+        blocks actually reloaded: a partial reload is a shorter splice,
+        and the suffix recomputes cold — degradation, never a crash."""
+        depth0 = self._depth(host_nodes[0]) if host_nodes else 0
+        reloaded: list[int] = []
+        for node in host_nodes:
+            entry = node.payload
+            try:
+                page = self.allocator.alloc(1, owner="cache")[0]
+            except Exception:
+                break
+            try:
+                # Chaos site: host->device re-upload failure. The
+                # contract under it: free the page, shorten the match,
+                # let admission recompute the suffix cold.
+                faults.fault_point(
+                    "host_spill_upload",
+                    exc=lambda: RuntimeError(
+                        "injected host-tier re-upload failure"
+                    ),
+                )
+                self.spill_upload(entry.blob, page)
+            # fault-boundary: a failed re-upload degrades to a cold
+            # recompute of the suffix — the page returns, the spilled
+            # entry stays for the next attempt, nothing leaks
+            except Exception:
+                self.allocator.free([page], owner="cache")
+                break
+            reloaded.append(page)
+            self._host_forget_node(node)
+        if reloaded:
+            path = self.trie.extend(
+                np.asarray(tokens)[
+                    : (depth0 + len(reloaded)) * self.page_size
+                ],
+                root_key,
+            )
+            for i, page in enumerate(reloaded):
+                node = path[depth0 + i]
+                if node.payload is None:
+                    node.payload = int(page)
+                    self._pages += 1
+                else:  # unreachable by the engine-thread ownership
+                    self.allocator.free([page], owner="cache")
+            if self.metrics is not None:
+                reg = self.metrics.registry
+                reg.counter(
+                    "oryx_cache_reload_hit_total", raw_name=True
+                ).inc()
+                reg.counter(
+                    "oryx_cache_reload_upload_total", raw_name=True
+                ).inc(len(reloaded))
+        self._gauges()
+        return reloaded
+
+    # ---- host tier internals --------------------------------------------
+
+    @staticmethod
+    def _depth(node: TrieNode) -> int:
+        """Block index of a trie node (root children are index 0; the
+        structural root is not a block and does not count)."""
+        d = -1
+        while node is not None and node.parent is not None:
+            d += 1
+            node = node.parent
+        return d
+
+    def _node_tokens(self, node: TrieNode) -> np.ndarray:
+        """The full token stream a device-trie node indexes (its path's
+        concatenated block keys) — what keys the host twin on spill."""
+        keys: list[bytes] = []
+        while node is not None and node.parent is not None:
+            keys.append(node.key)
+            node = node.parent
+        return np.frombuffer(b"".join(reversed(keys)), np.int64)
+
+    def _node_root_key(self, node: TrieNode) -> tuple:
+        """The root partition a node lives under (media fingerprint)."""
+        while node.parent is not None:
+            node = node.parent
+        for rk, root in self.roots_of(self.trie):
+            if root is node:
+                return rk
+        return ()
+
+    @staticmethod
+    def roots_of(trie: TokenTrie):
+        return list(trie.roots.items())
+
+    def _spill(self, victim: TrieNode) -> bool:
+        """Move a device-trie victim's page contents to the host tier
+        (byte-verbatim). Returns False — caller falls back to a plain
+        eviction — when the tier is off, the budget cannot fit the
+        entry even after LRU drops, or the device copy fails."""
+        if not self.spill_enabled:
+            return False
+        try:
+            blob, nbytes = self.spill_fetch(victim.payload)
+        # fault-boundary: a failed device->host copy demotes the spill
+        # to a plain eviction; the entry dies, nothing leaks
+        except Exception:
+            return False
+        if nbytes > self.host_cache_bytes:
+            return False
+        if self._host_bytes + nbytes > self.host_cache_bytes:
+            # ONE LRU scan per spill, dropping oldest leaf entries
+            # until the new blob fits (a per-drop rescan would make a
+            # budget-pressure spill storm quadratic on the engine
+            # thread — same discipline as the device evict's
+            # one-gather-per-round loop).
+            victims = sorted(
+                (n for n in self._host.leaves()
+                 if n.payload is not None),
+                key=lambda n: n.stamp,
+            )
+            for v in victims:
+                if self._host_bytes + nbytes <= self.host_cache_bytes:
+                    break
+                self._host_bytes -= v.payload.nbytes
+                self._spilled -= 1
+                v.payload = None
+                self._host_prune_chain(v)
+            if self._host_bytes + nbytes > self.host_cache_bytes:
+                return False
+        tokens = self._node_tokens(victim)
+        root_key = self._node_root_key(victim)
+        hpath = self._host.extend(tokens, root_key)
+        node = hpath[-1]
+        if node.payload is not None:
+            self._host_bytes -= node.payload.nbytes
+            self._spilled -= 1
+        node.payload = HostEntry(blob, nbytes)
+        self._host_bytes += nbytes
+        self._spilled += 1
+        return True
+
+    def _host_forget_node(self, node: TrieNode) -> None:
+        """Drop one host entry's bytes (reloaded or superseded) and
+        prune whatever chain that leaves dead."""
+        if node.payload is not None:
+            self._host_bytes -= node.payload.nbytes
+            self._spilled -= 1
+            node.payload = None
+        self._host_prune_chain(node)
+
+    def _host_prune_chain(self, node: TrieNode | None) -> None:
+        """Remove the dead suffix of ONE path: walking UP from `node`,
+        drop childless payload-less nodes until a live ancestor (or
+        the root). O(depth) per forget/drop — a full-trie rescan here
+        made reload and LRU churn quadratic on the engine thread
+        (dead nodes only ever appear along the path just touched, so
+        the upward walk reaches every one a rescan would)."""
+        while (
+            node is not None and node.parent is not None
+            and not node.children and node.payload is None
+        ):
+            parent = node.parent
+            self._host.remove(node)
+            node = parent
 
     def insert(self, tokens, pages: list[int], root_key: tuple = ()) -> int:
         """Index the full-page prefix of `tokens`, whose KV lives in
@@ -245,15 +520,37 @@ class PagedPrefixCache:
                 node.payload = int(page)
                 new += 1
         self._pages += new
+        if new and self.spill_enabled:
+            # Blocks recomputed cold (e.g. after a failed re-upload)
+            # are device-resident again: their host twins are stale
+            # duplicates now — drop them so the budget holds live
+            # spill value only.
+            hpath = self._host.walk(
+                np.asarray(tokens)[: n_full * self.page_size], root_key
+            )
+            for dnode, hnode in zip(path, hpath):
+                if hnode.payload is not None and dnode.payload is not None:
+                    self._host_forget_node(hnode)
         self._gauges()
         return new
 
-    def evict(self, need_pages: int) -> int:
+    def evict(self, need_pages: int, *, exclude=()) -> int:
         """Free at least `need_pages` pages the cache alone holds
         (refcount 1), least-recently-used leaves first — cached pages
-        are reclaimed before any live request is ever evicted. Returns
-        the number actually freed (may be fewer: entries shared with
-        live slots are pinned)."""
+        are reclaimed before any live request is ever evicted. With the
+        host tier armed, each victim's bytes SPILL to host RAM before
+        its device page returns (the entry survives, reloadable);
+        otherwise the entry dies. Returns the number of device pages
+        actually freed (may be fewer: entries shared with live slots
+        are pinned).
+
+        exclude: page ids that must NOT be evicted this call. The
+        reload path passes the device prefix it just matched — those
+        pages are still refcount-1 (lookup takes no references; the
+        requester's share lands only after reload), so without the
+        exclusion an eviction round could free the very pages the
+        splice is about to share."""
+        exclude = {int(p) for p in exclude}
         freed = 0
         while freed < need_pages:
             # One gather per ROUND, oldest first (removing a leaf never
@@ -263,7 +560,8 @@ class PagedPrefixCache:
             candidates = sorted(
                 (
                     n for n in self.trie.leaves()
-                    if self.allocator.refcount(n.payload) == 1
+                    if n.payload not in exclude
+                    and self.allocator.refcount(n.payload) == 1
                 ),
                 key=lambda n: n.stamp,
             )
@@ -272,6 +570,7 @@ class PagedPrefixCache:
             for victim in candidates:
                 if freed >= need_pages:
                     break
+                self._spill(victim)
                 self.allocator.release([victim.payload], owner="cache")
                 self.trie.remove(victim)
                 self._pages -= 1
@@ -282,13 +581,18 @@ class PagedPrefixCache:
         return freed
 
     def clear(self) -> None:
-        """Drop every entry, releasing the cache's references (used when
-        the scheduler rebuilds a consumed pool)."""
+        """Drop every entry — device references AND the host tier
+        (used when the scheduler rebuilds a consumed pool, and by
+        degraded-mode cache shedding: a shed must actually free the
+        host RAM too)."""
         for node in list(self.trie.nodes()):
             if node.payload is not None:
                 self.allocator.release([node.payload], owner="cache")
         self.trie = TokenTrie(self.page_size)
         self._pages = 0
+        self._host = TokenTrie(self.page_size)
+        self._host_bytes = 0
+        self._spilled = 0
         self._gauges()
 
 

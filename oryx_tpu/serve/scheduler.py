@@ -347,6 +347,10 @@ class ContinuousScheduler:
         forensics: forensics_lib.ForensicRing | None = None,
         audit_sample_every: int = 0,
         numerics_every: int = 0,
+        kv_dtype: str = "bf16",
+        host_cache_bytes: int = 0,
+        audit_tol_maxdiff: float | None = None,
+        audit_tol_kl: float | None = None,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -450,6 +454,24 @@ class ContinuousScheduler:
                 "tokens per step (rounded up)",
                 prefill_chunk, chunk, self.pf_width * chunk,
             )
+        # KV pool storage format (docs/DESIGN.md "KV quantization &
+        # cache tiering"): "bf16" = dense pages in the compute dtype
+        # (today's byte-exact path); "int8" = quantized pool with
+        # per-page scale blocks — quantize on page write, dequantize
+        # in the page walk — roughly doubling resident KV tokens per
+        # HBM byte. The audit plane's drift tolerances gate the
+        # numerics cost continuously.
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}"
+            )
+        self.kv_dtype = kv_dtype
+        if not isinstance(host_cache_bytes, int) or host_cache_bytes < 0:
+            raise ValueError(
+                "host_cache_bytes must be a non-negative integer "
+                f"(0 = host spill tier off), got {host_cache_bytes!r}"
+            )
+        self.host_cache_bytes = host_cache_bytes
         self.metrics = metrics or ServingMetrics()
         # Pre-register the prefix-cache + prefill families so the full
         # ladder renders (at zero) from the first scrape.
@@ -459,6 +481,16 @@ class ContinuousScheduler:
         reg.counter("prefix_cache_evicted_pages_total")
         reg.gauge("prefix_cache_entries")
         reg.gauge("prefix_cache_pages")
+        # Host spill-tier families, pre-registered at zero whether or
+        # not the tier is armed (ladders must render before the first
+        # spill), plus the pool's wire format as a build-info label.
+        reg.gauge("oryx_cache_spilled_pages", raw_name=True)
+        reg.gauge("oryx_cache_host_bytes", raw_name=True)
+        reg.counter("oryx_cache_reload_hit_total", raw_name=True)
+        reg.counter("oryx_cache_reload_upload_total", raw_name=True)
+        reg.info(
+            "oryx_pool_kv_dtype", {"kv_dtype": kv_dtype}, raw_name=True
+        )
         reg.counter("prefill_tokens_total")
         reg.histogram("prefill_chunk_tokens", PREFILL_CHUNK_BUCKETS)
         # Dispatch accounting: how many device dispatches each engine
@@ -540,12 +572,12 @@ class ContinuousScheduler:
         # successful allocation), not one per step.
         self._oom_episode = False  # thread-owned: engine
         self.prefix_cache = (
-            PagedPrefixCache(self.allocator, metrics=self.metrics)
-            if prefix_cache else None
+            self._build_prefix_cache() if prefix_cache else None
         )
         dtype = oryx.compute_dtype(self.cfg)
         self.kv_pages = self._place_kv(qwen2.init_paged_kv_cache(
-            self.cfg.llm, self.num_pages, page_size, dtype=dtype
+            self.cfg.llm, self.num_pages, page_size, dtype=dtype,
+            kv_dtype=self._pool_kv_dtype(),
         ))
         S = num_slots
         self._sentinel = self.allocator.sentinel
@@ -647,6 +679,8 @@ class ContinuousScheduler:
             sample_every=audit_sample_every, metrics=self.metrics,
             request_log=self.request_log, anomaly=self.anomaly,
             engine_label=engine_label, replica_id=replica_id,
+            kv_dtype=kv_dtype,
+            fail_abs_tol=audit_tol_maxdiff, fail_kl_tol=audit_tol_kl,
         )
         # Numerics sentinels (utils/numerics.py): every
         # `numerics_every` engine steps the dispatch carries the logit
@@ -705,6 +739,40 @@ class ContinuousScheduler:
             self._thread.start()
 
     # ---- public API (the Engine protocol surface, serve/engine.py) -------
+
+    def _pool_kv_dtype(self) -> str | None:
+        """init_paged_kv_cache's kv_dtype spelling of the flag value
+        (None = dense pages in the compute dtype)."""
+        return None if self.kv_dtype == "bf16" else self.kv_dtype
+
+    def _build_prefix_cache(self) -> PagedPrefixCache:
+        """The prefix cache over the CURRENT allocator, host spill
+        tier wired when --host-cache-bytes asked for one. The spill
+        callbacks read/write `self.kv_pages` at call time (the pool's
+        identity changes at every donated dispatch), and upload runs
+        under the pipe's mesh scope so a heads-sharded pool re-places
+        the page correctly."""
+        return PagedPrefixCache(
+            self.allocator, metrics=self.metrics,
+            host_cache_bytes=self.host_cache_bytes,
+            spill_fetch=self._spill_fetch,
+            spill_upload=self._spill_upload,
+        )
+
+    def _spill_fetch(self, page: int):
+        """Device -> host byte copy of one pool page (engine thread;
+        the prefix cache's spill_fetch callback)."""
+        blob = paged_kv.fetch_page(self.kv_pages, int(page))
+        return blob, paged_kv.host_blob_bytes(blob)
+
+    def _spill_upload(self, blob, page: int) -> None:
+        """Host -> device byte copy into a freshly allocated pool page
+        (engine thread; the prefix cache's spill_upload callback).
+        Donates and reassigns the pool like every other device edit."""
+        with self.pipe._mesh_scope():
+            self.kv_pages = paged_kv.upload_page(
+                self.kv_pages, jnp.asarray(int(page), jnp.int32), blob
+            )
 
     def _place_kv(self, kv_pages):
         """Tensor-parallel placement of the paged pool: KV heads
@@ -1069,13 +1137,15 @@ class ContinuousScheduler:
         self.pool_observatory.attach(self.allocator)
         if self.prefix_cache is not None:
             # The old cache indexed pages of the CONSUMED pool; rebuild
-            # it over the fresh allocator.
-            self.prefix_cache = PagedPrefixCache(
-                self.allocator, metrics=self.metrics
-            )
+            # it over the fresh allocator (the host tier restarts empty
+            # too: its blobs are still valid KV bytes, but re-seeding
+            # them into a fresh trie buys little against the complexity
+            # of a partial-trust tier after a crash).
+            self.prefix_cache = self._build_prefix_cache()
         self.kv_pages = self._place_kv(qwen2.init_paged_kv_cache(
             self.cfg.llm, self.num_pages, self.page_size,
             dtype=oryx.compute_dtype(self.cfg),
+            kv_dtype=self._pool_kv_dtype(),
         ))
         self.bt[:] = self._sentinel
         self._oom_episode = False
@@ -1118,6 +1188,18 @@ class ContinuousScheduler:
             # moment, so a scrape right after this snapshot agrees
             # with it (the collector is otherwise TTL-cached).
             self.pool_observatory.collect(force=True)
+        # Wire-format provenance + the pool's device byte cost
+        # (metadata only — leaf shapes, no device sync): what turns
+        # "peak pages" into "peak KV bytes" downstream, the unit the
+        # int8 pool actually halves (pages are token-granular and
+        # dtype-blind). Read off the LIVE pool, not the flag, so the
+        # report can never disagree with what is actually resident
+        # (a dense pool reports its real dtype, e.g. "float32").
+        snap["kv_dtype"] = paged_kv.kv_pool_dtype(self.kv_pages)
+        snap["kv_pool_bytes"] = int(sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(self.kv_pages)
+        ))
         snap["summary"] = pagemap.summarize(snap)
         return snap
 
@@ -1156,6 +1238,10 @@ class ContinuousScheduler:
                 "entries": self.prefix_cache.entries,
                 "pages": self.prefix_cache.pages,
                 "evictable_pages": self.prefix_cache.evictable_pages(),
+                # Host spill tier at the incident: what eviction can
+                # still bank (vs drop) and how much budget remains.
+                "spilled_pages": self.prefix_cache.spilled_pages,
+                "host_bytes": self.prefix_cache.host_bytes,
             }
             leaves = sorted(
                 self.prefix_cache.trie.leaves(), key=lambda n: n.stamp
@@ -1886,15 +1972,18 @@ class ContinuousScheduler:
         # so a False return leaves the integral untouched).
         req.pages_t = time.monotonic()
         spliced = 0
-        matched, pages = 0, []
+        matched, pages, host_nodes = 0, [], []
         cache_on = (
             self.prefix_cache is not None
             and req.cache_tokens is not None
             and not self._cache_shed  # degraded >= 1: no splicing
         )
         if cache_on:
-            matched, pages = self.prefix_cache.lookup(req.cache_tokens)
-        use = min(matched, max(req.length - 1, 0))
+            matched, pages, host_nodes = (
+                self.prefix_cache.lookup_tiered(req.cache_tokens)
+            )
+        limit = max(req.length - 1, 0)
+        use = min(matched, limit)
         full = use // ps
         # Feasibility screen BEFORE any share or COW device copy: the
         # fresh pages needed beyond the spliced prefix must be coverable
@@ -1923,6 +2012,36 @@ class ContinuousScheduler:
                     asking=(s, req, total_need - full),
                 )
             return False
+        if cache_on and host_nodes and full == len(pages):
+            # Host-tier hit: the prompt's cached prefix continues past
+            # the device-resident blocks into spilled entries — reload
+            # them onto fresh pages AHEAD of the suffix prefill, so
+            # the splice (and the suffix-only prefill bill) covers
+            # them too. Reload needs one free page per block; let the
+            # LRU arbitrate hot-vs-cold when the free list is short
+            # (evicting a cold entry — which itself spills — to bring
+            # a hot one back is exactly the tier working). Every
+            # failure mode (no page, failed upload) just shortens the
+            # match: the remaining suffix recomputes cold.
+            n_host = min(len(host_nodes), limit // ps - full)
+            if n_host > 0:
+                short = n_host - self.allocator.num_free
+                keep = [int(p) for p in pages[:full]]
+                if short > 0 and self.prefix_cache.evictable_pages(
+                    exclude=keep
+                ) >= short:
+                    # The matched device prefix is still refcount-1
+                    # (nothing shared yet) — exclude it or this round
+                    # could evict the pages the splice shares below.
+                    self.prefix_cache.evict(short, exclude=keep)
+                reloaded = self.prefix_cache.reload(
+                    req.cache_tokens, host_nodes[:n_host]
+                )
+                if reloaded:
+                    pages = pages + reloaded
+                    matched = len(pages) * ps
+                    use = min(matched, limit)
+                    full = use // ps
         if cache_on:
             if full:
                 share = [int(p) for p in pages[:full]]

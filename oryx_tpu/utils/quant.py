@@ -124,33 +124,98 @@ def quantize_params(params: Params, cast=None) -> Params:
 
 
 # ---------------------------------------------------------------------------
-# Round-trip error statistics (the int8 paged-KV groundwork)
+# Round-trip error statistics (the int8 / fp8 paged-KV groundwork)
 # ---------------------------------------------------------------------------
+
+# Symmetric quantization targets for the paged KV pool. int8 is the
+# shipping format; fp8-e4m3 shares the SAME layout (codes + one fp32
+# scale per token row, page-major) so the pool is fp8-ready by
+# construction — flipping the storage dtype changes one table entry,
+# not the write path, the kernels, or the COW/spill byte semantics.
+KV_STORAGE_DTYPES: dict[str, tuple[Any, float]] = {
+    # name -> (storage dtype, symmetric max representable magnitude)
+    "int8": (jnp.int8, 127.0),
+    "fp8_e4m3": (jnp.float8_e4m3fn, 448.0),
+}
+
+
+def kv_storage_dtype(name: str) -> tuple[Any, float]:
+    """(storage dtype, qmax) for a KV quantization format name; raises
+    with the known names on a typo."""
+    try:
+        return KV_STORAGE_DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown KV storage dtype {name!r} "
+            f"(known: {sorted(KV_STORAGE_DTYPES)})"
+        ) from None
 
 
 def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
                dtype=jnp.float32) -> jnp.ndarray:
-    """Invert `quantize_array`'s mapping (or any symmetric int8 +
-    scale pair, e.g. a per-page KV quantizer's output)."""
+    """Invert `quantize_array`'s mapping (or any symmetric int8/fp8 +
+    scale pair, e.g. the per-page KV quantizer's output)."""
     return q.astype(dtype) * scale.astype(dtype)
 
 
+def _encode(x: jnp.ndarray, scale: jnp.ndarray, fmt: str) -> jnp.ndarray:
+    """Symmetric encode of pre-scaled rows: int8 rounds-and-clips,
+    fp8 relies on the hardware format's own rounding (the cast). One
+    helper so every quantization site in the repo maps values to codes
+    identically — the byte-determinism the COW/spill planes rely on."""
+    dt, qmax = kv_storage_dtype(fmt)
+    y = x / scale
+    if fmt == "int8":
+        return jnp.clip(jnp.round(y), -qmax, qmax).astype(dt)
+    return jnp.clip(y, -qmax, qmax).astype(dt)
+
+
+def quantize_kv_rows(
+    x: jnp.ndarray, fmt: str = "int8"
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-TOKEN-ROW symmetric quantization of packed KV rows
+    [..., Hk, D]: one fp32 scale per leading index (amax over the
+    trailing head × dim axes). This is the paged pool's write-side
+    quantizer (ops/paged_kv.write_pages*): scale-per-row makes the
+    encoding a PURE FUNCTION of the token's own K/V value, so bytes
+    never depend on chunk grouping, write order, or pool history —
+    which is exactly what keeps cold-vs-cached, replay, COW and
+    host-spill/reload byte-identical on the quantized path (see
+    docs/DESIGN.md "KV quantization & cache tiering").
+
+    Returns (codes [...same shape], scale [...leading] fp32)."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    _, qmax = kv_storage_dtype(fmt)
+    scale = (amax / qmax + jnp.finfo(jnp.float32).tiny).astype(jnp.float32)
+    return _encode(xf, scale[..., None, None], fmt), scale
+
+
+def dequantize_kv_rows(
+    q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32
+) -> jnp.ndarray:
+    """Invert `quantize_kv_rows`: codes [..., Hk, D] x scale [...]."""
+    return q.astype(dtype) * scale[..., None, None].astype(dtype)
+
+
 def roundtrip_error_stats(
-    w: jnp.ndarray, *, axis: int = -2
+    w: jnp.ndarray, *, axis: int = -2, fmt: str = "int8"
 ) -> dict[str, float]:
-    """Quantize-dequantize `w` through the symmetric int8 path and
-    report the reconstruction error: max-abs and rms, absolute and
-    relative to the tensor's own absmax. One call answers "is int8
-    good enough for THIS tensor" — the standing spot-check ROADMAP
-    item 3's quantized-KV PR gates against (and what test_quant.py
-    pins so the quantizer's error envelope cannot drift silently).
+    """Quantize-dequantize `w` through the symmetric path of `fmt`
+    (int8 or fp8_e4m3 — same API, same scale convention) and report
+    the reconstruction error: max-abs and rms, absolute and relative
+    to the tensor's own absmax. One call answers "is this format good
+    enough for THIS tensor" — the standing spot-check ROADMAP item 3's
+    quantized-KV PR gates against (and what test_quant.py pins so the
+    quantizer's error envelope cannot drift silently).
 
     axis: the reduction axis the scale spans (-2 = per-output-channel,
     the weight path's convention)."""
+    _, qmax = kv_storage_dtype(fmt)
     w = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
-    scale = amax / 127.0 + jnp.finfo(jnp.float32).tiny
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    scale = amax / qmax + jnp.finfo(jnp.float32).tiny
+    q = _encode(w, scale, fmt)
     err = jnp.abs(dequantize(q, scale) - w)
     overall = float(jnp.max(jnp.abs(w)))
     max_abs = float(jnp.max(err))
@@ -165,21 +230,21 @@ def roundtrip_error_stats(
 
 def page_roundtrip_error(
     pages: jnp.ndarray,  # [P, page, Hk, D] one layer's K or V pool
+    *, fmt: str = "int8",
 ) -> dict[str, jnp.ndarray]:
-    """PER-PAGE symmetric-int8 round-trip error over a paged KV pool
-    layer: one scale per page (the int8 paged-KV design — quantize on
-    page write, dequantize inside the kernel's page walk), errors
+    """PER-PAGE symmetric round-trip error over a paged KV pool layer
+    in format `fmt` (int8 or fp8_e4m3): one scale per page, errors
     reduced per page so the answer is a [P] vector an operator (or the
-    audit plane) can rank: which resident's pages would int8 hurt
-    most. Returns {"max_abs_err": [P], "rms_err": [P], "scale": [P]}."""
+    audit plane) can rank: which resident's pages would quantization
+    hurt most. Returns {"max_abs_err": [P], "rms_err": [P],
+    "scale": [P]}."""
+    _, qmax = kv_storage_dtype(fmt)
     x = jnp.asarray(pages, jnp.float32)
     P = x.shape[0]
     flat = x.reshape(P, -1)
     amax = jnp.max(jnp.abs(flat), axis=1)
-    scale = amax / 127.0 + jnp.finfo(jnp.float32).tiny
-    q = jnp.clip(
-        jnp.round(flat / scale[:, None]), -127, 127
-    ).astype(jnp.int8)
+    scale = amax / qmax + jnp.finfo(jnp.float32).tiny
+    q = _encode(flat, scale[:, None], fmt)
     err = jnp.abs(q.astype(jnp.float32) * scale[:, None] - flat)
     return {
         "max_abs_err": jnp.max(err, axis=1),

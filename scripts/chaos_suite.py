@@ -470,6 +470,65 @@ def scenario_spec_drift(h: Harness) -> None:
           "byte-identical, 0 leaks")
 
 
+def scenario_host_spill_upload(h: Harness) -> None:
+    """Host spill-tier re-upload failure (site `host_spill_upload`,
+    one injected raise) on an int8 pool with the host tier armed: a
+    prompt is served cold, its cached prefix is force-spilled to host
+    RAM, and the SAME prompt is re-sent — the injected upload failure
+    must degrade the reload to a cold recompute (byte-identical 200
+    reply, never a crash), with the pool invariant and zero leaks
+    after the incident; a third send proves the tier recovered."""
+    srv, base = h.boot(
+        "host_spill_upload:times=1",
+        kv_dtype="int8", host_cache_bytes=1 << 24, prefill_chunk=32,
+    )
+    try:
+        prompt = "host tier chaos shared prefix " * 4
+        status, cold, _ = h.post_chat(base, prompt, 6)
+        if status != 200:
+            fail(f"[host_spill_upload] cold request: {status} {cold}")
+        cold_text = cold["choices"][0]["message"]["content"]
+        sched = srv.scheduler
+        wait_for(
+            lambda: all(r is None for r in sched.slots)
+            and sched.queue_len() == 0,
+            what="[host_spill_upload] quiesce before the forced spill",
+        )
+        from oryx_tpu.analysis.sanitizers import race_exempt
+
+        with race_exempt("forced cache spill: engine quiesced by the "
+                         "wait above"):
+            cache = sched.prefix_cache
+            cache.evict(cache.evictable_pages())
+            spilled = cache.spilled_pages
+        if not spilled:
+            fail("[host_spill_upload] forced eviction spilled nothing "
+                 "(tier not armed?)")
+        # Re-send: the reload attempt hits the injected failure and
+        # must fall back to a cold recompute of the whole prefix.
+        status, warm, _ = h.post_chat(base, prompt, 6)
+        if status != 200:
+            fail(f"[host_spill_upload] re-send under injected upload "
+                 f"failure: {status} {warm}")
+        warm_text = warm["choices"][0]["message"]["content"]
+        if warm_text != cold_text:
+            fail("[host_spill_upload] degraded (cold-recompute) reply "
+                 f"diverged: {warm_text!r} != {cold_text!r}")
+        # Third send: the fault schedule is exhausted and the cold
+        # recompute re-donated the prefix — a normal cached hit.
+        status, third, _ = h.post_chat(base, prompt, 6)
+        if status != 200 or (
+            third["choices"][0]["message"]["content"] != cold_text
+        ):
+            fail(f"[host_spill_upload] post-incident send: {status} "
+                 f"{third}")
+        h.assert_triad(
+            srv, base, "host_spill_upload", ["host_spill_upload"]
+        )
+    finally:
+        h.teardown(srv)
+
+
 def scenario_checkpoint_save(h: Harness) -> None:
     """Two injected save failures: bounded backoff retries land the
     checkpoint on the third attempt, schedule pinned (no wall-clock
@@ -551,13 +610,14 @@ def main() -> None:
     params = oryx.init_params(cfg, jax.random.key(0))
     pipe = OryxInference(_Tokenizer(), params, cfg)
     h = Harness(pipe)
-    print("chaos suite: 6 scenarios against a live tiny server")
+    print("chaos suite: 7 scenarios against a live tiny server")
     for scenario in (
         scenario_page_alloc_oom,
         scenario_engine_crash,
         scenario_hung_dispatch,
         scenario_client_disconnect,
         scenario_spec_drift,
+        scenario_host_spill_upload,
         scenario_checkpoint_save,
     ):
         scenario(h)

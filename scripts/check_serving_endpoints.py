@@ -170,9 +170,11 @@ def run_checks(base: str) -> str:
         # attributor's, oryx_audit_/oryx_numerics_ the output-quality
         # observatory's — raw-named like oryx_anomaly_ because their
         # semantics are engine-independent.
+        # oryx_cache_ is the prefix cache's host spill tier
+        # (raw-named: tier semantics are engine-independent too).
         else ("oryx_serving_", "oryx_anomaly_", "oryx_pool_",
               "oryx_page_", "oryx_device_time_", "oryx_profile_",
-              "oryx_audit_", "oryx_numerics_")
+              "oryx_audit_", "oryx_numerics_", "oryx_cache_")
     )
     info_family = (
         "oryx_router_build_info" if kind == "router"
@@ -248,6 +250,24 @@ def run_checks(base: str) -> str:
                 rf'^{fam}_bucket\{{le="\+Inf"\}} ', metrics_text, re.M
             ):
                 fail(f"{fam} histogram ladder not pre-registered")
+        # Host spill-tier families (prefix-cache host-RAM tier) and
+        # the pool's wire-format label: pre-registered at zero so the
+        # capacity dashboard renders before the first spill, and the
+        # kv_dtype provenance is scrapeable from boot.
+        for fam in (
+            "oryx_cache_spilled_pages",
+            "oryx_cache_host_bytes",
+            "oryx_cache_reload_hit_total",
+            "oryx_cache_reload_upload_total",
+        ):
+            if not re.search(rf"^{fam} ", metrics_text, re.M):
+                fail(f"{fam} not pre-registered on boot")
+        if not re.search(
+            r'^oryx_pool_kv_dtype\{kv_dtype="(bf16|int8)"\} 1$',
+            metrics_text, re.M,
+        ):
+            fail("oryx_pool_kv_dtype{kv_dtype=} build-info gauge "
+                 "missing from /metrics")
     else:
         # The router has no HBM of its own; the fleet's shows through
         # the aggregation endpoint, every sample line replica-labeled.

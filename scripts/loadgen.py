@@ -308,6 +308,8 @@ def memory_block(m0: str, m1: str, pages: dict,
         "pool": {
             "num_pages": pages.get("num_pages"),
             "page_size": pages.get("page_size"),
+            "kv_dtype": pages.get("kv_dtype"),
+            "kv_pool_bytes": pages.get("kv_pool_bytes"),
         },
         "end": {
             k: summary.get(k)
@@ -326,6 +328,15 @@ def memory_block(m0: str, m1: str, pages: dict,
     ]
     if num_pages is not None and frees:
         block["stage_peak_pages_in_use"] = num_pages - min(frees)
+    kv_bytes = pages.get("kv_pool_bytes")
+    peak = block.get(
+        "stage_peak_pages_in_use", block.get("peak_pages_in_use")
+    )
+    if kv_bytes and num_pages and peak is not None:
+        # Peak occupancy in HBM BYTES: pages x (pool bytes / pages) —
+        # the row that halves under --kv-dtype int8 while the page
+        # count stays put (pages are token-granular).
+        block["stage_peak_kv_bytes"] = int(peak * kv_bytes / num_pages)
     for name, fam in (
         ("page_lifetime_s", "oryx_page_lifetime_seconds"),
         ("page_idle_s", "oryx_page_idle_seconds"),
@@ -355,6 +366,24 @@ def memory_block(m0: str, m1: str, pages: dict,
     }
     block["sampled_wall_s"] = {
         k: round(wall1[k] - wall0.get(k, 0.0), 6) for k in sorted(wall1)
+    }
+    # Host-tier rows (the prefix cache's host-RAM spill plane): end-of
+    # -stage residency plus the stage's reload economics — hits are
+    # requests whose splice crossed into spilled blocks, uploads the
+    # pages brought back. hit rate = uploaded pages per hit (how much
+    # spilled prefix each hit recovered on average is uploads/hits;
+    # the fraction of hits that recovered ANYTHING device-side is what
+    # the closed-loop gate asserts via the counters themselves).
+    rh = _counter_value(m1, "oryx_cache_reload_hit_total") \
+        - _counter_value(m0, "oryx_cache_reload_hit_total")
+    ru = _counter_value(m1, "oryx_cache_reload_upload_total") \
+        - _counter_value(m0, "oryx_cache_reload_upload_total")
+    block["host_tier"] = {
+        "spilled_pages": _counter_value(m1, "oryx_cache_spilled_pages"),
+        "host_bytes": _counter_value(m1, "oryx_cache_host_bytes"),
+        "reload_hits": rh,
+        "reload_uploads": ru,
+        "reload_pages_per_hit": round(ru / rh, 4) if rh else None,
     }
     return block
 
@@ -1053,6 +1082,8 @@ def boot_tiny_server(args, *, replica_id: str | None = None,
         pipe, port=0, engine="continuous", num_slots=2, page_size=16,
         decode_chunk=4, max_ctx=512, prefill_chunk=32,
         ragged=bool(speculate), speculate=speculate,
+        kv_dtype=getattr(args, "kv_dtype", "bf16"),
+        host_cache_bytes=getattr(args, "host_cache_bytes", 0),
         profile_sample_every=profile_sample_every,
         ttft_slo=args.server_ttft_slo,
         queue_depth_slo=args.server_queue_depth_slo,
@@ -1163,6 +1194,18 @@ def run(argv=None) -> dict:
                     "speculative ragged engine (--ragged --speculate K "
                     "semantics); the per-stage speculation block then "
                     "reports accepted-tokens/step and draft economics")
+    ap.add_argument("--kv-dtype", choices=["bf16", "int8"],
+                    default="bf16",
+                    help="self-booted server: paged KV pool storage "
+                    "format (int8 = quantized pages with per-page "
+                    "scales — ~2x resident KV tokens per page budget). "
+                    "Stamped into the report's provenance; "
+                    "bench_compare REFUSES cross-dtype diffs.")
+    ap.add_argument("--host-cache-bytes", type=int, default=0,
+                    help="self-booted server: host-RAM prefix-cache "
+                    "spill tier budget in bytes (0 = off); the "
+                    "per-stage memory block then carries host-tier "
+                    "rows (spilled pages, reload hit economics)")
     ap.add_argument("--profile-sample-every", type=int, default=0,
                     metavar="N",
                     help="self-booted server only: arm the sampled "
@@ -1333,6 +1376,11 @@ def run(argv=None) -> dict:
         pool_geom = {
             "num_pages": pool_probe.get("num_pages"),
             "page_size": pool_probe.get("page_size"),
+            # Device bytes of the whole KV pool (codes + scales on a
+            # quantized pool): pages are token-granular and
+            # dtype-blind, so THIS is the unit --kv-dtype int8
+            # halves at identical geometry-in-tokens.
+            "kv_pool_bytes": pool_probe.get("kv_pool_bytes"),
         }
         # End-of-sweep zero-leak audit (self-booted targets only —
         # a remote server's quiescence is unknowable from here): with
@@ -1402,6 +1450,17 @@ def run(argv=None) -> dict:
                 "smoke": args.smoke,
                 "router_replicas": args.router or None,
                 "pool": pool_geom,
+                # KV-pool wire format + host-tier geometry provenance:
+                # page counts from pools storing different bytes per
+                # token are category errors (a remote target's format
+                # is unknowable from here -> null, like the engine
+                # flags above).
+                "kv_dtype": (
+                    None if args.base_url else args.kv_dtype
+                ),
+                "host_cache_bytes": (
+                    None if args.base_url else args.host_cache_bytes
+                ),
                 # The EFFECTIVE cadence: router fleets boot every
                 # replica with sampling off (jax's profiler is
                 # process-global), so stamping the CLI value would

@@ -882,12 +882,13 @@ def test_metrics_content_type_and_build_info(continuous_server):
         assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
         text = r.read().decode()
     # oryx_pool_/oryx_page_ (page-pool observatory),
-    # oryx_device_time_/oryx_profile_ (device-time attributor) and
-    # oryx_audit_/oryx_numerics_ (output-quality observatory) are
+    # oryx_device_time_/oryx_profile_ (device-time attributor),
+    # oryx_audit_/oryx_numerics_ (output-quality observatory) and
+    # oryx_cache_ (the prefix cache's host spill tier) are
     # raw-named like oryx_anomaly_: engine-independent semantics.
     allowed = ("oryx_serving_", "oryx_anomaly_", "oryx_pool_",
                "oryx_page_", "oryx_device_time_", "oryx_profile_",
-               "oryx_audit_", "oryx_numerics_")
+               "oryx_audit_", "oryx_numerics_", "oryx_cache_")
     for line in text.splitlines():
         if line and not line.startswith("#"):
             assert line.startswith(allowed), line

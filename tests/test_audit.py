@@ -366,3 +366,126 @@ def test_numerics_with_speculate_rejected(pipe):
             prefill_chunk=8, ragged=True, speculate=2,
             numerics_every=4, autostart=False,
         )
+
+
+# ---------------------------------------------------------------------------
+# Quantized pool: production twin, derived tolerances, verdict flip
+# ---------------------------------------------------------------------------
+
+
+def test_drift_fail_tolerance_defaults_derive_from_roundtrip():
+    fp = audit_lib.drift_fail_tolerances("bf16")
+    q8 = audit_lib.drift_fail_tolerances("int8")
+    assert fp == (1e-2, 1e-3)
+    # int8 defaults: positive, finite, looser than the fp pass line —
+    # 64x/8x the relative rms of the POOL'S OWN quantizer (per-token
+    # scales over the joint head x dim axes, the write path's real
+    # granularity — a per-(token, head) probe would understate the
+    # error for head-imbalanced models and over-tighten the gate).
+    assert 0 < q8[1] < q8[0] < 1.0
+    import jax.numpy as jnp
+
+    from oryx_tpu.utils.quant import dequantize_kv_rows, quantize_kv_rows
+
+    probe = jax.random.normal(jax.random.key(0), (256, 4, 32))
+    codes, scale = quantize_kv_rows(probe, "int8")
+    err = dequantize_kv_rows(codes, scale) - probe
+    rel = float(jnp.sqrt(jnp.mean(err * err)) / jnp.max(jnp.abs(probe)))
+    assert q8[0] == pytest.approx(64.0 * rel)
+    assert q8[1] == pytest.approx(8.0 * rel)
+
+
+def _int8_live_job(pipe, question="tolerance flip probe", cap=6):
+    """A real int8-served reply + its audit job: the live stream the
+    quantized production twin must reproduce byte-for-byte."""
+    sched, _, results = _run(
+        pipe, [(question, cap, {"temperature": 0.0})], kv_dtype="int8"
+    )
+    sched.close()
+    emitted = FakeTokenizer().encode(results[0][0])
+    ids, imgs, factors, caps = pipe._prepare_request(
+        {"question": question}
+    )
+    with pipe._mesh_scope():
+        embeds, length = pipe._prompt_embeds(
+            pipe.cfg, ids, imgs, factors, caps
+        )
+    return {
+        "request_id": "int8-flip",
+        "embeds": np.asarray(embeds),
+        "length": int(length),
+        "max_new": cap,
+        "seed": 0,
+        "emitted": emitted,
+        "completion": len(emitted),
+        "finish_reason": results[0][1],
+        "evictions": 0,
+    }
+
+
+def test_verdict_flips_fail_exactly_at_the_tolerance(pipe):
+    """The --audit-tol-maxdiff boundary is the drift-vs-fail verdict
+    flip: the SAME int8-served request audits `drift` with the fail
+    tolerance just above its measured logit drift and `fail` with it
+    just below — byte parity against the quantized twin holding in
+    both runs (the drift is numeric, not a divergence)."""
+    job = _int8_live_job(pipe)
+    # First pass, wide-open fail tolerance: measure the drift.
+    aud = _auditor(pipe, kv_dtype="int8", fail_abs_tol=1e9,
+                   fail_kl_tol=1e9)
+    aud._pending.append(dict(job))
+    assert aud.run_one()
+    rec = aud.to_dict()["records"][0]
+    assert rec["first_divergence"] == -1  # twin reproduces the bytes
+    drift = rec["logit_max_abs_diff"]
+    assert drift is not None and drift > 0  # int8 vs fp is nonzero
+    assert rec["verdict"] in ("drift", "pass")
+    # Tolerance just below the measured drift: same request FAILS.
+    tight = _auditor(pipe, kv_dtype="int8", fail_abs_tol=drift * 0.5,
+                     fail_kl_tol=1e9)
+    tight._pending.append(dict(job))
+    assert tight.run_one()
+    tight_rec = tight.to_dict()["records"][0]
+    assert tight_rec["verdict"] == "fail"
+    assert tight_rec["first_divergence"] == -1
+    # ...and just above it: back to drift (or pass under the pass
+    # tolerance), never fail.
+    loose = _auditor(pipe, kv_dtype="int8", fail_abs_tol=drift * 2.0,
+                     fail_kl_tol=1e9)
+    loose._pending.append(dict(job))
+    assert loose.run_one()
+    assert loose.to_dict()["records"][0]["verdict"] != "fail"
+
+
+def test_int8_audited_burst_zero_fail_verdicts(pipe):
+    """The acceptance bar: an audited burst with the quantized pool as
+    the production config yields ZERO fail verdicts, all drift within
+    the derived tolerances, byte parity vs the twin everywhere."""
+    sched, _, _ = _run(
+        pipe,
+        [(f"audited int8 burst {i}", 5, {"temperature": 0.0})
+         for i in range(3)],
+        kv_dtype="int8", audit_sample_every=1,
+    )
+    try:
+        _drain_audits(sched, 3)
+        d = sched.auditor.to_dict()
+        assert d["verdicts"]["fail"] == 0
+        assert d["total"] == 3
+        for rec in d["records"]:
+            assert rec["first_divergence"] == -1
+            assert rec["logit_max_abs_diff"] <= sched.auditor.fail_abs_tol
+    finally:
+        sched.close()
+
+
+def test_audit_tolerance_flags_reach_the_auditor(pipe):
+    sched = ContinuousScheduler(
+        pipe, num_slots=2, page_size=16, chunk=4, max_ctx=512,
+        autostart=False, kv_dtype="int8",
+        audit_tol_maxdiff=0.25, audit_tol_kl=0.03,
+    )
+    assert sched.auditor.fail_abs_tol == 0.25
+    assert sched.auditor.fail_kl_tol == 0.03
+    assert sched.auditor.compare_quant
+    sched.close()

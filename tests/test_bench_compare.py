@@ -290,3 +290,34 @@ def test_repo_artifacts_pass_the_gate():
     check CI runs after regenerating the loadgen smoke."""
     res = _run_cli(ROOT, "--gate")
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cross_kv_dtype_is_refused_with_field_named():
+    """A bf16 run against an int8 baseline stores different bytes per
+    resident token — the peak_pages delta across that line is the
+    memory-economics CLAIM, not a regression: REFUSED, field named."""
+    base = _loadgen_report()
+    base["config"]["kv_dtype"] = "bf16"
+    base["config"]["host_cache_bytes"] = 0
+    cur = _loadgen_report()
+    cur["config"]["kv_dtype"] = "int8"
+    cur["config"]["host_cache_bytes"] = 0
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert rows == [] and refusal is not None
+    assert "config.kv_dtype" in refusal
+    # Host-tier geometry drift refuses the same way.
+    cur["config"]["kv_dtype"] = "bf16"
+    cur["config"]["host_cache_bytes"] = 1 << 20
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert rows == [] and "config.host_cache_bytes" in refusal
+    # Matching stamps compare normally, host-tier rows included.
+    cur["config"]["host_cache_bytes"] = 0
+    for st in cur["stages"] + base["stages"]:
+        st["memory"]["host_tier"] = {
+            "spilled_pages": 3, "host_bytes": 4096,
+            "reload_hits": 2, "reload_uploads": 5,
+            "reload_pages_per_hit": 2.5,
+        }
+    rows, refusal = bench_compare.compare_loadgen(cur, base)
+    assert refusal is None
+    assert any("host_tier spilled_pages" in r.series for r in rows)

@@ -274,3 +274,70 @@ def test_dequantize_inverts_quantize_array():
     back = np.asarray(dequantize(qw.q, qw.scale))
     step = np.abs(w).max(axis=0, keepdims=True) / 127.0
     assert np.abs(back - w).max() <= (step / 2).max() + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# fp8-e4m3 round-trip helpers (same API as int8 — the pool's
+# "fp8-ready" claim, backed by numbers before any kernel work)
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_error_stats_fp8_same_api_and_bounds():
+    from oryx_tpu.utils.quant import roundtrip_error_stats
+
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    s8 = roundtrip_error_stats(w)
+    f8 = roundtrip_error_stats(w, fmt="fp8_e4m3")
+    assert set(f8) == set(s8)  # one API, two formats
+    assert 0 < f8["max_abs_err"]
+    assert 0 < f8["rms_err"] <= f8["max_abs_err"]
+    # e4m3 carries a 3-bit mantissa: relative error per element is
+    # bounded by half an ulp (2^-4 of the value) after the amax/448
+    # scaling keeps everything in range.
+    assert f8["rel_max_abs_err"] <= 2.0 ** -4 + 1e-6
+    # ...and is strictly coarser than int8 on full-scale gaussians
+    # (3 mantissa bits vs ~7 effective bits near amax).
+    assert f8["rms_err"] > s8["rms_err"]
+    # Powers of two round-trip exactly through e4m3.
+    grid = (2.0 ** np.arange(-4, 5, dtype=np.float32))[:, None]
+    z = roundtrip_error_stats(grid, fmt="fp8_e4m3")
+    assert z["max_abs_err"] == 0.0
+
+
+def test_page_roundtrip_error_fp8():
+    from oryx_tpu.utils.quant import page_roundtrip_error
+
+    rng = np.random.default_rng(3)
+    pages = rng.standard_normal((4, 8, 2, 4)).astype(np.float32)
+    f8 = {k: np.asarray(v)
+          for k, v in page_roundtrip_error(pages, fmt="fp8_e4m3").items()}
+    s8 = {k: np.asarray(v)
+          for k, v in page_roundtrip_error(pages).items()}
+    assert f8["max_abs_err"].shape == (4,)
+    assert (f8["max_abs_err"] > 0).all()
+    # fp8 scales divide by 448 instead of 127.
+    np.testing.assert_allclose(
+        f8["scale"] * 448.0, s8["scale"] * 127.0, rtol=1e-5
+    )
+
+
+def test_kv_rows_helpers_both_formats():
+    from oryx_tpu.utils.quant import (
+        dequantize_kv_rows,
+        kv_storage_dtype,
+        quantize_kv_rows,
+    )
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((16, 2, 8)), jnp.float32)
+    for fmt in ("int8", "fp8_e4m3"):
+        q, scale = quantize_kv_rows(x, fmt)
+        assert q.shape == x.shape and scale.shape == (16,)
+        assert q.dtype == kv_storage_dtype(fmt)[0]
+        assert scale.dtype == jnp.float32
+        back = dequantize_kv_rows(q, scale)
+        rel = float(jnp.abs(back - x).max() / jnp.abs(x).max())
+        assert rel < (0.005 if fmt == "int8" else 0.04)
+    with pytest.raises(ValueError, match="unknown KV storage dtype"):
+        quantize_kv_rows(x, "int4")

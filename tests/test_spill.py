@@ -1,0 +1,351 @@
+"""Host-RAM prefix-cache spill tier (serve/prefix_cache.py +
+scheduler wiring): spill-on-evict, tiered lookup, reload-ahead-of-
+prefill, the `host_spill_upload` fault degradation contract, budget
+bounds, and the closed-loop byte-parity + cost-ledger guarantees the
+serving engine leans on."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from oryx_tpu import config as cfg_lib
+from oryx_tpu.models import oryx
+from oryx_tpu.ops import paged_kv
+from oryx_tpu.serve.pipeline import OryxInference
+from oryx_tpu.serve.prefix_cache import PagedPrefixCache
+from oryx_tpu.serve.scheduler import ContinuousScheduler
+from oryx_tpu.utils import faults
+
+
+class FakeTokenizer:
+    def encode(self, text, add_special_tokens=False):
+        return [min(ord(c), 500) for c in text]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(i) for i in ids if 0 < i < 500)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx.init_params(cfg, jax.random.key(0))
+    return OryxInference(FakeTokenizer(), params, cfg)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Cache-level unit tests (a toy "device" of numbered blobs)
+# ---------------------------------------------------------------------------
+
+
+class ToyDevice:
+    """Stand-in for the pool: per-page content a spill can fetch and
+    an upload writes back, with byte accounting and failure arming."""
+
+    def __init__(self, alloc):
+        self.alloc = alloc
+        self.content = {}  # page -> bytes payload
+        self.fail_uploads = 0
+        self.uploads = 0
+
+    def fetch(self, page):
+        blob = self.content[page].ljust(20)[:20]  # fixed-size blobs
+        return blob, len(blob)
+
+    def upload(self, blob, page):
+        if self.fail_uploads > 0:
+            self.fail_uploads -= 1
+            raise RuntimeError("toy upload failure")
+        self.uploads += 1
+        self.content[page] = blob
+
+
+def _cache(num_pages=16, page_size=4, budget=1 << 20):
+    alloc = paged_kv.PageAllocator(num_pages, page_size)
+    dev = ToyDevice(alloc)
+    cache = PagedPrefixCache(
+        alloc, host_cache_bytes=budget,
+        spill_fetch=dev.fetch, spill_upload=dev.upload,
+    )
+    return alloc, dev, cache
+
+
+def _seed_entry(alloc, dev, cache, tokens):
+    """Simulate a donation: pages the 'request' computed, indexed by
+    the cache (which takes its own share), then released by the
+    request — leaving refcount-1 cache-only pages."""
+    n = len(tokens) // cache.page_size
+    pages = alloc.alloc(n, owner="req")
+    for i, p in enumerate(pages):
+        dev.content[p] = f"block{i}:{tokens[:4]}".encode()
+    cache.insert(np.asarray(tokens), pages)
+    alloc.free(pages, owner="req")
+    return pages
+
+
+def test_evict_spills_and_reload_restores():
+    alloc, dev, cache = _cache()
+    tokens = list(range(12))  # 3 blocks of 4
+    pages = _seed_entry(alloc, dev, cache, tokens)
+    # Snapshot the exact device bytes each block held BEFORE the spill
+    # (dev.fetch pads to the fixed blob size — compare like for like).
+    before = [dev.fetch(p)[0] for p in pages]
+    assert cache.pages == 3 and cache.spilled_pages == 0
+    freed = cache.evict(3)
+    assert freed == 3
+    assert cache.pages == 0 and cache.spilled_pages == 3
+    assert cache.host_bytes > 0
+    assert alloc.num_free == alloc.num_pages  # device pages returned
+    for p in pages:
+        del dev.content[p]  # a freed page's bytes are up for grabs
+    # Tiered lookup: device prefix empty, host continuation is all 3.
+    matched, dev_pages, host_nodes = cache.lookup_tiered(
+        np.asarray(tokens)
+    )
+    assert matched == 0 and dev_pages == [] and len(host_nodes) == 3
+    reloaded = cache.reload(np.asarray(tokens), host_nodes)
+    assert len(reloaded) == 3
+    assert cache.pages == 3 and cache.spilled_pages == 0
+    # Contents restored VERBATIM onto the fresh pages: block i's
+    # reloaded bytes equal the bytes it held before the spill.
+    for i, p in enumerate(reloaded):
+        assert dev.content[p] == before[i]
+        assert alloc.refcount(p) == 1  # the cache's own reference
+    # The reloaded entry is a normal device hit now.
+    matched, dev_pages, host_nodes = cache.lookup_tiered(
+        np.asarray(tokens)
+    )
+    assert matched == 12 and dev_pages == reloaded and not host_nodes
+    alloc.check_invariant([cache.held_pages()])
+
+
+def test_prereload_evict_never_takes_the_matched_prefix():
+    """The evict-before-share window: at reload time the matched
+    device prefix is still refcount-1 (nothing shared yet), so an
+    unexcluded eviction round could free — and a reload immediately
+    overwrite — the very pages the splice is about to share. The
+    `exclude` parameter closes it; this pins both halves."""
+    alloc, dev, cache = _cache(num_pages=3, page_size=4)
+    # Entry A: 2 device-resident blocks (the "matched prefix").
+    a_tokens = list(range(8))
+    a_pages = _seed_entry(alloc, dev, cache, a_tokens)
+    # Entry B: 1 block, older LRU... make A the ONLY evictable pages
+    # by spilling B first.
+    b_tokens = list(range(100, 104))
+    _seed_entry(alloc, dev, cache, b_tokens)
+    cache.evict(1, exclude=a_pages)  # spills B, A untouched
+    assert cache.spilled_pages == 1 and cache.pages == 2
+    # Free list now holds 1 page; an excluded evict round asked to
+    # free MORE must leave A alone and come up short.
+    freed = cache.evict(2, exclude=a_pages)
+    assert freed == 0
+    m, pages, _ = cache.lookup_tiered(np.asarray(a_tokens))
+    assert m == 8 and pages == a_pages  # the match survived
+    # ...while an unexcluded call would have taken them (the window).
+    assert cache.evictable_pages(exclude=a_pages) == 0
+    alloc.check_invariant([cache.held_pages()])
+
+
+def test_failed_upload_degrades_to_shorter_match():
+    alloc, dev, cache = _cache()
+    tokens = list(range(12))
+    _seed_entry(alloc, dev, cache, tokens)
+    cache.evict(3)
+    dev.fail_uploads = 1  # first reload attempt dies
+    _, _, host_nodes = cache.lookup_tiered(np.asarray(tokens))
+    reloaded = cache.reload(np.asarray(tokens), host_nodes)
+    assert reloaded == []  # stopped at the first failure
+    # Nothing leaked: the page allocated for the failed upload went
+    # back, and the spilled entries survive for the next attempt.
+    assert alloc.num_free == alloc.num_pages
+    assert cache.spilled_pages == 3
+    # Next attempt (fault cleared) succeeds.
+    _, _, host_nodes = cache.lookup_tiered(np.asarray(tokens))
+    assert len(cache.reload(np.asarray(tokens), host_nodes)) == 3
+    alloc.check_invariant([cache.held_pages()])
+
+
+def test_injected_fault_point_fires_in_reload():
+    alloc, dev, cache = _cache()
+    tokens = list(range(8))
+    _seed_entry(alloc, dev, cache, tokens)
+    cache.evict(2)
+    faults.configure("host_spill_upload:times=1")
+    _, _, host_nodes = cache.lookup_tiered(np.asarray(tokens))
+    assert cache.reload(np.asarray(tokens), host_nodes) == []
+    assert faults.injected_count("host_spill_upload") == 1
+    assert alloc.num_free == alloc.num_pages
+    alloc.check_invariant([cache.held_pages()])
+
+
+def test_host_budget_drops_lru():
+    # 20-byte blobs, budget 44: fits exactly two — the third spill
+    # drops the least-recently-used host entry.
+    alloc, dev, cache = _cache(budget=44)
+    for base in (0, 100, 200):
+        tokens = list(range(base, base + 4))
+        _seed_entry(alloc, dev, cache, tokens)
+    cache.evict(3)
+    assert cache.spilled_pages == 2
+    assert cache.host_bytes == 40
+
+
+def test_oversized_entry_skips_spill():
+    alloc, dev, cache = _cache(budget=4)  # smaller than any blob
+    tokens = list(range(4))
+    _seed_entry(alloc, dev, cache, tokens)
+    assert cache.evict(1) == 1  # still frees the device page
+    assert cache.spilled_pages == 0 and cache.host_bytes == 0
+
+
+def test_clear_drops_host_tier():
+    alloc, dev, cache = _cache()
+    _seed_entry(alloc, dev, cache, list(range(8)))
+    cache.evict(2)
+    assert cache.spilled_pages == 2
+    cache.clear()
+    assert cache.spilled_pages == 0 and cache.host_bytes == 0
+    assert cache.pages == 0
+
+
+def test_reinsert_forgets_stale_host_twin():
+    """A block recomputed cold after a spill (e.g. a failed reload)
+    re-donates; its host twin is then a stale duplicate and must be
+    dropped so the budget holds live spill value only."""
+    alloc, dev, cache = _cache()
+    tokens = list(range(8))
+    _seed_entry(alloc, dev, cache, tokens)
+    cache.evict(2)
+    assert cache.spilled_pages == 2
+    _seed_entry(alloc, dev, cache, tokens)  # cold recompute donated
+    assert cache.pages == 2
+    assert cache.spilled_pages == 0 and cache.host_bytes == 0
+
+
+def test_spill_disabled_without_budget():
+    alloc = paged_kv.PageAllocator(8, 4)
+    cache = PagedPrefixCache(alloc)
+    assert not cache.spill_enabled
+    dev = ToyDevice(alloc)
+    pages = alloc.alloc(1, owner="req")
+    dev.content[pages[0]] = b"x"
+    cache.insert(np.asarray(range(4)), pages)
+    alloc.free(pages, owner="req")
+    cache.evict(1)
+    assert cache.spilled_pages == 0  # plain eviction, entry died
+    m, p, h = cache.lookup_tiered(np.asarray(range(4)))
+    assert (m, p, h) == (0, [], [])
+
+
+# ---------------------------------------------------------------------------
+# Engine-level closed loop
+# ---------------------------------------------------------------------------
+
+
+def _boot(pipe, **kw):
+    return ContinuousScheduler(
+        pipe, num_slots=2, page_size=8, chunk=4, max_ctx=256,
+        prefill_chunk=16, host_cache_bytes=1 << 24, **kw,
+    )
+
+
+def _ask(sched, text, n=6):
+    h = sched.submit({"question": text}, n, {"temperature": 0.0})
+    return h.result(timeout=180)
+
+
+def _quiesce(sched, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(r is None for r in sched.slots) and sched.queue_len() == 0:
+            return
+        time.sleep(0.02)
+    raise TimeoutError("engine did not quiesce")
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_spill_reload_closed_loop(pipe, kv_dtype):
+    """The acceptance loop: evict a cached prefix to host, re-send the
+    prompt — the reply is byte-identical to the cold run, the reload
+    hit counter increments, and the cost ledger shows the suffix-only
+    prefill (cached_tokens covers the reloaded prefix)."""
+    sched = _boot(pipe, kv_dtype=kv_dtype)
+    try:
+        prompt = "spill tier closed loop prompt " * 4
+        cold = _ask(sched, prompt)
+        _quiesce(sched)
+        cache = sched.prefix_cache
+        assert cache.pages > 0
+        cache.evict(cache.evictable_pages())
+        assert cache.spilled_pages > 0 and cache.pages == 0
+        reg = sched.metrics.registry
+        h0 = reg.get("oryx_cache_reload_hit_total", raw_name=True)
+        warm = _ask(sched, prompt)
+        assert warm[0] == cold[0]
+        h1 = reg.get("oryx_cache_reload_hit_total", raw_name=True)
+        up = reg.get("oryx_cache_reload_upload_total", raw_name=True)
+        assert h1 == h0 + 1 and up > 0
+        # Suffix-only prefill: the warm request's ledger carries the
+        # reloaded prefix as cached tokens.
+        ev = sched.request_log.snapshot(1)[0]
+        assert ev["status"] == "ok" and ev["cached_tokens"] > 0
+        _quiesce(sched)
+        sched._check_pool_invariant()
+    finally:
+        sched.close()
+
+
+def test_failed_reload_degrades_to_cold_recompute(pipe):
+    """Chaos contract at engine level: an injected re-upload failure
+    on the re-sent prompt yields the byte-identical reply through a
+    cold recompute — never an error — with the pool invariant and
+    zero leaks after the incident."""
+    sched = _boot(pipe, kv_dtype="int8")
+    try:
+        prompt = "degraded reload prompt " * 4
+        cold = _ask(sched, prompt)
+        _quiesce(sched)
+        cache = sched.prefix_cache
+        cache.evict(cache.evictable_pages())
+        assert cache.spilled_pages > 0
+        faults.configure("host_spill_upload:times=1")
+        warm = _ask(sched, prompt)
+        assert warm[0] == cold[0]
+        assert faults.injected_count("host_spill_upload") == 1
+        ev = sched.request_log.snapshot(1)[0]
+        assert ev["status"] == "ok"
+        _quiesce(sched)
+        sched._check_pool_invariant()
+        # Zero leaks: free + cache-held covers the pool.
+        held = len(sched.prefix_cache.held_pages())
+        assert sched.allocator.num_free + held == sched.num_pages
+        # Recovered: a third send splices normally again.
+        third = _ask(sched, prompt)
+        assert third[0] == cold[0]
+    finally:
+        sched.close()
+
+
+def test_cache_shed_clears_host_tier(pipe):
+    """Degraded-mode cache shedding (mode >= 1 calls clear()) must
+    free the host RAM too, not just the device references."""
+    sched = _boot(pipe)
+    try:
+        _ask(sched, "shed me " * 6)
+        _quiesce(sched)
+        cache = sched.prefix_cache
+        cache.evict(cache.evictable_pages())
+        assert cache.spilled_pages > 0
+        cache.clear()
+        assert cache.spilled_pages == 0 and cache.host_bytes == 0
+    finally:
+        sched.close()
