@@ -32,12 +32,13 @@ looks like a registry (`...registry.info` / `reg.info`) so ordinary
 
 Wide-event schema (PR 12, extended PR 14): the same rule also checks
 every wide-event builder call site (utils/request_log.py:
-``build_request_event`` / ``build_oom_event`` / ``build_audit_event``)
-— each literal keyword field must be snake_case AND drawn from that
-builder's declared registry in utils/metrics.py
-(``REQUEST_EVENT_KEYS`` — a superset of ``REQUEST_COST_KEYS`` —
-``OOM_EVENT_KEYS``, ``AUDIT_EVENT_KEYS``; the builder->registry table
-is ``_EVENT_BUILDERS``). The registries are read from the canonical
+``build_request_event`` / ``build_oom_event`` / ``build_audit_event``;
+serve/journal.py: ``build_journal_event``) — each literal keyword
+field must be snake_case AND drawn from that builder's declared
+registry in utils/metrics.py (``REQUEST_EVENT_KEYS`` — a superset of
+``REQUEST_COST_KEYS`` — ``OOM_EVENT_KEYS``, ``AUDIT_EVENT_KEYS``,
+``JOURNAL_EVENT_KEYS``; the builder->registry table is
+``_EVENT_BUILDERS``). The registries are read from the canonical
 metrics module's AST (never imported — metrics.py imports jax), so
 the check works in single-file fixture runs too. A ``**splat`` passes
 statically (runtime validation in the builders covers it); a literal
@@ -78,6 +79,7 @@ _EVENT_BUILDERS = {
     "build_request_event": "REQUEST_EVENT_KEYS",
     "build_oom_event": "OOM_EVENT_KEYS",
     "build_audit_event": "AUDIT_EVENT_KEYS",
+    "build_journal_event": "JOURNAL_EVENT_KEYS",
 }
 _EVENT_KEYS_CACHE: tuple[dict[str, frozenset[str]] | None, bool] = (
     None, False,
@@ -267,7 +269,8 @@ class MetricNameChecker(Checker):
         self, mod: ParsedModule, call: ast.Call, builder: str
     ) -> Iterator[Finding | None]:
         """Literal keyword fields of a wide-event builder call
-        (build_request_event / build_oom_event / build_audit_event)
+        (build_request_event / build_oom_event / build_audit_event /
+        build_journal_event)
         must be snake_case members of that builder's declared schema
         registry. `**splat` fields pass here (the builders re-validate
         at runtime); the defining module itself (utils/request_log.py,

@@ -106,6 +106,7 @@ import numpy as np
 
 from oryx_tpu.analysis import sanitizers
 from oryx_tpu.analysis.sanitizers import named_lock
+from oryx_tpu.serve import journal as journal_lib
 from oryx_tpu.utils import faults
 from oryx_tpu.utils import trace as trace_lib
 
@@ -619,6 +620,8 @@ def build_server(
     replica_id: str | None = None,
     requests_log_path: str | None = None,
     requests_log_max_bytes: int = 16 * 1024 * 1024,
+    journal_path: str | None = None,
+    journal_max_bytes: int = 64 * 1024 * 1024,
 ) -> ThreadingHTTPServer:
     """Construct (not start) the HTTP server around a pipeline.
 
@@ -711,6 +714,14 @@ def build_server(
             "--kv-dtype/--host-cache-bytes require a scheduler engine "
             "(the window batcher has no paged KV pool or prefix cache)"
         )
+    if engine == "window" and journal_path:
+        # Same fail-fast contract: the decision journal records the
+        # scheduler's decision stream — arming it on the window
+        # batcher would write a header and nothing else.
+        raise ValueError(
+            "--journal requires a scheduler engine (the window "
+            "batcher has no decision stream to record)"
+        )
     # $ORYX_LOCK_SANITIZER=1 arms the lock-order sanitizer + race
     # detector for this server (chaos/test runs). Armed BEFORE the
     # metrics registry and scheduler are built so every named lock
@@ -772,6 +783,22 @@ def build_server(
         request_log = RequestLog(
             requests_log_path, max_bytes=requests_log_max_bytes
         )
+        # Decision journal (serve/journal.py): the engine flight
+        # recorder scripts/replay_journal.py replays offline. The
+        # server stamps the workload-level identity here; the
+        # scheduler stamps its effective geometry and seals the
+        # header. None when --journal was not given — every
+        # instrumentation site in the scheduler then costs one
+        # attribute check.
+        journal = None
+        if journal_path:
+            journal = journal_lib.DecisionJournal(
+                journal_path, max_bytes=journal_max_bytes
+            )
+            journal.stamp_header(
+                model=model_name, faults_spec=faults_spec or None,
+                max_tokens_limit=max_tokens_limit,
+            )
         # Engine registry (serve/engine.py): "continuous", "sharded",
         # and whatever later shapes register — all drop-in behind this
         # server and the supervisor through the Engine protocol.
@@ -790,7 +817,7 @@ def build_server(
             max_queue=max_queue, request_timeout=request_timeout,
             degraded_cooldown=degraded_cooldown,
             request_log=request_log, engine_label=engine,
-            replica_id=replica_id,
+            replica_id=replica_id, journal=journal,
         )
         if supervise:
             supervisor = EngineSupervisor(scheduler)
@@ -1018,6 +1045,20 @@ def build_server(
                     unavailable="output audits require a scheduler "
                     "engine (the window batcher has no paged replay "
                     "path)",
+                )
+            elif self.path.split("?", 1)[0] == "/debug/journal":
+                # Decision journal (serve/journal.py): the engine
+                # flight recorder's bounded ring — header + newest-
+                # first entries + per-kind counts. Disarmed replicas
+                # serve the same body shape with armed=false.
+                self._ring_debug(
+                    lambda: (
+                        scheduler.journal or journal_lib.DISARMED
+                    ),
+                    default_n=64,
+                    unavailable="the decision journal requires a "
+                    "scheduler engine (the window batcher has no "
+                    "decision stream to record)",
                 )
             elif self.path.split("?", 1)[0] == "/debug/profile":
                 # On-demand device-time capture: bracket the next
@@ -1492,6 +1533,7 @@ def build_server(
     srv.timeline = scheduler.timeline if scheduler is not None else None
     srv.forensics = scheduler.forensics if scheduler is not None else None
     srv.auditor = scheduler.auditor if scheduler is not None else None
+    srv.journal = scheduler.journal if scheduler is not None else None
 
     def begin_drain() -> None:
         """Drain-on-shutdown, step 1: /readyz flips 503 NOW (router
@@ -1683,6 +1725,15 @@ def main(argv: list[str] | None = None) -> None:
         "/debug/requests?format=jsonl is always on",
     )
     ap.add_argument(
+        "--journal", default=None, metavar="PATH",
+        help="continuous engine: arm the decision journal — append one "
+        "JSONL entry per engine dispatch and scheduling decision here "
+        "(size-capped, rolls to PATH.1, header re-written per "
+        "generation; schema utils.metrics.JOURNAL_EVENT_KEYS). "
+        "scripts/replay_journal.py replays the file offline "
+        "byte-for-byte; GET /debug/journal serves the in-memory ring",
+    )
+    ap.add_argument(
         "--max-queue", type=int, default=256,
         help="continuous engine: bound on the admission queue; beyond "
         "it new requests get 429 + Retry-After instead of unbounded "
@@ -1785,6 +1836,7 @@ def main(argv: list[str] | None = None) -> None:
         faults_spec=args.faults or os.environ.get("ORYX_FAULTS"),
         replica_id=args.replica_id,
         requests_log_path=args.requests_log,
+        journal_path=args.journal,
     )
 
     def _drain_and_exit() -> None:

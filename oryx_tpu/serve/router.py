@@ -551,6 +551,11 @@ def build_router(
                 # The fleet's output-audit rings, keyed by replica —
                 # same degrade-to-error-entry merge contract.
                 self._merged_replica_json("/debug/audit", query)
+            elif path == "/debug/journal":
+                # The fleet's decision-journal rings, keyed by replica
+                # (disarmed replicas answer armed=false bodies) — same
+                # degrade-to-error-entry merge contract.
+                self._merged_replica_json("/debug/journal", query)
             elif path == "/debug/profile":
                 self._proxy_profile(query)
             elif path == "/debug/trace":

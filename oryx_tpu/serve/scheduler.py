@@ -114,6 +114,7 @@ Failure containment (docs/DESIGN.md "Failure containment"):
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import queue
 import threading
@@ -135,6 +136,7 @@ from oryx_tpu.models import oryx, qwen2
 from oryx_tpu.ops import paged_kv
 from oryx_tpu.ops.packing import round_up_bucket
 from oryx_tpu.serve import audit as audit_lib
+from oryx_tpu.serve import journal as journal_lib
 from oryx_tpu.serve import pipeline as pipeline_lib
 from oryx_tpu.serve.prefix_cache import PagedPrefixCache
 from oryx_tpu.utils import faults
@@ -261,6 +263,10 @@ class _Request:
     processed: int = 0  # tokens consumed from the device stream
     replay: int = 0  # tokens to skip after an eviction re-admission
     admit_seq: int = -1  # admission order (eviction picks the youngest)
+    # Seq of this request's journal `submit` entry (None = journal
+    # disarmed): the join key between the wide event / trace meta and
+    # the decision journal (serve/journal.py).
+    journal_seq: int | None = None
     # Replay re-admissions this request paid (eviction + supervisor
     # restart), surfaced in its wide event — the per-request spelling
     # of the fleet's eviction pressure.
@@ -351,6 +357,7 @@ class ContinuousScheduler:
         host_cache_bytes: int = 0,
         audit_tol_maxdiff: float | None = None,
         audit_tol_kl: float | None = None,
+        journal: journal_lib.DecisionJournal | None = None,
     ):
         # Pool-geometry validation up front: a bad flag should be one
         # actionable ValueError at construction, never a mid-decode
@@ -670,6 +677,40 @@ class ContinuousScheduler:
         self.request_log = request_log or request_log_lib.RequestLog()
         self.engine_label = engine_label
         self.replica_id = replica_id
+        # Decision journal (serve/journal.py): the deterministic flight
+        # recorder. None = disarmed, and every instrumentation site is
+        # a single attribute check (the observe-never-perturb contract
+        # check_tier1.sh gates byte-for-byte). The scheduler stamps its
+        # EFFECTIVE geometry — num_pages resolved, clamp knobs — so
+        # scripts/replay_journal.py can rebuild this exact scheduler
+        # cold from the header alone.
+        self.journal = journal
+        # Dispatch counter gating journal entries and replay feeding:
+        # unlike chunks_run (decode chunks only), this advances at
+        # EVERY recorded dispatch, so split-mode prefill-only
+        # iterations can't alias two loop turns onto one gate value.
+        self.steps_run = 0  # thread-owned: engine
+        # Replay feeding hook (scripts/replay_journal.py): called at
+        # the top of every engine-loop iteration; None in live serving.
+        self.replay_feeder = None  # thread-owned: engine
+        if self.journal is not None:
+            self.journal.stamp_header(
+                num_slots=num_slots, page_size=page_size, chunk=chunk,
+                max_ctx=max_ctx, num_pages=self.num_pages, seed=seed,
+                prefill_chunk=prefill_chunk,
+                prefix_cache=bool(prefix_cache),
+                ragged=self.ragged, speculate=self.speculate,
+                kv_dtype=kv_dtype, host_cache_bytes=host_cache_bytes,
+                max_queue=max_queue,
+                degraded_clamp_tokens=degraded_clamp_tokens,
+                engine=engine_label, replica=replica_id,
+            )
+            self.journal.seal_header()
+            # Fault firings reach the journal through the module-level
+            # observer hook (utils/faults.py) — the seeded schedule
+            # makes the (site, count) stream reproducible, which is
+            # what lets replay assert fault-for-fault equality.
+            faults.add_observer(self._journal_fault)
         # Output auditor (serve/audit.py): shadow-parity replays of
         # every Nth finished request, run on THIS thread at idle
         # points only. Constructed unconditionally so the oryx_audit_*
@@ -926,6 +967,14 @@ class ContinuousScheduler:
             routed=routed,
         )
         req.qw_span = tr.begin("queue_wait")
+        if self.journal is not None:
+            # Journal the arrival BEFORE the admission-control verdict:
+            # the submit entry is the replayable workload record
+            # (arrival order + payload + requested knobs), whatever
+            # happens to the request next. journal_seq joins the wide
+            # event / /debug/requests meta back to this entry.
+            req.journal_seq = self._journal_submit(req)
+            tr.annotate(journal_seq=req.journal_seq)
         with self._cond:
             # Admission-control checks and the append are one atomic
             # section: two racing submits can never both squeeze into
@@ -969,6 +1018,14 @@ class ContinuousScheduler:
             self.metrics.inc(
                 "admission_rejected_total", labels={"reason": reason}
             )
+            if self.journal is not None:
+                # Excluded from replay comparison by contract
+                # (REPLAYED_KINDS): admission control is load/timing-
+                # coupled, so a replayed run legitimately admits what
+                # the live run shed.
+                self.journal.append(journal_lib.build_journal_event(
+                    kind="reject", request_id=tr.id, reason=reason,
+                ))
             cost = self._finalize_cost(None, req, observe=False)
             tr.finish(error=msg, rejected=reason, cost=cost)
             self._emit_request_event(
@@ -991,6 +1048,11 @@ class ContinuousScheduler:
             self._thread.join(timeout=30)
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.journal is not None:
+            # Detach the process-global fault observer (the journal
+            # itself is closed by its owner — build_server, or the
+            # replay harness).
+            faults.remove_observer(self._journal_fault)
 
     def begin_drain(self) -> None:
         """Start drain-on-shutdown: admission stops NOW (new submits
@@ -1114,6 +1176,14 @@ class ContinuousScheduler:
         self._reset_pool()
         self.restarts += 1
         self.metrics.inc("engine_restarts_total")
+        if self.journal is not None:
+            # Supervisor thread, engine dead: steps_run is quiescent
+            # and safe to read here — the restart's position in the
+            # step stream is exactly what replay reproduces.
+            self.journal.append(journal_lib.build_journal_event(
+                kind="restart", step=self.steps_run,
+                restarts=self.restarts, requeued=len(live),
+            ))
         _LOG.warning(
             "engine thread restarted (#%d): %d request(s) requeued "
             "for replay", self.restarts, len(live),
@@ -1446,8 +1516,78 @@ class ContinuousScheduler:
             streaming=h.streaming,
             evictions=req.evictions,
             accepted_tokens_per_step=aps,
+            journal_seq=req.journal_seq,
             **cost,
         ))
+        if self.journal is not None and status != "rejected":
+            # Terminal journal entry (submit-time rejections already
+            # wrote their own `reject` entry — a finish here would leak
+            # a timing-coupled decision into the replayed stream). The
+            # reply fingerprints are THE byte-exactness oracle replay
+            # asserts against; the cost subset is the deterministic
+            # half of the ledger (journal_lib.DETERMINISTIC_COST_KEYS).
+            self.journal.append(journal_lib.build_journal_event(
+                kind="finish",
+                step=self._journal_step(),
+                request_id=req.trace.id,
+                status=status,
+                finish_reason=h.finish_reason if status == "ok" else None,
+                error_kind=error_kind,
+                completion_tokens=len(req.emitted),
+                reply_sha256=journal_lib.fingerprint_text(req.text_done),
+                tokens_sha256=journal_lib.fingerprint_tokens(req.emitted),
+                cost={
+                    k: cost.get(k, 0)
+                    for k in journal_lib.DETERMINISTIC_COST_KEYS
+                },
+            ))
+
+    # ---- decision journal (serve/journal.py) -----------------------------
+
+    def _journal_step(self) -> int | None:
+        """`steps_run` when journaling FROM the engine thread, else
+        None: the counter is engine-thread-owned, and entries written
+        from HTTP/supervisor threads (submit rejections, fail_inflight,
+        off-engine fault sites) are timing-coupled anyway — replay
+        feeds on the step gates of engine-thread entries only."""
+        if threading.current_thread() is self._thread:
+            return self.steps_run
+        return None
+
+    def _journal_submit(self, req: _Request) -> int:
+        """One `submit` entry: the replayable workload record. A
+        JSON-serializable request dict (every HTTP request is one) is
+        journaled VERBATIM as the payload; anything else — e.g. raw
+        array embeds handed to submit() programmatically — journals a
+        fingerprint only and is flagged unreplayable by its absence."""
+        try:
+            canon = json.dumps(req.request, sort_keys=True)
+        except (TypeError, ValueError):
+            prompt = None
+            sha = journal_lib.fingerprint_text(repr(req.request))
+        else:
+            prompt = req.request
+            sha = journal_lib.fingerprint_text(canon)
+        return self.journal.append(journal_lib.build_journal_event(
+            kind="submit",
+            request_id=req.trace.id,
+            arrival_seq=self.journal.next_arrival(),
+            prompt=prompt,
+            prompt_sha256=sha,
+            sampling=req.sampling,
+            max_new=req.max_new,
+            streaming=req.handle.streaming,
+        ))
+
+    def _journal_fault(self, site: str, fired: int) -> None:
+        """utils/faults.py observer hook: one entry per fault-point
+        firing, any thread (the journal lock is a leaf). Registered at
+        construction when the journal is armed, detached in close()."""
+        if self.journal is not None:
+            self.journal.append(journal_lib.build_journal_event(
+                kind="fault", step=self._journal_step(),
+                site=site, fires=fired,
+            ))
 
     @staticmethod
     def _owner_tag(req: _Request | None) -> str | None:
@@ -1543,6 +1683,13 @@ class ContinuousScheduler:
 
     def _run(self) -> None:
         while True:
+            if self.replay_feeder is not None:
+                # Offline replay (scripts/replay_journal.py): feed the
+                # journaled admission stream at its recorded step gates
+                # before this iteration examines the queue. Live
+                # serving never sets the hook — the branch costs one
+                # attribute check.
+                self.replay_feeder(self)
             drain_drop: list[_Request] = []
             with self._cond:
                 if self._shutdown:
@@ -1779,6 +1926,13 @@ class ContinuousScheduler:
             ["normal", "prefix cache shed", "max_tokens clamped",
              "shedding load"][mode],
         )
+        if self.journal is not None:
+            # Journaled, NOT replayed (REPLAYED_KINDS): the ladder is
+            # wall-clock-driven; its decision effect is the clamped
+            # max_new the admit entries carry.
+            self.journal.append(journal_lib.build_journal_event(
+                kind="degraded", step=self._journal_step(), mode=mode,
+            ))
         if mode > prev:
             # An escalation is a capacity incident in progress: capture
             # the same forensic record an OOM gets, while the pressure
@@ -1799,6 +1953,15 @@ class ContinuousScheduler:
     def _admit(self) -> None:
         gen = self.cfg.generation
         while True:
+            if self.replay_feeder is not None:
+                # Replay feeding re-checks its step gates HERE as well
+                # as at the loop top: an unchunked prefill dispatches
+                # inside this while (advancing steps_run mid-
+                # iteration), and the live run may have admitted the
+                # next queued request immediately after it — the
+                # feeder must be able to inject that request between
+                # two admissions, not one engine iteration later.
+                self.replay_feeder(self)
             if any(r is not None and not r.activated for r in self.slots):
                 # A chunked prefill is in flight: the engine-step budget
                 # for prompt work is ONE prefill chunk, so no further
@@ -1972,6 +2135,8 @@ class ContinuousScheduler:
         # so a False return leaves the integral untouched).
         req.pages_t = time.monotonic()
         spliced = 0
+        cow_pages = 0
+        host_reloaded = 0
         matched, pages, host_nodes = 0, [], []
         cache_on = (
             self.prefix_cache is not None
@@ -2038,6 +2203,7 @@ class ContinuousScheduler:
                     req.cache_tokens, host_nodes[:n_host]
                 )
                 if reloaded:
+                    host_reloaded = len(reloaded)
                     pages = pages + reloaded
                     matched = len(pages) * ps
                     use = min(matched, limit)
@@ -2069,6 +2235,7 @@ class ContinuousScheduler:
                         jnp.asarray(cow, jnp.int32),
                     )
                     self.bt[s, full] = cow
+                    cow_pages = 1
             spliced = use
         req.spliced = spliced
         req.prefill_pos = spliced
@@ -2082,6 +2249,18 @@ class ContinuousScheduler:
             "prefix_cache_miss_tokens_total", req.length - spliced
         )
         req.cost_cached_tokens += spliced
+        if self.journal is not None and (spliced or host_reloaded):
+            # Cache-hit decision record (misses are implied by an admit
+            # entry with spliced_tokens=0 — journaling every miss would
+            # double the stream for no replay signal).
+            self.journal.append(journal_lib.build_journal_event(
+                kind="splice", step=self.steps_run,
+                request_id=req.trace.id, slot=s,
+                spliced_tokens=spliced,
+                shared_pages=full,
+                cow_pages=cow_pages,
+                host_reload_pages=host_reloaded,
+            ))
         return True
 
     def _place(self, s: int, req: _Request) -> None:
@@ -2126,6 +2305,18 @@ class ContinuousScheduler:
         # Eviction ordering needs an age the moment pages are held.
         req.admit_seq = self._admit_seq
         self._admit_seq += 1
+        if self.journal is not None:
+            # max_new here is the EFFECTIVE budget (degraded clamp
+            # already applied at the queue head): replay re-submits
+            # with this value, so the wall-clock-driven ladder never
+            # has to replay — its decision effect is captured here.
+            self.journal.append(journal_lib.build_journal_event(
+                kind="admit", step=self.steps_run,
+                request_id=req.trace.id, slot=s,
+                admit_seq=req.admit_seq, prompt_len=req.length,
+                max_new=req.max_new, replay_tokens=req.replay,
+                spliced_tokens=req.spliced,
+            ))
         _LOG.info(
             "request %s %s slot=%d prompt=%d cached=%d", req.trace.id,
             "re-admitted" if req.replay else "admitted", s, req.length,
@@ -2355,6 +2546,13 @@ class ContinuousScheduler:
         self._clear_slot(s)
         req.trace.event("evicted", slot=s, replay_tokens=req.processed)
         req.qw_span = req.trace.begin("queue_wait", requeued=True)
+        if self.journal is not None:
+            self.journal.append(journal_lib.build_journal_event(
+                kind="evict", step=self.steps_run, slot=s,
+                victim_request_id=req.trace.id,
+                admit_seq=req.admit_seq,
+                replay_tokens=req.processed,
+            ))
         _LOG.info(
             "request %s evicted from slot %d (replay %d tokens)",
             req.trace.id, s, req.processed,
@@ -2618,17 +2816,30 @@ class ContinuousScheduler:
         degraded-mode reads go through the metrics registry's own
         gauges, so the hot path never takes the scheduler lock for a
         telemetry sample."""
+        live = sum(
+            1 for r in self.slots if r is not None and r.activated
+        )
         self.timeline.record(
             dur_s=dur_s, kind=kind, rows=rows,
-            live_slots=sum(
-                1 for r in self.slots if r is not None and r.activated
-            ),
+            live_slots=live,
             accepted_tokens=accepted,
             queue_depth=int(self.metrics.get("queue_depth")),
             free_pages=self.allocator.num_free,
             degraded_mode=int(self.metrics.get("degraded_mode")),
             device_us=device_us,
         )
+        # The journal's step clock: EVERY recorded dispatch advances it
+        # (prefill, decode, ragged, spec), so steps_run is the count of
+        # dispatches completed — the gate replay feeds admissions on.
+        self.steps_run += 1
+        if self.journal is not None:
+            # Deliberately no dur_s/device_us/queue_depth: the journal
+            # records only what replays deterministically.
+            self.journal.append(journal_lib.build_journal_event(
+                kind="step", step=self.steps_run, dispatch=kind,
+                rows=rows, live_slots=live, accepted_tokens=accepted,
+                free_pages=self.allocator.num_free,
+            ))
 
     # hot-path
     def _harvest_chunk(self, tok, lengths, finished, recent, toks, fin):

@@ -68,6 +68,7 @@ from collections import deque
 from typing import Any
 
 from oryx_tpu.analysis.sanitizers import named_lock
+from oryx_tpu.utils.rolling_sink import RollingSink
 
 _LOG = logging.getLogger("oryx.anomaly")
 
@@ -183,10 +184,11 @@ class AnomalyMonitor:
         self.counts: dict[str, int] = {}
         self.total = 0
         self._lock = named_lock("anomaly._lock")
-        self._f = None
+        self._sink = None
         if self.events_path:
-            os.makedirs(os.path.dirname(self.events_path), exist_ok=True)
-            self._f = open(self.events_path, "a")
+            self._sink = RollingSink(
+                self.events_path, max_bytes=events_max_bytes
+            )
         # The shared cross-registry family: oryx_anomaly_total{kind=}.
         # raw_name — deliberately NOT prefixed, so the train and serve
         # exporters publish the same series name and one Prometheus
@@ -221,20 +223,11 @@ class AnomalyMonitor:
             self.recent.append(ev)
             self.counts[kind] = self.counts.get(kind, 0) + 1
             self.total += 1
-            if self._f is not None:
-                self._f.write(json.dumps(ev.to_dict()) + "\n")
-                self._f.flush()
-                if (
-                    self.events_max_bytes
-                    and self._f.tell() >= self.events_max_bytes
-                ):
-                    # Rotate AFTER the write that crossed the cap: the
-                    # live file is always a complete JSONL (never a
-                    # torn line), and the event that triggered the roll
-                    # lands in `.1` with its episode-mates.
-                    self._f.close()
-                    os.replace(self.events_path, self.events_path + ".1")
-                    self._f = open(self.events_path, "a")
+            if self._sink is not None:
+                # Rotation contract (rotate AFTER the crossing write,
+                # one `.1` generation) lives in utils/rolling_sink.py,
+                # shared with the request-log and journal sinks.
+                self._sink.write(json.dumps(ev.to_dict()))
         if self._counter is not None:
             self._counter.labels(kind=kind).inc()
         _LOG.warning("anomaly[%s] %s: %s", self.source, kind, message)
@@ -480,6 +473,6 @@ class AnomalyMonitor:
 
     def close(self) -> None:
         with self._lock:
-            if self._f is not None:
-                self._f.close()
-                self._f = None
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None

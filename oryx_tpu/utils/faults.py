@@ -163,6 +163,12 @@ _LOCK = threading.Lock()
 _SITES: dict[str, _Site] | None = None
 _ARMED = False
 _REGISTRIES: list = []  # bound metric registries (weakly-owned)
+# Firing observers: fn(site, fired_count) called OUTSIDE the module
+# lock on every firing. The decision journal (serve/journal.py) records
+# fault firings through this hook; the seeded schedule makes the stream
+# of (site, count) pairs reproducible run-to-run, which is what lets
+# the replay harness assert fault-for-fault equality.
+_OBSERVERS: list = []
 
 
 def configure(spec: str | None) -> None:
@@ -210,6 +216,7 @@ def reset() -> None:
         _SITES = None
         _ARMED = False
         _REGISTRIES.clear()
+        _OBSERVERS.clear()
 
 
 def armed() -> bool:
@@ -228,6 +235,21 @@ def bind_registry(registry) -> None:
                 "oryx_faults_injected_total", ("site",), raw_name=True
             )
             _REGISTRIES.append(registry)
+
+
+def add_observer(fn) -> None:
+    """Register `fn(site, fired_count)` to run on every firing (after
+    the counters, outside the module lock). Cleared by reset(); safe to
+    call disarmed; idempotent per observer."""
+    with _LOCK:
+        if fn not in _OBSERVERS:
+            _OBSERVERS.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _LOCK:
+        if fn in _OBSERVERS:
+            _OBSERVERS.remove(fn)
 
 
 def injected_count(site: str | None = None) -> int:
@@ -255,10 +277,14 @@ def fault_point(site: str, *, exc=None) -> bool:
             return False
         delay, corrupt = s.delay, s.corrupt
         registries = list(_REGISTRIES)
+        observers = list(_OBSERVERS)
+        fired = s.fired
     for reg in registries:
         reg.counter(
             "oryx_faults_injected_total", ("site",), raw_name=True
         ).labels(site=site).inc()
+    for fn in observers:
+        fn(site, fired)
     _LOG.warning("fault injected at %r (%s)", site,
                  "delay" if delay is not None
                  else "corrupt" if corrupt else "raise")

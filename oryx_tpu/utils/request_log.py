@@ -45,6 +45,7 @@ from oryx_tpu.utils.metrics import (
     OOM_EVENT_KEYS,
     REQUEST_EVENT_KEYS,
 )
+from oryx_tpu.utils.rolling_sink import RollingSink
 
 # The current wide-event schema version, stamped into every event so
 # offline consumers can dispatch on it when fields are added.
@@ -148,12 +149,9 @@ class RequestLog:
             maxlen=max(1, keep)
         )
         self._total = 0  # guarded-by: _lock
-        self._f = None  # guarded-by: _lock
+        self._sink = None  # guarded-by: _lock
         if self.path:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._f = open(self.path, "a")
+            self._sink = RollingSink(self.path, max_bytes=max_bytes)
 
     def append(self, event: dict[str, Any]) -> None:
         """Record one event (normally built by build_request_event /
@@ -174,17 +172,11 @@ class RequestLog:
         with self._lock:
             self._ring.append(event)
             self._total += 1
-            if self._f is not None:
-                self._f.write(line + "\n")
-                self._f.flush()
-                if self.max_bytes and self._f.tell() >= self.max_bytes:
-                    # Rotate AFTER the crossing write (the anomaly-sink
-                    # contract): the live file is always complete
-                    # JSONL, and the crossing event lands in `.1` with
-                    # its episode-mates.
-                    self._f.close()
-                    os.replace(self.path, self.path + ".1")
-                    self._f = open(self.path, "a")
+            if self._sink is not None:
+                # Rotation contract (rotate AFTER the crossing write,
+                # one `.1` generation) lives in utils/rolling_sink.py,
+                # shared with the anomaly and journal sinks.
+                self._sink.write(line)
 
     # ---- readers ---------------------------------------------------------
 
@@ -209,6 +201,6 @@ class RequestLog:
 
     def close(self) -> None:
         with self._lock:
-            if self._f is not None:
-                self._f.close()
-                self._f = None
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None

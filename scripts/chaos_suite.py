@@ -10,6 +10,13 @@ Scenarios (one armed `utils/faults.py` spec each, fully deterministic):
                           EngineSupervisor restarts with deterministic
                           replay; the client's reply is byte-identical
                           to the solo pipeline and /readyz recovers.
+  * ``journaled_crash``   the same engine-thread death with the
+                          decision journal armed (--journal): the
+                          fault firing and supervisor restart land in
+                          the journal, and scripts/replay_journal.py
+                          replays the file offline bit-for-bit —
+                          decision-for-decision equal, reply
+                          fingerprints identical.
   * ``hung_dispatch``     a decode dispatch stalls past the
                           per-request deadline — the request converts
                           into a clean 504, pages freed.
@@ -299,6 +306,62 @@ def scenario_engine_crash(h: Harness) -> None:
         if srv.metrics.get("engine_restarts_total") < 1:
             fail("[engine_crash] engine_restarts_total never moved")
         h.assert_triad(srv, base, "engine_crash", ["engine_crash"])
+    finally:
+        h.teardown(srv)
+
+
+def scenario_journaled_crash(h: Harness) -> None:
+    """The flight-recorder contract under chaos: a crash mid-burst is
+    JOURNALED (--journal armed; fault firing + supervisor restart
+    entries in the stream), and the journal file replays offline
+    bit-for-bit — fault, restart and every decision reproduced, reply
+    fingerprints identical (docs/OBSERVABILITY.md "Incident replay")."""
+    import tempfile
+
+    from oryx_tpu.serve import journal as journal_lib
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import replay_journal as rj
+
+    jpath = os.path.join(tempfile.mkdtemp(), "journal.jsonl")
+    srv, base = h.boot("engine_crash:after=3", journal_path=jpath)
+    try:
+        for i in range(3):
+            status, body, _ = h.post_chat(
+                base, f"journal me through the crash q{i}", 4 + i % 3
+            )
+            if status != 200:
+                fail(f"[journaled_crash] request {i} through the "
+                     f"crash: want 200, got {status} {body}")
+        wait_for(lambda: srv.scheduler.restarts >= 1, timeout=30,
+                 what="[journaled_crash] supervisor restart")
+        h.assert_triad(srv, base, "journaled_crash", ["engine_crash"])
+        # Quiesce the live engine, then replay the file offline.
+        if srv.supervisor is not None:
+            srv.supervisor.stop()
+        srv.scheduler.close()
+        header, entries = journal_lib.read_journal(jpath)
+        kinds = {e.get("kind") for e in entries}
+        if "fault" not in kinds or "restart" not in kinds:
+            fail(f"[journaled_crash] the crash did not journal: kinds "
+                 f"{sorted(kinds)} lack fault/restart")
+        res = rj.run_replay(header, entries, pipe=h.pipe)
+        if res["feed_errors"] or res["timed_out"] or res["gave_up"]:
+            fail(f"[journaled_crash] offline replay did not run "
+                 f"clean: feed_errors={res['feed_errors']} "
+                 f"timed_out={res['timed_out']} gave_up={res['gave_up']}")
+        div = rj.first_divergence(entries, res["entries"])
+        if div is not None:
+            fail(f"[journaled_crash] offline replay diverged from the "
+                 f"live journal: {div}")
+        matched, total, bad = rj.reply_match(entries, res["entries"])
+        if matched != total or total < 3:
+            fail(f"[journaled_crash] replayed reply fingerprints: "
+                 f"{matched}/{total} matched (divergent ids {bad})")
+        print(f"  [journaled_crash] replayed: crash + restart "
+              f"journaled ({len(entries)} entries), offline replay "
+              f"decision-for-decision equal, {matched}/{total} reply "
+              "fingerprints identical")
     finally:
         h.teardown(srv)
 
@@ -610,10 +673,11 @@ def main() -> None:
     params = oryx.init_params(cfg, jax.random.key(0))
     pipe = OryxInference(_Tokenizer(), params, cfg)
     h = Harness(pipe)
-    print("chaos suite: 7 scenarios against a live tiny server")
+    print("chaos suite: 8 scenarios against a live tiny server")
     for scenario in (
         scenario_page_alloc_oom,
         scenario_engine_crash,
+        scenario_journaled_crash,
         scenario_hung_dispatch,
         scenario_client_disconnect,
         scenario_spec_drift,

@@ -832,6 +832,150 @@ def run_audit_check() -> None:
         _shutdown_replica(plain)
 
 
+def run_journal_check() -> None:
+    """The engine flight-recorder gate (ISSUE 18): the SAME sequential
+    greedy burst against a --journal ARMED and an unarmed tiny
+    replica, gating:
+
+      * /debug/journal is well-formed and reconciled: armed=true, the
+        sealed header carries the scheduler geometry, counts_by_kind
+        sums to total, one submit and one finish entry per request
+        (the unarmed twin answers the same shape with armed=false);
+      * the journal FILE replays offline byte-exactly
+        (scripts/replay_journal.py as a library): first_divergence is
+        None over the replayed decision stream, every finish entry's
+        reply/token fingerprints match, and the deterministic cost
+        ledgers are equal — the capture -> replay contract of
+        docs/OBSERVABILITY.md "Incident replay";
+      * the journal observes, never perturbs: live-traffic reply
+        bytes AND oryx_serving_dispatches_total{kind=} are identical
+        between the armed and unarmed runs.
+    """
+    import tempfile
+
+    import jax
+
+    from oryx_tpu import config as cfg_lib
+    from oryx_tpu.models import oryx as oryx_lib
+    from oryx_tpu.serve import api_server
+    from oryx_tpu.serve import journal as journal_lib
+    from oryx_tpu.serve.pipeline import OryxInference
+
+    import replay_journal as rj
+
+    cfg = cfg_lib.oryx_tiny()
+    params = oryx_lib.init_params(cfg, jax.random.key(0))
+    pipe = OryxInference(_Tokenizer(), params, cfg)
+    jpath = os.path.join(tempfile.mkdtemp(), "journal.jsonl")
+
+    bursts = [
+        ("hello there, journal me", 6),
+        ("a different question now", 4),
+        ("hello there, journal me", 6),  # repeat: splice path journaled
+        ("one more to finish the burst", 5),
+    ]
+
+    def boot(path):
+        srv = api_server.build_server(
+            pipe, port=0, engine="continuous", num_slots=2,
+            page_size=16, decode_chunk=4, max_ctx=512, prefill_chunk=32,
+            journal_path=path,
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def drive(srv) -> tuple[list[str], dict[str, float]]:
+        base = _base_of(srv)
+        replies = []
+        for q, toks in bursts:
+            req = urllib.request.Request(
+                base + "/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [{"role": "user", "content": q}],
+                    "max_tokens": toks,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                body = json.load(r)
+            replies.append(body["choices"][0]["message"]["content"])
+        with _get(base, "/metrics") as r:
+            text = r.read().decode()
+        dispatches = {
+            m.group(1): float(m.group(2))
+            for m in re.finditer(
+                r'^oryx_serving_dispatches_total\{kind="([^"]+)"\} '
+                r"([0-9.e+-]+)$", text, re.M,
+            )
+        }
+        return replies, dispatches
+
+    armed = boot(jpath)
+    plain = boot(None)
+    try:
+        armed_replies, armed_disp = drive(armed)
+        with _get(_base_of(armed), "/debug/journal?n=512") as r:
+            ring = json.load(r)
+        if not ring.get("armed") or ring.get("path") != jpath:
+            fail(f"/debug/journal on the armed replica is not armed "
+                 f"at {jpath}: {ring.get('armed')}/{ring.get('path')}")
+        counts = ring.get("counts_by_kind") or {}
+        if sum(counts.values()) != ring.get("total"):
+            fail(f"/debug/journal counts_by_kind {counts} does not "
+                 f"sum to total {ring.get('total')}")
+        if counts.get("submit") != len(bursts) \
+                or counts.get("finish") != len(bursts):
+            fail(f"expected {len(bursts)} submit and finish entries, "
+                 f"got {counts}")
+        hdr_cfg = (ring.get("header") or {}).get("config") or {}
+        for key in ("num_slots", "page_size", "seed"):
+            if key not in hdr_cfg:
+                fail(f"journal header config is missing {key!r}: "
+                     f"{sorted(hdr_cfg)}")
+        with _get(_base_of(plain), "/debug/journal") as r:
+            off = json.load(r)
+        if off.get("armed") or off.get("total") or off.get("entries"):
+            fail(f"unarmed replica's /debug/journal is not the "
+                 f"disarmed shape: {off}")
+        # Quiesce the armed engine (close() joins the thread and
+        # detaches the journal's fault observer; the sink flushed
+        # every line already), then replay the FILE offline.
+        armed.scheduler.close()
+        header, entries = journal_lib.read_journal(jpath)
+        res = rj.run_replay(header, entries, pipe=pipe)
+        if res["feed_errors"] or res["timed_out"] or res["gave_up"]:
+            fail(f"offline replay did not run clean: "
+                 f"feed_errors={res['feed_errors']} "
+                 f"timed_out={res['timed_out']} gave_up={res['gave_up']}")
+        div = rj.first_divergence(entries, res["entries"])
+        if div is not None:
+            fail(f"offline replay diverged from the live journal: "
+                 f"{div}")
+        matched, total_fp, bad = rj.reply_match(entries, res["entries"])
+        if matched != total_fp or total_fp != len(bursts):
+            fail(f"replayed reply fingerprints: {matched}/{total_fp} "
+                 f"matched (want {len(bursts)}/{len(bursts)}; "
+                 f"divergent ids {bad})")
+        # Never-perturb A/B against the unarmed twin.
+        plain_replies, plain_disp = drive(plain)
+        if armed_replies != plain_replies:
+            fail("armed vs unarmed replies diverged — the journal "
+                 f"perturbed live traffic: {armed_replies} vs "
+                 f"{plain_replies}")
+        if armed_disp != plain_disp:
+            fail("armed vs unarmed dispatch counters diverged — the "
+                 f"journal perturbed the engine: {armed_disp} vs "
+                 f"{plain_disp}")
+        print(f"journal smoke OK: {len(bursts)} requests journaled "
+              f"({sum(counts.values())} entries), offline replay "
+              f"byte-identical ({matched}/{total_fp} replies, "
+              "decision-for-decision equal), armed==unarmed byte "
+              f"parity and dispatch schedule ({armed_disp})")
+    finally:
+        _shutdown_replica(armed)
+        _shutdown_replica(plain)
+
+
 def run_router_smoke() -> None:
     """Two tiny replicas + a router: the full gate against the ROUTER,
     then the affinity assertion — the shared-prefix burst must
@@ -905,7 +1049,20 @@ def main() -> None:
         "wide-event schema, and armed==unarmed byte parity + "
         "dispatch schedule (the auditor observes, never perturbs)",
     )
+    ap.add_argument(
+        "--journal-smoke", action="store_true",
+        help="boot a --journal armed replica and an unarmed twin, run "
+        "the same sequential burst against both, replay the journal "
+        "file offline byte-exactly (scripts/replay_journal.py), and "
+        "gate armed==unarmed byte parity + dispatch schedule (the "
+        "journal observes, never perturbs)",
+    )
     args = ap.parse_args()
+    if args.journal_smoke:
+        if args.base_url:
+            ap.error("--journal-smoke self-boots; drop --base-url")
+        run_journal_check()
+        return
     if args.router_smoke:
         if args.base_url:
             ap.error("--router-smoke self-boots; drop --base-url")

@@ -10,19 +10,16 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 650 = the 620 recorded at PR 13 plus the output-quality & numerics
-# observatory suites added in PR 14 (shadow-parity audits — pass on
-# the fp path and through eviction replay, fail/drift classification,
-# ring<->counter reconciliation, kind="audit" wide events — in
-# tests/test_audit.py; the in-dispatch logit probe's stat math,
-# bit-identical-tokens contract on the split AND ragged paths, and
-# the trainer-side grad/activation probes in tests/test_numerics.py;
-# the entropy_collapse/absmax_explosion/audit_drift/
-# spec_accept_collapse sentinels in tests/test_anomaly.py; the int8
-# round-trip error helpers in tests/test_quant.py; ~690 observed),
+# 680 = the 650 recorded at PR 14 plus the engine flight-recorder
+# suite added in PR 18 (tests/test_journal.py: the decision journal's
+# schema/ring/rotation contracts, byte-exact offline replay across
+# eviction, supervisor restart, speculative decoding, int8 KV,
+# host-spill reload and prefix-cache COW, the pinned first-divergence
+# report shape, what-if diff-table schema, the observe-never-perturb
+# A/B, and journal_seq joining the wide-event log; ~705 observed),
 # with headroom for load-dependent flakes (bench-supervisor probes on
 # one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-650}
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-680}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -83,6 +80,7 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     tests/test_speculative.py tests/test_pagemap.py \
     tests/test_forensics.py tests/test_device_time.py \
     tests/test_audit.py tests/test_numerics.py \
+    tests/test_journal.py \
     -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "LOCK SANITIZER SUITE FAILED (a concurrency violation above)" >&2
@@ -113,6 +111,20 @@ echo "checking output-quality observatory (--audit-smoke)"
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/check_serving_endpoints.py --audit-smoke; then
     echo "AUDIT OBSERVATORY CHECK FAILED" >&2
+    exit 1
+fi
+
+# --- engine flight-recorder gate ---------------------------------------------
+# The ISSUE-18 acceptance bar: a --journal armed replica under a
+# sequential burst — /debug/journal well-formed and reconciled, the
+# journal FILE replays offline byte-exactly (replay_journal.py:
+# decision-for-decision stream equality + reply fingerprints), and
+# live-traffic reply bytes + dispatch counters are identical to an
+# unarmed twin (the journal observes, never perturbs).
+echo "checking engine flight recorder (--journal-smoke)"
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_serving_endpoints.py --journal-smoke; then
+    echo "JOURNAL FLIGHT-RECORDER CHECK FAILED" >&2
     exit 1
 fi
 
@@ -161,7 +173,8 @@ fi
 
 # --- chaos suite: fault injection + failure containment ----------------------
 # Every named fault scenario (injected page-pool OOM, engine-thread
-# crash, hung dispatch vs deadline, mid-stream client disconnect,
+# crash, the same crash journaled + replayed offline bit-for-bit,
+# hung dispatch vs deadline, mid-stream client disconnect,
 # checkpoint-save failure) against a live tiny server: pool invariants
 # hold, zero leaked pages/refcounts, /readyz returns to 200, and
 # oryx_faults_injected_total reconciles against the injection schedule.

@@ -1057,7 +1057,8 @@ class _CharTokenizer:
 
 def boot_tiny_server(args, *, replica_id: str | None = None,
                      params=None, cfg=None,
-                     profile_sample_every: int | None = None):
+                     profile_sample_every: int | None = None,
+                     journal_path: str | None = None):
     """In-process tiny-geometry continuous-engine server with the SLO
     detectors ARMED (they are the gate). Returns (srv, base_url).
     profile_sample_every overrides the CLI value (the fleet boot
@@ -1088,6 +1089,7 @@ def boot_tiny_server(args, *, replica_id: str | None = None,
         ttft_slo=args.server_ttft_slo,
         queue_depth_slo=args.server_queue_depth_slo,
         replica_id=replica_id,
+        journal_path=journal_path,
     )
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv, f"http://127.0.0.1:{srv.server_address[1]}"
@@ -1206,6 +1208,13 @@ def run(argv=None) -> dict:
                     "spill tier budget in bytes (0 = off); the "
                     "per-stage memory block then carries host-tier "
                     "rows (spilled pages, reload hit economics)")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="single self-booted server only: arm the "
+                    "engine decision journal at PATH (serve/journal.py) "
+                    "— the sweep's decision stream lands as a "
+                    "replayable artifact (scripts/replay_journal.py) "
+                    "and the journal provenance (armed, path, entry "
+                    "count) is stamped into the report's config block")
     ap.add_argument("--profile-sample-every", type=int, default=0,
                     metavar="N",
                     help="self-booted server only: arm the sampled "
@@ -1247,6 +1256,10 @@ def run(argv=None) -> dict:
         ap.error("--router self-boots a fleet; drop --base-url")
     if args.gate_vs_single and not args.router:
         ap.error("--gate-vs-single only applies to --router sweeps")
+    if args.journal and (args.router or args.base_url):
+        # One journal file per scheduler: a fleet would collide on the
+        # path, and a remote target's journal lives on its own disk.
+        ap.error("--journal applies to the single self-booted server")
     if args.smoke:
         args.base_url = None
         args.rates = "1,4"
@@ -1319,7 +1332,7 @@ def run(argv=None) -> dict:
                 args, args.router
             )
         elif self_booted:
-            srv, base = boot_tiny_server(args)
+            srv, base = boot_tiny_server(args, journal_path=args.journal)
         warmup(base, cfg, random.Random(args.seed + 2))
         if replica_bases:
             # The affinity router concentrates the warmup on one
@@ -1387,6 +1400,26 @@ def run(argv=None) -> dict:
         # every stage drained, no slot may still hold pages and the
         # free list plus the prefix cache's references must cover the
         # whole pool.
+        # Decision-journal provenance: when --journal armed the flight
+        # recorder, the sweep's decision stream is itself an artifact
+        # (scripts/replay_journal.py replays it offline) — record
+        # where it landed and how many decisions it carries so the
+        # capacity number stays re-derivable. Unarmed/remote/router
+        # runs stamp armed=false / null honestly.
+        journal_prov = None
+        if not args.base_url and not args.router:
+            try:
+                with urllib.request.urlopen(
+                    base + "/debug/journal?n=0", timeout=30
+                ) as r:
+                    jbody = json.load(r)
+                journal_prov = {
+                    "armed": bool(jbody.get("armed")),
+                    "path": jbody.get("path"),
+                    "entries": jbody.get("total"),
+                }
+            except Exception as e:
+                journal_prov = {"error": f"{type(e).__name__}: {e}"}
         memory_audit = None
         if not args.base_url:
             memory_audit = {}
@@ -1471,6 +1504,9 @@ def run(argv=None) -> dict:
                     else 0 if args.router
                     else args.profile_sample_every
                 ),
+                # Flight-recorder provenance (NOT a comparability key:
+                # journaling observes, never perturbs — CI-gated).
+                "journal": journal_prov,
             },
             "stages": stages,
             "knee": knee,

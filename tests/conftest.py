@@ -325,6 +325,7 @@ _LOCK_SAN_FILES = (
     "test_pagemap.py",
     "test_forensics.py",
     "test_device_time.py",
+    "test_journal.py",
 )
 
 

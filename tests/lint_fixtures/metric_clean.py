@@ -18,3 +18,10 @@ def emit_events(build_request_event):
         request_id="r1", status="ok", error_kind=None,
         prefill_tokens=4, cached_tokens=0, page_seconds=0.5,
     )
+
+
+def emit_journal(build_journal_event):
+    build_journal_event(
+        kind="admit", step=3, request_id="r1", slot=0,
+        admit_seq=1, prompt_len=12, max_new=16, replay_tokens=0,
+    )

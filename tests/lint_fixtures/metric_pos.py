@@ -21,3 +21,9 @@ def emit_events(build_request_event):
     build_request_event(mystery_field=1)  # expect: metric-name
     build_request_event(BadCaseField="x")  # expect: metric-name
     build_request_event(request_id="r2", undeclared_one=1)  # expect: metric-name
+
+
+def emit_journal(build_journal_event):
+    build_journal_event(kind="step", dispatch="decode", rows=2)  # ok
+    build_journal_event(kind="step", not_in_schema=1)  # expect: metric-name
+    build_journal_event(BadJournalField="x")  # expect: metric-name
