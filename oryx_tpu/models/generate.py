@@ -809,12 +809,9 @@ def paged_ragged_step(
     W = pf_width
 
     def stop_hit(recent):
-        if stop_sequences is None:
-            return jnp.zeros((recent.shape[0],), bool)
-        m = (stop_sequences[None] == -1) | (
-            recent[:, None, :] == stop_sequences[None]
-        )
-        return jnp.any(jnp.all(m, axis=-1), axis=-1)
+        # Shared device-side stop predicate (ops/paged_kv.py): the
+        # fused megastep must match these semantics bit-for-bit.
+        return paged_kv_lib.stop_window_hit(recent, stop_sequences)
 
     def embed(ids):
         # The exact lookup `forward(input_ids=...)` performs, so decode
@@ -918,6 +915,112 @@ def paged_ragged_step(
     return out
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk", "k_steps", "eos", "attn_impl",
+                     "compute_dtype"),
+    donate_argnames=("kv_pages",),
+)
+def paged_fused_steps(
+    params,
+    cfg: LLMConfig,
+    kv_pages: dict,  # donated
+    block_tables: jnp.ndarray,  # [S, max_pages] int32
+    tok: jnp.ndarray,  # [S] next token to feed per slot
+    lengths: jnp.ndarray,  # [S] kv tokens held per slot (frozen on finish)
+    finished: jnp.ndarray,  # [S] bool (True for finished AND empty slots)
+    recent: jnp.ndarray,  # [S, stop_L] rolling stop window (-2 init)
+    keys: jax.Array,  # [S] per-slot PRNG keys
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    stop_sequences: jnp.ndarray | None,  # [Sq, L] (shared, static)
+    *,
+    chunk: int,
+    k_steps: int,
+    eos: int,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+):
+    """ONE device dispatch for K=`k_steps` PURE-DECODE engine steps —
+    the decode megastep (docs/DESIGN.md "Fused multi-step decode").
+
+    The scan body is `paged_ragged_step`'s pure-decode iteration
+    (pf_width=0), run k_steps*chunk times instead of chunk: sampling,
+    packed KV writes, the per-iteration RNG pair split and the
+    EOS/stop-window freeze all stay device-side, and the host harvests
+    ONCE per K logical steps instead of once per step. Columns
+    [j*chunk, (j+1)*chunk) of the returned toks are logical step j's
+    chunk — the host processes them as K sequential harvests (billing,
+    journal entries, stop-string detection all per LOGICAL step).
+
+    Bit-parity contract: K dispatches of the pure-decode
+    `paged_ragged_step` program and one dispatch of this program
+    produce identical carries and identical toks, because the per-
+    iteration math is the same expression — the K=1 path's host
+    round-trip between steps copies values it uploads back unchanged.
+    Rows the HOST would have frozen between steps (max_new cap,
+    per-request stop strings — both invisible to the device) keep
+    decoding inside the megastep; their later logical chunks are
+    garbage the host discards after the finish point, exactly like the
+    intra-chunk overshoot the K=1 path already discards, and their KV
+    overshoot self-confines to the row's own pages (the sentinel
+    routing of write_pages_packed drops anything past them).
+
+    Dispatched only when no admission is in flight: the megastep is
+    the idle-resident fast path, and the shape class is one compiled
+    program per k_steps ladder value (the recompile watchdog's bounded
+    -class contract).
+
+    Returns (kv_pages, tok, lengths, finished, recent, keys,
+    toks [S, k_steps*chunk], fin [S, k_steps*chunk])."""
+    from oryx_tpu.parallel.sharding import constrain
+
+    S = tok.shape[0]
+
+    def embed(ids):
+        e = constrain(params["embed"]["weight"], None, None)[ids]
+        return e.astype(compute_dtype) if compute_dtype is not None else e
+
+    def step(carry, _):
+        kv_pages, tok, cur_len, finished, recent, keys = carry
+        pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        emb = embed(tok)  # [S, H]
+        seg = jnp.arange(S, dtype=jnp.int32)
+        logits, kv_pages = qwen2.forward(
+            params, cfg,
+            inputs_embeds=emb[None], positions=cur_len[None],
+            kv_cache=kv_pages, block_tables=block_tables,
+            q_segments=seg[None], write_mask=(~finished)[None],
+            attn_impl=attn_impl, compute_dtype=compute_dtype,
+        )
+        lg = logits[0]  # [S, V]
+        nxt = sample_token_rows(
+            lg[:S], pair[:, 1],
+            temperature=temperature, top_p=top_p, top_k=top_k,
+        )
+        if recent.shape[1]:
+            recent = jnp.concatenate([recent[:, 1:], tok[:, None]], axis=1)
+        finished = finished | (tok == eos) | paged_kv_lib.stop_window_hit(
+            recent, stop_sequences
+        )
+        nxt = jnp.where(finished, eos, nxt)
+        cur_len = cur_len + (~finished).astype(jnp.int32)
+        return (
+            kv_pages, nxt, cur_len, finished, recent, pair[:, 0]
+        ), (tok, finished)
+
+    carry, (toks, fin) = jax.lax.scan(
+        step, (kv_pages, tok, lengths, finished, recent, keys),
+        None, length=k_steps * chunk,
+    )
+    kv_pages, tok, lengths, finished, recent, keys = carry
+    return (
+        kv_pages, tok, lengths, finished, recent, keys,
+        jnp.moveaxis(toks, 0, 1), jnp.moveaxis(fin, 0, 1),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Speculative decoding: self-drafted multi-token steps, verified in one
 # packed dispatch (docs/DESIGN.md "Speculative decoding")
@@ -953,6 +1056,19 @@ class Drafter:
 
     def propose(self, context, k: int) -> list[int]:
         raise NotImplementedError
+
+    # Device-side contract (opt-in): a drafter that can run ON the
+    # accelerator — inside `paged_fused_steps`' speculative scan —
+    # exposes its parameters as a pytree plus a module-level
+    # `device_apply(params, ctx, ctx_len, fed, k) -> (drafts, draft_len)`
+    # pure function. device_params() returning None means host-only:
+    # the drafter works on the per-step path but cannot ride a fused
+    # megastep (the scheduler rejects --fuse-steps > 1 + --speculate
+    # for such drafters rather than silently falling back).
+    device_apply = None
+
+    def device_params(self):
+        return None
 
 
 class NgramDrafter(Drafter):
@@ -1272,6 +1388,376 @@ def paged_spec_step(
     return (
         kv_pages, nxt, lengths + inc, new_finished, keys_next,
         out_toks, n_new, acc, pf_tok0, pf_key_next,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Trained draft model: tiny device-resident proposer behind the Drafter
+# seam (docs/DESIGN.md "Fused multi-step decode" — the draft chain runs
+# INSIDE the fused scan so propose->verify never leaves the chip)
+# ---------------------------------------------------------------------------
+
+# Positional decay of the context-mixing weights: token at distance d
+# from the window's right edge contributes DRAFT_DECAY**d. Part of the
+# checkpoint contract — changing it invalidates trained drafters.
+DRAFT_DECAY = 0.9
+
+
+def _draft_logits(params, buf: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """Next-token logits of the decayed-bag draft model.
+
+    `buf` [S, W] is a RIGHT-ALIGNED token window (left-padded with
+    anything; `n` [S] counts the valid tail entries). The model embeds
+    the window, mixes it with exponentially-decayed weights anchored at
+    the right edge, and projects to the vocabulary — one matmul pair,
+    cheap enough to run k times per verify lane inside the fused scan.
+    Pure function of (params, valid tail), so host and device callers
+    produce bit-identical proposals from the same window."""
+    W = buf.shape[1]
+    idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    valid = idx >= (W - n[:, None].astype(jnp.int32))
+    w = jnp.power(
+        jnp.float32(DRAFT_DECAY), (W - 1 - idx).astype(jnp.float32)
+    ) * valid.astype(jnp.float32)  # [S, W]
+    emb = params["embed"][jnp.clip(buf, 0)]  # [S, W, D] f32
+    h = jnp.sum(w[..., None] * emb, axis=1) / jnp.maximum(
+        jnp.sum(w, axis=1, keepdims=True), 1e-6
+    )
+    return h @ params["proj"]  # [S, V]
+
+
+def _draft_chain(params, buf: jnp.ndarray, n: jnp.ndarray, *, k: int):
+    """Greedy k-token draft chain: argmax, shift-append, repeat.
+
+    Greedy by design — a deterministic proposer is what the Drafter
+    replay contract requires, and speculative acceptance treats the
+    proposal as a point mass regardless of how it was picked.
+    Returns [S, k] int32 drafts."""
+
+    def step(carry, _):
+        buf, n = carry
+        nxt = jnp.argmax(_draft_logits(params, buf, n), axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        buf = jnp.concatenate([buf[:, 1:], nxt[:, None]], axis=1)
+        n = jnp.minimum(n + 1, buf.shape[1])
+        return (buf, n), nxt
+
+    _, drafts = jax.lax.scan(step, (buf, n), None, length=k)
+    return jnp.moveaxis(drafts, 0, 1)  # [S, k]
+
+
+_draft_chain_jit = jax.jit(_draft_chain, static_argnames=("k",))
+
+
+def neural_draft_propose(
+    draft_params,
+    ctx: jnp.ndarray,  # [S, W] right-aligned confirmed tail, EXCLUDING fed
+    ctx_len: jnp.ndarray,  # [S] valid entries in ctx (0..W)
+    fed: jnp.ndarray,  # [S] the fed token (lane 0 of the verify dispatch)
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side Drafter.device_apply for `NeuralDrafter`: shift the
+    fed token into the window (the host Drafter contract hands propose()
+    the confirmed stream INCLUDING the pending fed token) and run the
+    greedy chain. Module-level so it is hashable as a jit static arg in
+    `paged_fused_spec_steps`. Returns (drafts [S, k], draft_len [S]) —
+    the chain always emits exactly k proposals."""
+    buf = jnp.concatenate(
+        [ctx[:, 1:], fed[:, None].astype(jnp.int32)], axis=1
+    )
+    n = jnp.minimum(ctx_len.astype(jnp.int32) + 1, ctx.shape[1])
+    drafts = _draft_chain(draft_params, buf, n, k=k)
+    return drafts, jnp.full(fed.shape, k, jnp.int32)
+
+
+class NeuralDrafter(Drafter):
+    """Tiny trained draft model (decayed-bag-of-embeddings -> vocab
+    projection) implementing BOTH halves of the Drafter seam: the
+    host-side `propose()` used by the per-step speculative path, and
+    the `device_params()`/`device_apply` contract that lets
+    `paged_fused_spec_steps` run the same chain inside the fused scan.
+    Host and device call the SAME jitted `_draft_chain` math on the
+    same right-aligned window, so proposals are bit-identical — the
+    fused-vs-K=1 byte-parity claim for speculative serving rests on
+    exactly that.
+
+    Checkpoints are .npz files (embed [V, D] f32, proj [D, V] f32,
+    window). `from_spec` accepts either a checkpoint path or
+    "init:V:D:W:SEED" for a randomly-initialized model (useful for
+    parity tests and smoke benches; a random drafter just accepts
+    ~never, which is slow but CORRECT)."""
+
+    def __init__(self, params: dict, window: int = 16,
+                 source: str | None = None):
+        embed = np.asarray(params["embed"], np.float32)
+        proj = np.asarray(params["proj"], np.float32)
+        if embed.ndim != 2 or proj.ndim != 2 or embed.shape[1] != \
+                proj.shape[0] or embed.shape[0] != proj.shape[1]:
+            raise ValueError(
+                f"drafter params must be embed [V, D] / proj [D, V], got "
+                f"{embed.shape} / {proj.shape}"
+            )
+        if window < 1:
+            raise ValueError(f"drafter window must be >= 1, got {window}")
+        self.params = {"embed": embed, "proj": proj}
+        self.window = int(window)
+        self.source = source
+
+    @classmethod
+    def init(cls, vocab_size: int, dim: int = 16, *, window: int = 16,
+             seed: int = 0) -> "NeuralDrafter":
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        return cls(
+            {
+                "embed": 0.02 * jax.random.normal(
+                    k1, (vocab_size, dim), jnp.float32
+                ),
+                "proj": 0.02 * jax.random.normal(
+                    k2, (dim, vocab_size), jnp.float32
+                ),
+            },
+            window=window,
+            source=f"init:{vocab_size}:{dim}:{window}:{seed}",
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "NeuralDrafter":
+        with np.load(path) as z:
+            return cls(
+                {"embed": z["embed"], "proj": z["proj"]},
+                window=int(z["window"]), source=str(path),
+            )
+
+    def save(self, path: str) -> None:
+        np.savez(
+            path, embed=self.params["embed"], proj=self.params["proj"],
+            window=np.int64(self.window),
+        )
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "NeuralDrafter":
+        """"init:V:D:W:SEED" -> random init; anything else -> npz path.
+        The spec string is what gets stamped into the journal header
+        (`draft_model`), so replay can rebuild the identical drafter."""
+        if spec.startswith("init:"):
+            parts = spec.split(":")
+            if len(parts) != 5:
+                raise ValueError(
+                    f"drafter init spec must be init:V:D:W:SEED, got "
+                    f"{spec!r}"
+                )
+            v, d, w, s = (int(p) for p in parts[1:])
+            return cls.init(v, d, window=w, seed=s)
+        return cls.load(spec)
+
+    def device_params(self) -> dict:
+        return {
+            "embed": jnp.asarray(self.params["embed"]),
+            "proj": jnp.asarray(self.params["proj"]),
+        }
+
+    device_apply = staticmethod(neural_draft_propose)
+
+    def propose(self, context, k: int) -> list[int]:
+        a = np.asarray(context, np.int64).reshape(-1)[-self.window:]
+        if k <= 0 or a.size == 0:
+            return []
+        buf = np.zeros((1, self.window), np.int32)
+        buf[0, self.window - a.size:] = a
+        drafts = _draft_chain_jit(
+            self.device_params(), jnp.asarray(buf),
+            jnp.asarray([a.size], jnp.int32), k=k,
+        )
+        return [int(x) for x in np.asarray(drafts)[0]]
+
+
+def fit_neural_drafter(
+    streams,
+    vocab_size: int,
+    *,
+    dim: int = 16,
+    window: int = 16,
+    epochs: int = 30,
+    lr: float = 0.5,
+    seed: int = 0,
+) -> tuple["NeuralDrafter", list[float]]:
+    """Train a NeuralDrafter on token streams (next-token cross-entropy,
+    full-batch gradient descent). Deliberately tiny — the draft model's
+    job is to beat n-gram lookup on non-repetitive tails, not to be a
+    language model. Returns (drafter, per-epoch losses)."""
+    bufs, ns, tgts = [], [], []
+    for stream in streams:
+        a = np.asarray(stream, np.int64).reshape(-1)
+        for t in range(1, a.size):
+            ctx = a[max(0, t - window): t]
+            row = np.zeros((window,), np.int32)
+            row[window - ctx.size:] = ctx
+            bufs.append(row)
+            ns.append(ctx.size)
+            tgts.append(a[t])
+    if not bufs:
+        raise ValueError("fit_neural_drafter needs at least one 2-token "
+                         "stream")
+    buf = jnp.asarray(np.stack(bufs))
+    n = jnp.asarray(np.asarray(ns, np.int32))
+    tgt = jnp.asarray(np.asarray(tgts, np.int32))
+    drafter = NeuralDrafter.init(
+        vocab_size, dim, window=window, seed=seed
+    )
+    params = drafter.device_params()
+
+    def loss_fn(p):
+        lg = _draft_logits(p, buf, n)
+        return -jnp.mean(
+            jnp.take_along_axis(
+                jax.nn.log_softmax(lg, axis=-1), tgt[:, None], axis=1
+            )
+        )
+
+    step = jax.jit(
+        lambda p: (loss_fn(p), jax.grad(loss_fn)(p))
+    )
+    losses = []
+    for _ in range(epochs):
+        loss, g = step(params)
+        params = {k: v - lr * g[k] for k, v in params.items()}
+        losses.append(float(loss))
+    out = NeuralDrafter(
+        {k: np.asarray(v) for k, v in params.items()}, window=window,
+        source=f"fit:{vocab_size}:{dim}:{window}:{seed}",
+    )
+    return out, losses
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "k", "k_steps", "eos", "attn_impl", "compute_dtype",
+        "draft_apply",
+    ),
+    donate_argnames=("kv_pages",),
+)
+def paged_fused_spec_steps(
+    params,
+    cfg: LLMConfig,
+    kv_pages: dict,  # donated
+    block_tables: jnp.ndarray,  # [S, max_pages] int32
+    tok: jnp.ndarray,  # [S] next token to feed per slot
+    lengths: jnp.ndarray,  # [S] kv tokens held per slot
+    finished: jnp.ndarray,  # [S] bool
+    keys: jax.Array,  # [S] per-slot PRNG keys
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    draft_params,  # drafter.device_params() pytree
+    draft_ctx: jnp.ndarray,  # [S, CW] right-aligned confirmed tail (no fed)
+    draft_ctx_len: jnp.ndarray,  # [S] valid entries in draft_ctx
+    *,
+    k: int,
+    k_steps: int,
+    eos: int,
+    attn_impl: str = "xla",
+    compute_dtype=None,
+    draft_apply,
+):
+    """ONE device dispatch for K=`k_steps` SPECULATIVE pure-decode
+    engine steps: each scan iteration drafts k tokens on-device via
+    `draft_apply` (the Drafter's device contract — same math as its
+    host `propose()`), verifies them through the same packed forward
+    as `paged_spec_step`'s pure-decode branch, splices accepts /
+    rolls back rejects, and shifts the confirmed tokens into the
+    draft-context carry. Propose->verify->rollback never touches the
+    host until the K-step harvest.
+
+    Parity contract: iteration j's math is `paged_spec_step` (W=0)
+    verbatim — same spec_verify_rows key discipline (fixed 2k+3 split
+    per slot per step), same accept/EOS-truncation/rollback algebra —
+    and the in-scan context update reproduces exactly the confirmed
+    stream the host-side `_propose_drafts` would have assembled
+    between dispatches. So K fused speculative steps emit the same
+    bytes as K sequential `paged_spec_step` dispatches with the same
+    drafter. The context carry is NOT returned: the host rebuilds it
+    from its own confirmed stream before the next megastep, which
+    keeps the harvest surface identical to the per-step spec path.
+
+    Returns (kv_pages, tok, lengths, finished, keys,
+    toks [S, k_steps*(k+1)], n_new [S, k_steps], acc [S, k_steps]) —
+    logical step j owns toks[:, j*(k+1):(j+1)*(k+1)], of which the
+    first n_new[:, j] are real emissions."""
+    from oryx_tpu.parallel.sharding import constrain
+
+    S = tok.shape[0]
+    lanes = k + 1
+    CW = draft_ctx.shape[1]
+
+    def embed(ids):
+        e = constrain(params["embed"]["weight"], None, None)[ids]
+        return e.astype(compute_dtype) if compute_dtype is not None else e
+
+    def step(carry, _):
+        kv_pages, tok, lengths, finished, keys, ctx, clen = carry
+        drafts, dlen = draft_apply(draft_params, ctx, clen, tok, k)
+        ids = jnp.concatenate(
+            [tok[:, None], drafts.astype(jnp.int32)], axis=1
+        )
+        dec_emb = embed(ids.reshape(S * lanes))
+        seg, pos = paged_kv_lib.spec_lane_metadata(lengths, k)
+        lane_j = jnp.tile(jnp.arange(lanes, dtype=jnp.int32), (S,))
+        wm = (
+            jnp.repeat(~finished, lanes)
+            & (lane_j <= jnp.repeat(dlen.astype(jnp.int32), lanes))
+        )
+        logits, kv_pages = qwen2.forward(
+            params, cfg,
+            inputs_embeds=dec_emb[None], positions=pos[None],
+            kv_cache=kv_pages, block_tables=block_tables,
+            q_segments=seg[None], write_mask=wm[None],
+            attn_impl=attn_impl, compute_dtype=compute_dtype,
+        )
+        lg = logits[0][: S * lanes].reshape(S, lanes, -1)
+        acc, cand, keys_next = spec_verify_rows(
+            lg, tok, drafts, dlen, keys,
+            temperature=temperature, top_p=top_p, top_k=top_k, eos=eos,
+        )
+        jr = jnp.arange(k, dtype=jnp.int32)[None, :]
+        accepted = jr < acc[:, None]
+        out_toks = jnp.concatenate(
+            [tok[:, None], jnp.where(accepted, drafts, eos)], axis=1
+        )
+        acc_eos = jnp.any(accepted & (drafts == eos), axis=1)
+        fed_eos = tok == eos
+        new_finished = finished | fed_eos | acc_eos
+        n_new = jnp.where(finished, 0, 1 + acc)
+        inc = jnp.where(
+            finished | fed_eos, 0, 1 + acc - acc_eos.astype(jnp.int32)
+        )
+        nxt = jnp.where(new_finished, eos, cand)
+        # Shift this step's confirmed tokens (fed + accepted drafts)
+        # into the right-aligned window — what the host would have fed
+        # the drafter next step. Frozen rows have n_new == 0: no shift.
+        ext = jnp.concatenate([ctx, out_toks.astype(jnp.int32)], axis=1)
+        ctx = jnp.take_along_axis(
+            ext,
+            n_new[:, None] + jnp.arange(CW, dtype=jnp.int32)[None, :],
+            axis=1,
+        )
+        clen = jnp.minimum(clen + n_new, CW)
+        return (
+            kv_pages, nxt, lengths + inc, new_finished, keys_next, ctx,
+            clen,
+        ), (out_toks, n_new, acc)
+
+    carry, (toks, n_new, acc) = jax.lax.scan(
+        step,
+        (kv_pages, tok, lengths, finished, keys, draft_ctx,
+         draft_ctx_len.astype(jnp.int32)),
+        None, length=k_steps,
+    )
+    kv_pages, tok, lengths, finished, keys, _, _ = carry
+    return (
+        kv_pages, tok, lengths, finished, keys,
+        jnp.moveaxis(toks, 0, 1).reshape(S, k_steps * lanes),
+        jnp.moveaxis(n_new, 0, 1), jnp.moveaxis(acc, 0, 1),
     )
 
 

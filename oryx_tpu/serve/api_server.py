@@ -600,6 +600,8 @@ def build_server(
     prefix_cache: bool = True,
     ragged: bool = False,
     speculate: int = 0,
+    fuse_steps: int | str = 1,
+    draft_model: str | None = None,
     kv_dtype: str = "bf16",
     host_cache_bytes: int = 0,
     audit_tol_maxdiff: float | None = None,
@@ -683,6 +685,32 @@ def build_server(
         raise ValueError(
             "--speculate requires --ragged (draft tokens ride the "
             "fused packed dispatch as extra verify lanes)"
+        )
+    if fuse_steps != 1:
+        # Fused multi-step decode (docs/DESIGN.md "Fused multi-step
+        # decode"): the megastep is a scan over the fused ragged step,
+        # so it needs that step to exist — same fail-fast contract.
+        if engine == "window":
+            raise ValueError(
+                "--fuse-steps requires a scheduler engine (the window "
+                "batcher has no engine step to fuse)"
+            )
+        if not ragged:
+            raise ValueError(
+                "--fuse-steps requires --ragged (the megastep is a "
+                "scan over the fused ragged step)"
+            )
+        if speculate and not draft_model:
+            raise ValueError(
+                "--fuse-steps with --speculate needs --draft-model: "
+                "the host-side n-gram drafter cannot ride the fused "
+                "scan (propose->verify must stay on-device)"
+            )
+    if draft_model and not speculate:
+        raise ValueError(
+            "--draft-model requires --speculate (the draft model "
+            "proposes speculative tokens; without a verify lane count "
+            "it would never be consulted)"
         )
     if engine == "window" and request_timeout:
         # Same fail-fast contract for the containment knob: deadlines
@@ -799,6 +827,17 @@ def build_server(
                 model=model_name, faults_spec=faults_spec or None,
                 max_tokens_limit=max_tokens_limit,
             )
+        # Trained draft model (models/generate.NeuralDrafter): a
+        # checkpoint path or an "init:V:D:W:SEED" spec. Replaces the
+        # default n-gram drafter and — because it implements the
+        # device params/apply contract — unlocks fused speculative
+        # megasteps. Its `source` string lands in the journal header
+        # (draft_model) so replay rebuilds the identical proposer.
+        drafter = None
+        if draft_model:
+            from oryx_tpu.models import generate as generate_lib
+
+            drafter = generate_lib.NeuralDrafter.from_spec(draft_model)
         # Engine registry (serve/engine.py): "continuous", "sharded",
         # and whatever later shapes register — all drop-in behind this
         # server and the supervisor through the Engine protocol.
@@ -808,6 +847,7 @@ def build_server(
             tracer=tracer, stall_timeout=stall_timeout, anomaly=anomaly,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
             ragged=ragged, speculate=speculate,
+            fuse_steps=fuse_steps, drafter=drafter,
             kv_dtype=kv_dtype, host_cache_bytes=host_cache_bytes,
             audit_tol_maxdiff=audit_tol_maxdiff,
             audit_tol_kl=audit_tol_kl,
@@ -1619,6 +1659,28 @@ def main(argv: list[str] | None = None) -> None:
         "rejection sampling (distribution-exact). Requires --ragged.",
     )
     ap.add_argument(
+        "--fuse-steps", default="1", metavar="K|auto",
+        help="continuous engine: fused multi-step decode — run K "
+        "engine steps per device dispatch (a donating on-device scan: "
+        "sampling, KV writes and EOS/stop-window detection stay "
+        "device-side; the host harvests once per K logical steps). "
+        "'auto' adapts K from queue depth within a small fixed ladder "
+        "of compiled shape classes (backlog -> K=1 so admission "
+        "latency never degrades; idle residents -> large K). Replies "
+        "are byte-identical to K=1. Requires --ragged; with "
+        "--speculate also requires --draft-model (propose->verify "
+        "runs inside the fused scan)",
+    )
+    ap.add_argument(
+        "--draft-model", default=None, metavar="PATH|init:V:D:W:SEED",
+        help="continuous engine: trained draft model for speculative "
+        "decoding (models/generate.NeuralDrafter) replacing the "
+        "default n-gram drafter — an .npz checkpoint path (see "
+        "generate.fit_neural_drafter) or an init:V:D:W:SEED spec for "
+        "a random init. Implements the device-side drafting contract "
+        "required by --fuse-steps + --speculate. Requires --speculate",
+    )
+    ap.add_argument(
         "--kv-dtype", choices=["bf16", "int8"], default="bf16",
         help="continuous engine: paged KV pool storage format. bf16 = "
         "dense pages in the compute dtype (byte-exact). int8 = "
@@ -1794,6 +1856,25 @@ def main(argv: list[str] | None = None) -> None:
                  "lanes of the fused dispatch)")
     if args.speculate < 0:
         ap.error("--speculate must be >= 0")
+    # --fuse-steps: "auto" stays a string; anything else must parse as
+    # a positive int (build_server re-validates engine/ragged pairing).
+    if args.fuse_steps == "auto":
+        fuse_steps: int | str = "auto"
+    else:
+        try:
+            fuse_steps = int(args.fuse_steps)
+        except ValueError:
+            ap.error("--fuse-steps must be a positive integer or 'auto'")
+        if fuse_steps < 1:
+            ap.error("--fuse-steps must be a positive integer or 'auto'")
+    if fuse_steps != 1 and not args.ragged:
+        ap.error("--fuse-steps requires --ragged (the megastep is a "
+                 "scan over the fused ragged step)")
+    if fuse_steps != 1 and args.speculate and not args.draft_model:
+        ap.error("--fuse-steps with --speculate requires --draft-model "
+                 "(on-device drafting)")
+    if args.draft_model and not args.speculate:
+        ap.error("--draft-model requires --speculate")
 
     from oryx_tpu.parallel.mesh import parse_shard_arg
     from oryx_tpu.serve.builder import load_pipeline
@@ -1818,6 +1899,8 @@ def main(argv: list[str] | None = None) -> None:
         prefix_cache=not args.no_prefix_cache,
         ragged=args.ragged,
         speculate=args.speculate,
+        fuse_steps=fuse_steps,
+        draft_model=args.draft_model,
         kv_dtype=args.kv_dtype,
         host_cache_bytes=args.host_cache_bytes,
         audit_tol_maxdiff=args.audit_tol_maxdiff,

@@ -164,6 +164,14 @@ from oryx_tpu.utils.metrics import (
 # /debug/trace).
 _LOG = logging.getLogger("oryx.serve.scheduler")
 
+# The adaptive-K ladder for --fuse-steps auto: every value is a
+# separate compiled shape class of the megastep program, so the ladder
+# stays SHORT and FIXED (the recompile watchdog's bounded-class
+# contract — a warmup that touches each rung compiles everything the
+# engine will ever run). K=1 — the plain per-step program — is always
+# implicitly available below the ladder.
+FUSE_AUTO_LADDER: tuple[int, ...] = (4, 16)
+
 
 class AdmissionRejected(RuntimeError):
     """submit() refused the request without queueing it: backpressure
@@ -345,6 +353,7 @@ class ContinuousScheduler:
         ragged: bool = False,
         speculate: int = 0,
         drafter=None,
+        fuse_steps: int | str = 1,
         timeline: StepTimeline | None = None,
         request_log: request_log_lib.RequestLog | None = None,
         engine_label: str = "continuous",
@@ -449,6 +458,53 @@ class ContinuousScheduler:
                 else generate_lib.NgramDrafter()
             )
         self._win = (1 + self.speculate) if self.speculate else chunk
+        # Fused multi-step decode (docs/DESIGN.md "Fused multi-step
+        # decode"): K engine steps per device dispatch — the decode
+        # megastep. An int K pins the fusion depth; "auto" adapts K
+        # from queue depth between a small bounded LADDER of compiled
+        # shape classes (deep backlog -> K=1 so admission/cancel
+        # latency never degrades by more than K-1 steps; idle
+        # residents -> large K so the per-step harvest sync amortizes).
+        # K collapses to 1 whenever an admission rides the step, so
+        # the prefill-present shape class never multiplies by K.
+        if fuse_steps != "auto" and (
+            isinstance(fuse_steps, bool) or not isinstance(fuse_steps, int)
+            or fuse_steps < 1
+        ):
+            raise ValueError(
+                "fuse_steps must be a positive integer (engine steps "
+                f"per decode dispatch) or 'auto', got {fuse_steps!r}"
+            )
+        if fuse_steps != 1 and not ragged:
+            raise ValueError(
+                "fuse_steps > 1 requires ragged=True: the megastep is "
+                "a scan over the fused ragged step (the split engine "
+                "has no single program to iterate)"
+            )
+        if fuse_steps != 1 and self.speculate and (
+            self.drafter.device_params() is None
+            or self.drafter.device_apply is None
+        ):
+            raise ValueError(
+                "fuse_steps > 1 with speculate>0 needs a drafter "
+                "implementing the device contract (device_params()/"
+                "device_apply) so propose->verify can run inside the "
+                "fused scan — pass a generate.NeuralDrafter "
+                "(--draft-model), or drop --fuse-steps"
+            )
+        self.fuse_steps = fuse_steps
+        self._fuse_ladder: tuple[int, ...] = (
+            FUSE_AUTO_LADDER if fuse_steps == "auto"
+            else ((fuse_steps,) if fuse_steps > 1 else ())
+        )
+        # Replay override (scripts/replay_journal.py): a dict mapping
+        # the steps_run value a megastep STARTED at -> its journaled K.
+        # Live serving leaves it None and picks K from the ladder;
+        # replay substitutes the captured plan because live K reads
+        # queue depth, which is wall-clock-coupled and NOT part of the
+        # deterministic replay state (same treatment as the degraded
+        # ladder: journaled, not re-derived).
+        self.replay_fuse_plan: dict[int, int] | None = None  # thread-owned: engine
         if ragged and not self.speculate and prefill_chunk % chunk:
             # The prefill lanes advance chunk*pf_width tokens per fused
             # step — ceil-rounding silently raises the configured
@@ -506,6 +562,15 @@ class ContinuousScheduler:
         # carried (docs/OBSERVABILITY.md).
         reg.counter("dispatches_total", ("kind",))
         reg.histogram("dispatch_rows", DISPATCH_ROWS_BUCKETS)
+        # Fused-decode observability: the K currently in effect (gauge,
+        # so a dashboard sees adaptive-K transitions) and how many
+        # times the host actually harvested device outputs — with
+        # fusion, dispatches == harvests but BOTH run at 1/K of the
+        # logical step rate, and the separate counter is what makes a
+        # harvest-cadence regression diagnosable (docs/OBSERVABILITY.md
+        # "Fused multi-step decode").
+        reg.gauge("fused_k")
+        reg.counter("harvest_total")
         # Speculation accounting: tokens a slot advanced per engine
         # step (sum/count mean is THE speculation headline — the
         # accepted-tokens/step gate) plus raw draft economics
@@ -700,6 +765,8 @@ class ContinuousScheduler:
                 prefill_chunk=prefill_chunk,
                 prefix_cache=bool(prefix_cache),
                 ragged=self.ragged, speculate=self.speculate,
+                fuse_steps=fuse_steps,
+                draft_model=getattr(self.drafter, "source", None),
                 kv_dtype=kv_dtype, host_cache_bytes=host_cache_bytes,
                 max_queue=max_queue,
                 degraded_clamp_tokens=degraded_clamp_tokens,
@@ -2498,12 +2565,20 @@ class ContinuousScheduler:
                 [int(p) for p in self.bt[s, :full]],
             )
 
-    def _ensure_capacity(self) -> None:
-        """Every live slot must own pages for lengths + chunk before the
-        next dispatch; under page pressure, preempt YOUNGER slots only —
+    def _ensure_capacity(self, horizon: int | None = None) -> None:
+        """Every live slot must own pages for lengths + `horizon`
+        (default: one dispatch window, `_win`) before the next
+        dispatch; under page pressure, preempt YOUNGER slots only —
         a slot with no younger victim preempts ITSELF (vLLM-style), so
         the oldest request always makes progress and eviction can never
-        ping-pong two slots at the same growth point forever."""
+        ping-pong two slots at the same growth point forever.
+
+        A fused megastep passes horizon=_win*K: the device writes up
+        to K windows of KV before the host sees any of it, so every
+        page a row could touch must exist BEFORE the dispatch. Evicting
+        here (pre-dispatch, deterministic in journaled state) is what
+        keeps eviction replay exact under fusion."""
+        win = self._win if horizon is None else horizon
         order = sorted(
             (s for s, r in enumerate(self.slots) if r is not None),
             key=lambda s: self.slots[s].admit_seq,
@@ -2511,7 +2586,7 @@ class ContinuousScheduler:
         for s in order:
             if self.slots[s] is None or self.finished[s]:
                 continue  # freed or evicted by an earlier iteration
-            while not self._grow_slot(s, int(self.lengths[s]) + self._win):
+            while not self._grow_slot(s, int(self.lengths[s]) + win):
                 me = self.slots[s].admit_seq
                 younger = [
                     v for v in order
@@ -2852,6 +2927,7 @@ class ContinuousScheduler:
         point per chunk (the harvest the chunk exists to amortize) —
         anything else host-syncing on the step paths is a regression
         the host-sync rule catches."""
+        self.metrics.inc("harvest_total")
         # oryxlint: off=host-sync
         self.tok = np.asarray(tok).copy()
         self.lengths = np.asarray(lengths).copy()
@@ -2915,6 +2991,16 @@ class ContinuousScheduler:
                 pf_s, pf_req = s, req
                 break
         if pf_req is None and not live:
+            return
+        # Fused multi-step decode: when the adaptive-K policy (or the
+        # replay plan) picks K>1, the whole engine step becomes a
+        # megastep — K logical steps in one dispatch — and everything
+        # below (prefill lanes, per-step dispatch, harvest) is the K=1
+        # path this step didn't take.
+        fuse_k = self._select_fuse_k(live, pf_req)
+        self.metrics.set_gauge("fused_k", fuse_k)
+        if fuse_k > 1:
+            self._fused_megastep(fuse_k)
             return
         # Chaos sites: the fused dispatch is both the admission's
         # prefill work and the residents' decode beat, so both named
@@ -3072,6 +3158,284 @@ class ContinuousScheduler:
                 self._activate(pf_s, pf_req, pf_tok0[np.newaxis], pf_key)
         self._occupancy_gauge()
 
+    def _select_fuse_k(self, live: list[int], pf_req) -> int:
+        """Pick K — logical engine steps for the next decode dispatch
+        (docs/DESIGN.md "Fused multi-step decode").
+
+        Replay consults the journaled plan FIRST: live K reads queue
+        depth, which is wall-clock-coupled and not replay state (the
+        degraded ladder gets the same journaled-not-re-derived
+        treatment). Live policy: K>1 only for a pure-decode step
+        (admission in flight -> 1, so the prefill-present shape class
+        never multiplies), only when the queue is EMPTY (a waiting
+        request must not eat a K-step admission delay), and never with
+        the numerics probe armed (the megastep program doesn't carry
+        it). K is then clamped to every live row's remaining max_new
+        budget in dispatch windows — a row the HOST will finish
+        (length cap, custom stop string) overruns at most one window
+        past its budget, the same max_ctx exposure as K=1 — and the
+        largest ladder rung that fits wins. "auto" uses the small rung
+        when residents share the step (a mid-megastep finish idles its
+        lanes for the remainder) and the large rung for a solo
+        resident."""
+        if self.replay_fuse_plan is not None:
+            return self.replay_fuse_plan.get(self.steps_run, 1)
+        if not self._fuse_ladder or pf_req is not None or not live:
+            return 1
+        if self.numerics_every:
+            return 1
+        with self._cond:
+            if self._queue:
+                return 1
+        desired = (
+            self._fuse_ladder[-1] if len(live) == 1
+            else self._fuse_ladder[0]
+        )
+        cap = desired
+        for s in live:
+            req = self.slots[s]
+            rem = max(1, req.replay + req.max_new - len(req.emitted))
+            cap = min(cap, -(-rem // self._win))
+        k = 1
+        for rung in self._fuse_ladder:
+            if rung <= cap:
+                k = max(k, rung)
+        return k
+
+    def _fused_megastep(self, k_steps: int) -> None:
+        """ONE device dispatch for K logical engine steps — the decode
+        megastep. Pure-decode by construction (`_select_fuse_k` returns
+        1 whenever an admission is in flight), so the dispatch is the
+        `paged_fused_steps` scan (or its speculative twin, with the
+        drafter's device chain folded into each iteration) and the
+        host pays ONE harvest sync for K steps. Everything host-side —
+        billing, journal entries, stop-string detection, finishes —
+        then runs as K sequential logical steps over column slices of
+        the harvested outputs (`_finish_megastep`), so every per-step
+        meaning (TPOT, wasted fraction, the journal's step clock) is
+        preserved bit-for-bit against the K=1 path."""
+        faults.fault_point("decode_dispatch")
+        hot_dispatch("scheduler._fused_megastep")
+        # Pages for K dispatch windows must exist BEFORE the scan (the
+        # device cannot grow tables mid-flight); eviction under this
+        # larger horizon is deterministic in journaled state, so replay
+        # re-derives it exactly.
+        self._ensure_capacity(self._win * k_steps)
+        live = [
+            s for s, r in enumerate(self.slots)
+            if r is not None and r.activated
+        ]
+        if not live:
+            return
+        dtype = oryx.compute_dtype(self.cfg)
+        eos = self.cfg.generation.eos_token_id
+        sampled = self._profile_dispatch_begin()
+        t0 = time.monotonic()
+        t0_ns = trace_lib.now_ns()
+        if self.speculate:
+            draft_ctx, draft_clen = self._build_draft_ctx(live)
+            with self.pipe._mesh_scope():
+                (self.kv_pages, tok, lengths, finished, self.keys,
+                 toks, n_new, acc) = generate_lib.paged_fused_spec_steps(
+                    self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
+                    jnp.asarray(self.bt),
+                    jnp.asarray(self.tok),
+                    jnp.asarray(self.lengths),
+                    jnp.asarray(self.finished),
+                    self.keys,
+                    jnp.asarray(self.temp),
+                    jnp.asarray(self.top_p),
+                    jnp.asarray(self.top_k),
+                    self.drafter.device_params(),
+                    jnp.asarray(draft_ctx),
+                    jnp.asarray(draft_clen),
+                    k=self.speculate, k_steps=k_steps, eos=eos,
+                    attn_impl=self.cfg.attn_impl, compute_dtype=dtype,
+                    draft_apply=self.drafter.device_apply,
+                )
+            toks, n_new, acc = self._harvest_spec(
+                tok, lengths, finished, toks, n_new, acc
+            )
+            dt = time.monotonic() - t0
+            dev_us = self._profile_dispatch_end(
+                sampled, "fused_spec", t0_ns
+            )
+            # Draft economics: the device chain proposes k tokens for
+            # every row still decoding at that logical step (n_new==0
+            # marks a row that entered the step frozen — its masked
+            # lanes proposed nothing, same as the K=1 accounting).
+            self.metrics.inc(
+                "draft_proposed_total",
+                int(self.speculate * (n_new[live] > 0).sum()),
+            )
+            self.metrics.inc("draft_accepted_total", int(acc[live].sum()))
+            rows = len(live) * (1 + self.speculate)
+            self._finish_megastep(
+                "fused_spec", rows, live, toks, t0_ns, dt, k_steps,
+                n_new=n_new, device_us=dev_us,
+            )
+        else:
+            with self.pipe._mesh_scope():
+                (self.kv_pages, tok, lengths, finished, recent,
+                 self.keys, toks, fin) = generate_lib.paged_fused_steps(
+                    self.pipe.params["llm"], self.cfg.llm, self.kv_pages,
+                    jnp.asarray(self.bt),
+                    jnp.asarray(self.tok),
+                    jnp.asarray(self.lengths),
+                    jnp.asarray(self.finished),
+                    jnp.asarray(self.recent),
+                    self.keys,
+                    jnp.asarray(self.temp),
+                    jnp.asarray(self.top_p),
+                    jnp.asarray(self.top_k),
+                    self.stop_sequences,
+                    chunk=self.chunk, k_steps=k_steps, eos=eos,
+                    attn_impl=self.cfg.attn_impl, compute_dtype=dtype,
+                )
+            toks, fin = self._harvest_chunk(
+                tok, lengths, finished, recent, toks, fin
+            )
+            dt = time.monotonic() - t0
+            dev_us = self._profile_dispatch_end(sampled, "fused", t0_ns)
+            self._finish_megastep(
+                "fused", len(live), live, toks, t0_ns, dt, k_steps,
+                device_us=dev_us,
+            )
+        self._occupancy_gauge()
+
+    def _finish_megastep(
+        self, kind: str, rows: int, live: list[int], toks, t0_ns, dt,
+        k_steps: int, n_new=None, device_us=None,
+    ) -> None:
+        """Post-megastep accounting: the dispatch-level numbers land
+        ONCE (one device dispatch happened — dispatches_total, the
+        rows histogram, the watchdog beat, one timeline record), then
+        the harvested outputs are processed as K sequential LOGICAL
+        steps — logical step j owns columns [j*width, (j+1)*width) of
+        `toks` — so the per-step billing (`_advance`, cost ledger,
+        TPOT, the decode_steps family, the journal's step clock) keeps
+        its K=1 meaning exactly. A row the host finishes at logical
+        step j (EOS, max_new, stop string) drops out of live_j for
+        j+1.. — its remaining device columns are frozen filler the
+        sequential path would never have dispatched, discarded here
+        the same way."""
+        self.metrics.inc("dispatches_total", labels={"kind": kind})
+        self.metrics.observe(
+            "dispatch_rows", rows, buckets=DISPATCH_ROWS_BUCKETS
+        )
+        if self.watchdog is not None:
+            self.watchdog.beat()
+        width = (1 + self.speculate) if n_new is not None else self.chunk
+        total_accepted = 0
+        for j in range(k_steps):
+            self.chunks_run += 1
+            self.metrics.inc("chunks")
+            live_j = [s for s in live if self.slots[s] is not None]
+            useful = 0
+            emitted = 0
+            for s, tokens in generate_lib.unpack_ragged_rows(
+                toks[:, j * width:(j + 1) * width], live_j
+            ).items():
+                req = self.slots[s]
+                if req is None:
+                    continue
+                if n_new is not None:
+                    tokens = tokens[: int(n_new[s, j])]
+                    emitted += len(tokens)
+                    self.metrics.observe(
+                        "accepted_tokens_per_step", len(tokens),
+                        buckets=SPEC_ACCEPT_BUCKETS,
+                    )
+                req.trace.add_complete(
+                    "decode_chunk", t0_ns, int(dt * 1e9),
+                    chunk=self.chunks_run, slot=s,
+                )
+                req.cost_decode_steps += width
+                self._accrue_page_seconds(s)
+                useful += self._advance(s, tokens)
+            if live_j and n_new is not None and self.anomaly is not None:
+                self.anomaly.observe_spec_accept(
+                    emitted / len(live_j), step=self.chunks_run,
+                )
+            if live_j:
+                per_tok = (
+                    emitted / len(live_j) if n_new is not None
+                    else self.chunk
+                )
+                self.metrics.observe(
+                    "time_per_output_token_seconds",
+                    (dt / k_steps) / max(1.0, per_tok),
+                )
+                total = self.num_slots * width
+                self.metrics.inc("decode_steps_total", total)
+                self.metrics.inc("decode_steps_useful", useful)
+                self.metrics.inc("decode_steps_wasted", total - useful)
+            step_accepted = emitted if n_new is not None else useful
+            total_accepted += step_accepted
+            # The journal's step clock advances per LOGICAL step — K
+            # entries per megastep, each stamped with (fused_k,
+            # fused_j) so replay can reconstruct the fuse plan and a
+            # K=1 replay of a fused capture diverges on the `dispatch`
+            # field by name instead of silently.
+            self.steps_run += 1
+            if self.journal is not None:
+                self.journal.append(journal_lib.build_journal_event(
+                    kind="step", step=self.steps_run, dispatch=kind,
+                    rows=rows, live_slots=len(live_j),
+                    accepted_tokens=step_accepted,
+                    free_pages=self.allocator.num_free,
+                    fused_k=k_steps, fused_j=j,
+                ))
+        live_now = sum(
+            1 for r in self.slots if r is not None and r.activated
+        )
+        self.timeline.record(
+            dur_s=dt, kind=kind, rows=rows, live_slots=live_now,
+            accepted_tokens=total_accepted,
+            queue_depth=int(self.metrics.get("queue_depth")),
+            free_pages=self.allocator.num_free,
+            degraded_mode=int(self.metrics.get("degraded_mode")),
+            device_us=device_us,
+        )
+
+    def _build_draft_ctx(self, live: list[int]):
+        """Right-aligned confirmed-stream windows for the device draft
+        chain — `_propose_drafts`'s context assembly MINUS the fed
+        token (the fused program shifts each step's fed token into the
+        window itself, so one upload serves all K logical steps).
+        Rebuilt from host truth before every megastep: the device's
+        in-scan context carry is deliberately NOT round-tripped back
+        (no new host-sync surface beyond the one harvest), and
+        rebuilding from the DEVICE-CONFIRMED stream — not the full
+        host `emitted`, which runs ahead during eviction replay — is
+        what keeps replayed proposals identical to the original run's.
+        Returns (ctx [S, window] int32, ctx_len [S] int32)."""
+        CW = self.drafter.window
+        ctx = np.zeros((self.num_slots, CW), np.int32)
+        clen = np.zeros((self.num_slots,), np.int32)
+        for s in live:
+            req = self.slots[s]
+            confirmed = max(0, int(self.lengths[s]) - req.length)
+            prompt = (
+                req.cache_tokens if req.cache_tokens is not None
+                else np.zeros((0,), np.int64)
+            )
+            reply = req.emitted[:confirmed]
+            keep = max(0, CW - len(reply))
+            prompt = (
+                prompt[max(0, len(prompt) - keep):] if keep
+                else prompt[:0]
+            )
+            reply = reply[max(0, len(reply) - CW):]
+            tail = np.concatenate([
+                np.asarray(prompt, np.int64),
+                np.asarray(reply, np.int64),
+            ])[-CW:].astype(np.int32)
+            if len(tail):
+                ctx[s, CW - len(tail):] = tail
+            clen[s] = len(tail)
+        return ctx, clen
+
     def _propose_drafts(self, live: list[int]):
         """Host-side draft proposal for every live slot: the drafter
         sees the request's DEVICE-CONFIRMED stream — prompt ids +
@@ -3126,6 +3490,7 @@ class ContinuousScheduler:
         strings are host-detected in this mode, and fin is subsumed by
         the finished vector + the EOS the accepted span carries). Same
         one-deliberate-sync-per-step contract."""
+        self.metrics.inc("harvest_total")
         # oryxlint: off=host-sync
         self.tok = np.asarray(tok).copy()
         self.lengths = np.asarray(lengths).copy()

@@ -610,11 +610,15 @@ JOURNAL_EVENT_KEYS = (
     "host_reload_pages",    # host-tier pages re-uploaded for the splice
     "victim_request_id",    # evict: whose pages were taken
     # -- step -------------------------------------------------------------
-    "dispatch",             # prefill | decode | ragged | spec
+    "dispatch",             # prefill | decode | ragged | spec | fused
+                            # | fused_spec
     "rows",
     "live_slots",
     "accepted_tokens",
     "free_pages",
+    "fused_k",              # megastep: logical steps in this dispatch
+    "fused_j",              # megastep: this entry's index within it
+                            # (0..fused_k-1; absent on K=1 dispatches)
     # -- degraded / fault / restart ---------------------------------------
     "mode",                 # degraded-mode ladder level
     "site",                 # fault-point site name

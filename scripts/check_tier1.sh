@@ -10,16 +10,16 @@
 set -u
 cd "$(dirname "$0")/.."
 
-# 680 = the 650 recorded at PR 14 plus the engine flight-recorder
-# suite added in PR 18 (tests/test_journal.py: the decision journal's
-# schema/ring/rotation contracts, byte-exact offline replay across
-# eviction, supervisor restart, speculative decoding, int8 KV,
-# host-spill reload and prefix-cache COW, the pinned first-divergence
-# report shape, what-if diff-table schema, the observe-never-perturb
-# A/B, and journal_seq joining the wide-event log; ~705 observed),
-# with headroom for load-dependent flakes (bench-supervisor probes on
-# one CPU core).
-BASELINE_DOTS=${ORYX_TIER1_BASELINE:-680}
+# 700 = the 680 recorded at PR 18 plus the fused-megastep suite added
+# in PR 19 (tests/test_fused_decode.py: fused-vs-K=1 byte parity
+# across greedy/sampled/stop-string/eviction/int8/tp=2/speculative
+# runs, per-logical-step billing, adaptive-K zero-recompile warmup,
+# K-entry journaling with byte-exact fused replay, the journaled
+# fuse-plan on auto replay, K=1-replay first-divergence naming, and
+# the NeuralDrafter host/device bit-identity + checkpoint contracts;
+# ~731 observed), with headroom for load-dependent flakes
+# (bench-supervisor probes on one CPU core).
+BASELINE_DOTS=${ORYX_TIER1_BASELINE:-700}
 
 # --- oryxlint static analysis (fast, jax-free: fail before pytest) ----------
 # Repo-wide by default; ORYX_LINT_CHANGED=1 lints only files changed vs
@@ -51,7 +51,7 @@ fi
 echo "oryxlint report artifact: $ORYX_LINT_REPORT"
 
 # --- ROADMAP.md "Tier-1 verify", verbatim -----------------------------------
-bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
+bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 960 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 rc=$?
 # ----------------------------------------------------------------------------
 

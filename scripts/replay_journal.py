@@ -68,7 +68,7 @@ VOLATILE_FIELDS = ("seq", "ts_unix_s")
 GEOMETRY_KEYS = (
     "num_slots", "page_size", "chunk", "max_ctx", "num_pages", "seed",
     "prefill_chunk", "prefix_cache", "ragged", "speculate", "kv_dtype",
-    "host_cache_bytes", "degraded_clamp_tokens",
+    "host_cache_bytes", "degraded_clamp_tokens", "fuse_steps",
 )
 OVERRIDE_KEYS = GEOMETRY_KEYS + ("faults_spec",)
 
@@ -206,6 +206,16 @@ def run_replay(header: dict[str, Any], entries: list[dict[str, Any]], *,
     if pipe is None:
         pipe = build_tiny_pipe()
     kw = {k: cfg[k] for k in GEOMETRY_KEYS if k in cfg}
+    # The draft model is part of the recorded machine: its source spec
+    # (an init:V:D:W:SEED string or a checkpoint path) is stamped in the
+    # header, and device-side speculation replays bit-for-bit only with
+    # the same weights.
+    drafter = None
+    if cfg.get("draft_model"):
+        from oryx_tpu.models import generate as generate_lib
+
+        drafter = generate_lib.NeuralDrafter.from_spec(cfg["draft_model"])
+        kw["drafter"] = drafter
     journal = journal_lib.DecisionJournal(
         None, keep=max(4096, 4 * len(entries) + 8 * len(plan)),
     )
@@ -249,6 +259,19 @@ def run_replay(header: dict[str, Any], entries: list[dict[str, Any]], *,
                 )
 
     sched.replay_feeder = feeder
+    # Adaptive fused-K reads queue depth, which is wall-clock-coupled:
+    # the journal records the K actually chosen at each megastep
+    # (fused_k on the fused_j==0 step entry), and replay re-applies that
+    # plan instead of re-deriving it. A fuse_steps override drops the
+    # plan — the what-if runs the overridden policy from scratch.
+    if not (overrides and "fuse_steps" in overrides):
+        plan_k = {
+            int(e["step"]) - 1: int(e["fused_k"])
+            for e in entries
+            if e.get("kind") == "step" and e.get("fused_j") == 0
+        }
+        if plan_k:
+            sched.replay_fuse_plan = plan_k
     sched.start()
     # The supervisor is part of the recorded machine: a journaled
     # engine_crash fault must revive and restart-replay exactly as the
