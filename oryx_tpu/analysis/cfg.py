@@ -1,0 +1,458 @@
+"""Intraprocedural control-flow graphs over the stdlib AST.
+
+The AST-visitor rules (locks, hostsync, swallow, ...) see *syntax*; the
+dataflow tier's rules (key-linearity, terminal-path, replay-taint) need
+*paths*: which statements can execute before this one, which exits a
+function has, what an `except` handler can observe. This module turns
+one function body into basic blocks and edges — including the edges the
+bug history cares about: early returns, `raise`, exception flow into
+handlers, `finally` on every leaving path, loop back edges and
+`continue`/`break`.
+
+Design rules (shared with core.py): stdlib-only, source-level, small
+enough to run over the whole tree inside the lint time budget.
+
+Model:
+
+  * A `Block` holds a straight-line list of *elements*: simple
+    statements verbatim, the evaluated expression of compound-statement
+    headers (`if`/`while` tests, `for` iterables), and `Bind` records
+    for implicit assignments (`for` targets, `with ... as x`,
+    `except E as e`). Compound statements NEVER appear whole — their
+    bodies live in successor blocks — so a transfer function can walk
+    every element wholesale without double-seeing nested code.
+  * Exceptions: only explicit `raise` statements and try-body flow into
+    handlers are modeled. Inside a `try` with handlers every element
+    ends its block and edges to EVERY handler (any statement may raise,
+    and static type matching is not attempted) — sound for both must-
+    and may-analyses. Implicit raises outside a `try` (any call can
+    throw) are deliberately NOT exits: modeling them would drown the
+    terminal-path rule in noise. `assert` is treated as straight-line
+    for the same reason.
+  * `finally` bodies are INLINED (rebuilt) on every leaving edge —
+    normal fall-through, `return`, `raise`, `break`, `continue` — the
+    same duplication CPython's own compiler performs, so a discharge
+    inside a `finally` proves every exit path.
+  * Exits are virtual: `Exit(kind, node)` with kind one of `return`,
+    `raise`, `implicit` (falling off the end), and — in `loop_body`
+    mode, used by the terminal-path rule's per-iteration obligations —
+    `continue`, `fallthrough` (reaching the next iteration) and
+    `break`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class Bind:
+    """An implicit assignment: `for TARGET in ...`, `with ... as
+    TARGET`, `except E as name`. `value` is the source expression when
+    the binding has one (`for`'s iterable, `with`'s context manager);
+    None marks an opaque bind (the exception object)."""
+
+    target: ast.expr | None
+    value: ast.expr | None
+    node: ast.AST  # anchor (lineno) — the owning compound statement
+    kind: str  # "for" | "with" | "except"
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+# One block element: a simple statement, a header expression, or a Bind.
+Element = object
+
+
+class Block:
+    __slots__ = ("id", "elems", "succs")
+
+    def __init__(self, bid: int):
+        self.id = bid
+        self.elems: list[Element] = []
+        self.succs: list["Block"] = []
+
+    def edge(self, other: "Block") -> None:
+        if other not in self.succs:
+            self.succs.append(other)
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"Block({self.id}, elems={len(self.elems)}, " \
+               f"succs={[b.id for b in self.succs]})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Exit:
+    """One way out: `block` is the (terminated) block whose out-state
+    holds at the exit; `node` anchors the finding (the Return/Raise
+    statement, the `continue`, or — for implicit/fallthrough — the last
+    element executed, falling back to the owning body)."""
+
+    block: Block
+    kind: str  # return | raise | implicit | continue | fallthrough | break
+    node: ast.AST
+
+
+class CFG:
+    def __init__(self) -> None:
+        self.blocks: list[Block] = []
+        self.entry: Block | None = None
+        self.exits: list[Exit] = []
+
+    def preds(self) -> dict[int, list[Block]]:
+        out: dict[int, list[Block]] = {b.id: [] for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succs:
+                out[s.id].append(b)
+        return out
+
+    def elements(self) -> Iterator[Element]:
+        for b in self.blocks:
+            yield from b.elems
+
+
+# Statements that run straight through (modeled as opaque elements).
+_SIMPLE = (
+    ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr, ast.Pass,
+    ast.Delete, ast.Import, ast.ImportFrom, ast.Global, ast.Nonlocal,
+    ast.Assert,
+)
+
+
+class _Ctx:
+    """Build context: where `break`/`continue` go, which handler blocks
+    an exception reaches, and the active `finally` stack (innermost
+    last; each entry remembers the ctx to rebuild its body under)."""
+
+    __slots__ = ("break_to", "continue_to", "handlers", "finallies",
+                 "loop_depth")
+
+    def __init__(self, break_to=None, continue_to=None, handlers=(),
+                 finallies=(), loop_depth=0):
+        self.break_to = break_to
+        self.continue_to = continue_to
+        self.handlers = handlers  # tuple[Block, ...]
+        self.finallies = finallies  # tuple[(body, _Ctx), ...]
+        self.loop_depth = loop_depth  # len(finallies) at loop entry
+
+    def replace(self, **kw) -> "_Ctx":
+        new = _Ctx(self.break_to, self.continue_to, self.handlers,
+                   self.finallies, self.loop_depth)
+        for k, v in kw.items():
+            setattr(new, k, v)
+        return new
+
+
+class _Builder:
+    def __init__(self, loop_body: bool):
+        self.cfg = CFG()
+        self.loop_body = loop_body
+        self._n = 0
+
+    def new_block(self) -> Block:
+        b = Block(self._n)
+        self._n += 1
+        self.cfg.blocks.append(b)
+        return b
+
+    # -- elements ----------------------------------------------------------
+
+    def _emit(self, cur: Block, elem: Element, ctx: _Ctx) -> Block:
+        """Append one element; inside a try-with-handlers every element
+        terminates its block and edges to each handler, so a handler's
+        in-state joins every point the body could raise from."""
+        cur.elems.append(elem)
+        if ctx.handlers:
+            nxt = self.new_block()
+            cur.edge(nxt)
+            for h in ctx.handlers:
+                cur.edge(h)
+            return nxt
+        return cur
+
+    # -- abrupt edges ------------------------------------------------------
+
+    def _through_finallies(self, cur: Block, ctx: _Ctx,
+                           upto: int = 0) -> Block:
+        """Inline the active `finally` bodies, innermost first, down to
+        stack depth `upto`; returns the block control leaves from."""
+        for body, fctx in reversed(ctx.finallies[upto:]):
+            entry = self.new_block()
+            cur.edge(entry)
+            nxt = self._seq(body, entry, fctx)
+            if nxt is None:  # the finally itself never falls through
+                return None
+            cur = nxt
+        return cur
+
+    def _exit(self, cur: Block, node: ast.AST, kind: str,
+              ctx: _Ctx) -> None:
+        cur = self._through_finallies(cur, ctx, upto=0)
+        if cur is not None:
+            self.cfg.exits.append(Exit(cur, kind, node))
+
+    def _jump(self, cur: Block, node: ast.AST, ctx: _Ctx,
+              target: Block | None, kind: str) -> None:
+        """break/continue: through finallies down to the loop's level,
+        then to the loop-supplied target (or a loop_body-mode exit)."""
+        cur = self._through_finallies(cur, ctx, upto=ctx.loop_depth)
+        if cur is None:
+            return
+        if target is not None:
+            cur.edge(target)
+        else:
+            self.cfg.exits.append(Exit(cur, kind, node))
+
+    # -- statement sequencing ----------------------------------------------
+
+    def _seq(self, stmts: list[ast.stmt], cur: Block,
+             ctx: _Ctx) -> Block | None:
+        """Build `stmts` from `cur`; returns the open fall-through
+        block, or None when no path falls out the end."""
+        for stmt in stmts:
+            if cur is None:
+                return None  # unreachable tail (after return/raise)
+            cur = self._stmt(stmt, cur, ctx)
+        return cur
+
+    def _stmt(self, stmt: ast.stmt, cur: Block,
+              ctx: _Ctx) -> Block | None:
+        if isinstance(stmt, _SIMPLE):
+            return self._emit(cur, stmt, ctx)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # Nested definitions are data here, not control flow; the
+            # checkers analyze each function scope separately.
+            return self._emit(cur, stmt, ctx)
+        if isinstance(stmt, ast.Return):
+            cur = self._emit(cur, stmt, ctx)
+            self._exit(cur, stmt, "return", ctx)
+            return None
+        if isinstance(stmt, ast.Raise):
+            cur = self._emit(cur, stmt, ctx)
+            if ctx.handlers:
+                return None  # _emit already edged into the handlers
+            self._exit(cur, stmt, "raise", ctx)
+            return None
+        if isinstance(stmt, ast.Break):
+            self._jump(cur, stmt, ctx, ctx.break_to,
+                       "break" if self.loop_body else "return")
+            return None
+        if isinstance(stmt, ast.Continue):
+            self._jump(cur, stmt, ctx, ctx.continue_to, "continue")
+            return None
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, cur, ctx)
+        if isinstance(stmt, (ast.While,)):
+            return self._while(stmt, cur, ctx)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._for(stmt, cur, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, cur, ctx)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, cur, ctx)
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, cur, ctx)
+        # Unknown statement kind: treat as straight-line.
+        return self._emit(cur, stmt, ctx)
+
+    def _if(self, stmt: ast.If, cur, ctx):
+        cur = self._emit(cur, stmt.test, ctx)
+        join = self.new_block()
+        then_entry = self.new_block()
+        cur.edge(then_entry)
+        then_out = self._seq(stmt.body, then_entry, ctx)
+        if then_out is not None:
+            then_out.edge(join)
+        if stmt.orelse:
+            else_entry = self.new_block()
+            cur.edge(else_entry)
+            else_out = self._seq(stmt.orelse, else_entry, ctx)
+            if else_out is not None:
+                else_out.edge(join)
+        else:
+            cur.edge(join)
+        return join
+
+    def _while(self, stmt: ast.While, cur, ctx):
+        head = self.new_block()
+        cur.edge(head)
+        head2 = self._emit(head, stmt.test, ctx)
+        body_entry = self.new_block()
+        after = self.new_block()
+        head2.edge(body_entry)
+        # `while True:` never falls out of the loop on its own.
+        infinite = (
+            isinstance(stmt.test, ast.Constant) and stmt.test.value
+        )
+        inner = ctx.replace(
+            break_to=after, continue_to=head,
+            loop_depth=len(ctx.finallies),
+        )
+        body_out = self._seq(stmt.body, body_entry, inner)
+        if body_out is not None:
+            body_out.edge(head)  # back edge
+        if not infinite:
+            if stmt.orelse:
+                else_entry = self.new_block()
+                head2.edge(else_entry)
+                else_out = self._seq(stmt.orelse, else_entry, ctx)
+                if else_out is not None:
+                    else_out.edge(after)
+            else:
+                head2.edge(after)
+        return after
+
+    def _for(self, stmt, cur, ctx):
+        cur = self._emit(cur, stmt.iter, ctx)
+        head = self.new_block()
+        cur.edge(head)
+        body_entry = self.new_block()
+        after = self.new_block()
+        head.edge(body_entry)
+        bind = Bind(stmt.target, stmt.iter, stmt, "for")
+        inner = ctx.replace(
+            break_to=after, continue_to=head,
+            loop_depth=len(ctx.finallies),
+        )
+        body_entry2 = self._emit(body_entry, bind, inner)
+        body_out = self._seq(stmt.body, body_entry2, inner)
+        if body_out is not None:
+            body_out.edge(head)  # back edge
+        if stmt.orelse:
+            else_entry = self.new_block()
+            head.edge(else_entry)
+            else_out = self._seq(stmt.orelse, else_entry, ctx)
+            if else_out is not None:
+                else_out.edge(after)
+        else:
+            head.edge(after)
+        return after
+
+    def _with(self, stmt, cur, ctx):
+        for item in stmt.items:
+            bind = Bind(item.optional_vars, item.context_expr, stmt,
+                        "with")
+            cur = self._emit(cur, bind, ctx)
+        return self._seq(stmt.body, cur, ctx)
+
+    def _try(self, stmt: ast.Try, cur, ctx):
+        after = self.new_block()
+        body_ctx = ctx
+        if stmt.finalbody:
+            body_ctx = body_ctx.replace(
+                finallies=ctx.finallies + ((stmt.finalbody, ctx),),
+            )
+        handler_entries: list[Block] = []
+        handler_outs: list[Block] = []
+        if stmt.handlers:
+            for h in stmt.handlers:
+                handler_entries.append(self.new_block())
+            body_ctx = body_ctx.replace(
+                handlers=tuple(handler_entries),
+            )
+        body_out = self._seq(stmt.body, cur, body_ctx)
+        # else: runs only on normal body completion, OUTSIDE the
+        # handlers' protection but inside the finally's.
+        else_ctx = ctx if not stmt.finalbody else ctx.replace(
+            finallies=ctx.finallies + ((stmt.finalbody, ctx),),
+        )
+        if body_out is not None and stmt.orelse:
+            body_out = self._seq(stmt.orelse, body_out, else_ctx)
+        # Handlers run with the try's context minus themselves (a raise
+        # inside a handler escapes to the OUTER try), plus the finally.
+        for h, entry in zip(stmt.handlers, handler_entries):
+            hctx = else_ctx
+            b = self._emit(
+                entry, Bind(None, None, h, "except"), hctx
+            )
+            h_out = self._seq(h.body, b, hctx)
+            if h_out is not None:
+                handler_outs.append(h_out)
+        outs = ([body_out] if body_out is not None else []) + \
+            handler_outs
+        if not outs:
+            return None
+        if stmt.finalbody:
+            merged = self.new_block()
+            for o in outs:
+                o.edge(merged)
+            return self._seq(stmt.finalbody, merged, ctx)
+        for o in outs:
+            o.edge(after)
+        return after
+
+    def _match(self, stmt, cur, ctx):
+        cur = self._emit(cur, stmt.subject, ctx)
+        join = self.new_block()
+        exhaustive = False
+        for case in stmt.cases:
+            entry = self.new_block()
+            cur.edge(entry)
+            out = self._seq(case.body, entry, ctx)
+            if out is not None:
+                out.edge(join)
+            if isinstance(case.pattern, ast.MatchAs) \
+                    and case.pattern.pattern is None:
+                exhaustive = True  # `case _:` — no fall-past edge
+        if not exhaustive:
+            cur.edge(join)
+        return join
+
+
+def _prune(cfg: CFG) -> CFG:
+    """Drop blocks unreachable from entry (e.g. join blocks both of
+    whose arms returned) so fixpoints never see them."""
+    seen: set[int] = set()
+    stack = [cfg.entry]
+    while stack:
+        b = stack.pop()
+        if b is None or b.id in seen:
+            continue
+        seen.add(b.id)
+        stack.extend(b.succs)
+    cfg.blocks = [b for b in cfg.blocks if b.id in seen]
+    cfg.exits = [e for e in cfg.exits if e.block.id in seen]
+    return cfg
+
+
+def _last_anchor(block: Block, fallback: ast.AST) -> ast.AST:
+    for elem in reversed(block.elems):
+        node = elem.node if isinstance(elem, Bind) else elem
+        if getattr(node, "lineno", None):
+            return node
+    return fallback
+
+
+def build_cfg(stmts: list[ast.stmt], *, loop_body: bool = False,
+              anchor: ast.AST | None = None) -> CFG:
+    """CFG of a statement list (a function body, or — loop_body=True —
+    one loop iteration: `continue` and falling off the end become
+    `continue`/`fallthrough` exits, `break` a `break` exit, and
+    return/raise keep their own kinds)."""
+    builder = _Builder(loop_body)
+    cfg = builder.cfg
+    cfg.entry = builder.new_block()
+    ctx = _Ctx()
+    out = builder._seq(stmts, cfg.entry, ctx)
+    if out is not None:
+        kind = "fallthrough" if loop_body else "implicit"
+        node = _last_anchor(out, anchor or (stmts[-1] if stmts else
+                                            ast.Pass()))
+        cfg.exits.append(Exit(out, kind, node))
+    return _prune(cfg)
+
+
+def function_cfg(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    return build_cfg(fn.body, anchor=fn)
+
+
+def loop_cfg(loop: ast.For | ast.While) -> CFG:
+    """One iteration of `loop`'s body — the terminal-path rule's
+    per-iteration obligation domain. `break` paths surface as `break`
+    exits (reported or not is the rule's call)."""
+    return build_cfg(loop.body, loop_body=True, anchor=loop)

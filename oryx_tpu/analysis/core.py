@@ -37,10 +37,8 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import io
 import json
 import re
-import tokenize
 from typing import Any, Callable, Iterable, Iterator
 
 
@@ -82,23 +80,173 @@ class ParsedModule:
         self.file_disables: set[str] = set()
         # line (1-based) -> rules suppressed on that line.
         self.line_disables: dict[int, set[str]] = {}
+        # Lazy shared walk index (walk()/parent()): built on first use.
+        # Initialized BEFORE suppression parsing — the comment scanner
+        # reads string-literal spans through nodes_of().
+        self._preorder: list[ast.AST] | None = None
+        self._spans: dict[int, tuple[int, int]] = {}
+        self._parents: dict[int, ast.AST] = {}
+        self._by_type: dict[type, list[ast.AST]] = {}
         self._parse_suppressions()
 
-    def _comments_by_line(self) -> dict[int, str]:
-        """line (1-based) -> comment text. Tokenized, not regexed over
-        raw lines, so a docstring or string literal QUOTING the
-        directive syntax (this module's own docstring does) can never
-        disable rules."""
-        out: dict[int, str] = {}
-        try:
-            for tok in tokenize.generate_tokens(
-                io.StringIO(self.source).readline
-            ):
-                if tok.type == tokenize.COMMENT:
-                    out[tok.start[0]] = tok.string
-        except (tokenize.TokenError, IndentationError):
-            pass  # ast.parse succeeded; truncated tail tokens only
+    def _build_walk_index(self) -> None:
+        """One DFS over the tree: preorder list + per-node subtree
+        spans + parent links. Every checker walks the same tree many
+        times (whole-module scans, per-function passes, per-statement
+        taint checks); `ast.walk` re-derives children through getattr
+        reflection on every call, which dominates lint wall time on a
+        big tree. Amortizing it here is what keeps the repo-wide run
+        inside the CI `--time-budget`."""
+        # Pass 1: iterative preorder + parent links, with child
+        # discovery inlined (getattr over _fields — no per-node
+        # generator frames, which dominate an ast.iter_child_nodes
+        # formulation at this scale).
+        parents = self._parents
+        order: list[ast.AST] = []
+        AST, append, pop = ast.AST, order.append, None
+        stack: list[ast.AST] = [self.tree]
+        pop = stack.pop
+        push = stack.append
+        while stack:
+            node = pop()
+            append(node)
+            for name in reversed(node._fields):
+                field = getattr(node, name, None)
+                if field.__class__ is list:
+                    for item in reversed(field):
+                        if isinstance(item, AST):
+                            parents[id(item)] = node
+                            push(item)
+                elif isinstance(field, AST):
+                    parents[id(field)] = node
+                    push(field)
+        # Pass 2: subtree spans. In preorder every node precedes its
+        # descendants, so a reverse sweep folding each node's end into
+        # its parent yields [start, end) without tracking frames.
+        n = len(order)
+        index = {id(node): i for i, node in enumerate(order)}
+        ends = list(range(1, n + 1))
+        for i in range(n - 1, 0, -1):
+            pi = index[id(parents[id(order[i])])]
+            if ends[i] > ends[pi]:
+                ends[pi] = ends[i]
+        spans = self._spans
+        by_type = self._by_type
+        for i, node in enumerate(order):
+            spans[id(node)] = (i, ends[i])
+            cls = node.__class__
+            bucket = by_type.get(cls)
+            if bucket is None:
+                bucket = by_type[cls] = []
+            bucket.append(node)
+        self._preorder = order
+
+    def walk(self, node: ast.AST | None = None) -> list[ast.AST]:
+        """All nodes of `node`'s subtree (default: the whole module),
+        `node` included, in preorder. Amortized O(subtree): the index
+        is one DFS per module, a subtree walk is a list slice. Falls
+        back to `ast.walk` for nodes synthesized outside this tree."""
+        if self._preorder is None:
+            self._build_walk_index()
+        if node is None or node is self.tree:
+            return self._preorder
+        span = self._spans.get(id(node))
+        if span is None:
+            return list(ast.walk(node))
+        return self._preorder[span[0]:span[1]]
+
+    def nodes_of(self, *types: type) -> list[ast.AST]:
+        """Every node in the module whose class is exactly one of
+        `types`, in preorder. The module-wide `for n in walk(): if
+        isinstance(n, T)` scans are the bulk of lint time on a big
+        tree; this is the same loop precomputed."""
+        if self._preorder is None:
+            self._build_walk_index()
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, ()))
+        if len(types) > 1 and out:
+            spans = self._spans
+            out.sort(key=lambda n: spans[id(n)][0])
         return out
+
+    def subtree_size(self, node: ast.AST) -> int:
+        """Node count of `node`'s subtree (itself included) — lets a
+        preorder consumer skip a subtree in O(1)."""
+        if self._preorder is None:
+            self._build_walk_index()
+        span = self._spans.get(id(node))
+        if span is None:
+            return 1
+        return span[1] - span[0]
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        """The AST parent of `node` (None for the root or for nodes
+        not from this tree). Same shared index as walk()."""
+        if self._preorder is None:
+            self._build_walk_index()
+        return self._parents.get(id(node))
+
+    def _comments_by_line(self) -> dict[int, str]:
+        """line (1-based) -> comment text. A `#` counts as a comment
+        only OUTSIDE every string-literal span of the parsed tree, so a
+        docstring or string literal QUOTING the directive syntax (this
+        module's own docstring does) can never disable rules. The AST
+        span mask replaces a full tokenize pass — same answer at a
+        fraction of the cost, since only lines containing `#` are ever
+        inspected."""
+        out: dict[int, str] = {}
+        lines = self.lines
+        cand = [i for i, l in enumerate(lines, 1) if "#" in l]
+        if not cand:
+            return out
+        big = 1 << 30
+        masks: dict[int, list[tuple[int, int]]] = {}
+        for node in self.nodes_of(ast.Constant, ast.JoinedStr):
+            if isinstance(node, ast.Constant) and not isinstance(
+                node.value, (str, bytes)
+            ):
+                continue
+            sl, el = node.lineno, node.end_lineno
+            sc, ec = node.col_offset, node.end_col_offset
+            if sl == el:
+                masks.setdefault(sl, []).append((sc, ec))
+            else:
+                masks.setdefault(sl, []).append((sc, big))
+                for ln in range(sl + 1, el):
+                    masks.setdefault(ln, []).append((0, big))
+                masks.setdefault(el, []).append((0, ec))
+        for ln in cand:
+            text = lines[ln - 1]
+            mask = masks.get(ln)
+            if mask is not None and not text.isascii():
+                # AST col offsets are UTF-8 byte offsets: compare in
+                # byte space when the line mixes strings and non-ASCII.
+                raw = text.encode("utf-8")
+                pos = raw.find(b"#")
+                while pos != -1:
+                    if not any(s <= pos < e for s, e in mask):
+                        out[ln] = raw[pos:].decode("utf-8")
+                        break
+                    pos = raw.find(b"#", pos + 1)
+                continue
+            pos = text.find("#")
+            while pos != -1:
+                if mask is None or not any(
+                    s <= pos < e for s, e in mask
+                ):
+                    out[ln] = text[pos:]
+                    break
+                pos = text.find("#", pos + 1)
+        return out
+
+    def comments(self) -> dict[int, str]:
+        """line (1-based) -> comment text, only lines that HAVE one —
+        for checkers scanning every comment in a file (iterating this
+        beats probing comment_text per source line)."""
+        return self._comments
 
     def comment_text(self, line: int) -> str:
         """The comment on `line` ('' when none) — checkers read markers
@@ -204,11 +352,7 @@ _FIELD_DECL_RE = re.compile(
 
 
 def class_line_span(cls: ast.ClassDef) -> tuple[int, int]:
-    end = max(
-        (getattr(n, "end_lineno", cls.lineno) for n in ast.walk(cls)),
-        default=cls.lineno,
-    )
-    return cls.lineno, end
+    return cls.lineno, getattr(cls, "end_lineno", cls.lineno) or cls.lineno
 
 
 def field_annotations(
@@ -256,6 +400,18 @@ class LintResult:
     errors: list[tuple[str, str]]  # (path, parse error)
     files: int
     suppressed: int
+    # rule -> suppression count; the per-rule ratchet
+    # (`--max-suppressions-per-rule`) reads this so a NEW rule can be
+    # pinned at 0 escapes while the global ratchet stays loose.
+    suppressed_by_rule: dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def findings_by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
 
 
 def run_lint(
@@ -287,6 +443,7 @@ def run_lint(
             checker.scan(mod, ctx)
     findings: list[Finding] = []
     suppressed = 0
+    suppressed_by_rule: dict[str, int] = {}
     checked = [
         m for m in mods
         if check_only is None or m.path in check_only
@@ -296,10 +453,16 @@ def run_lint(
             for f in checker.check(mod, ctx):
                 if f is None:
                     suppressed += 1
+                    suppressed_by_rule[checker.name] = (
+                        suppressed_by_rule.get(checker.name, 0) + 1
+                    )
                 else:
                     findings.append(f)
     findings.sort()
-    return LintResult(findings, errors, len(checked), suppressed)
+    return LintResult(
+        findings, errors, len(checked), suppressed,
+        suppressed_by_rule,
+    )
 
 
 def render_text(result: LintResult) -> str:
@@ -320,6 +483,10 @@ def render_text(result: LintResult) -> str:
 
 
 def render_json(result: LintResult) -> str:
+    findings_by_rule = result.findings_by_rule()
+    rules = sorted(
+        set(findings_by_rule) | set(result.suppressed_by_rule)
+    )
     return json.dumps(
         {
             "findings": [f.to_dict() for f in result.findings],
@@ -328,6 +495,15 @@ def render_json(result: LintResult) -> str:
             ],
             "files": result.files,
             "suppressed": result.suppressed,
+            # Per-rule breakdown: what the CI artifact diffs and the
+            # per-rule suppression ratchet gates on.
+            "by_rule": {
+                r: {
+                    "findings": findings_by_rule.get(r, 0),
+                    "suppressed": result.suppressed_by_rule.get(r, 0),
+                }
+                for r in rules
+            },
         },
         indent=2,
     )

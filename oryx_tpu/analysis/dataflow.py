@@ -1,0 +1,133 @@
+"""Forward dataflow fixpoints over cfg.py graphs.
+
+One engine, two lattices:
+
+  * **may** (union join): a fact holds if it holds on ANY path in —
+    the shape for "this key was already consumed somewhere" and "this
+    value is tainted". Missing facts are safe.
+  * **must** (intersection join): a fact holds only if it holds on
+    EVERY path in — the shape for "this obligation was discharged".
+    Extra facts are unsafe, so unreached predecessors contribute
+    nothing and the meet runs over reached predecessors only.
+
+States are frozensets of checker-defined facts; both joins are
+monotone over a finite fact universe (facts name syntax sites), so the
+worklist terminates. Analyses implement one method:
+
+    transfer(elem, state) -> state
+
+applied to each block element in order (cfg.py guarantees elements are
+simple statements / header expressions / Bind records — never whole
+compound statements). After `run()`, `in_states[block.id]` holds the
+join at block entry; `replay(block)` re-walks a block yielding
+(elem, state_before_elem) so checkers can emit findings against the
+converged solution instead of mid-iteration noise.
+"""
+
+from __future__ import annotations
+
+from .cfg import CFG, Block, Element
+
+State = frozenset
+
+
+class ForwardAnalysis:
+    """Subclass and implement `transfer`; pick the join with
+    `may=True` (union) or `may=False` (intersection/must)."""
+
+    may = True
+
+    def initial(self) -> State:
+        """State at function entry."""
+        return frozenset()
+
+    def transfer(self, elem: Element, state: State) -> State:
+        raise NotImplementedError
+
+    # -- engine ------------------------------------------------------------
+
+    def _block_out(self, block: Block, state: State) -> State:
+        for elem in block.elems:
+            state = self.transfer(elem, state)
+        return state
+
+    def run(self, cfg: CFG) -> dict[int, State]:
+        preds = cfg.preds()
+        in_states: dict[int, State] = {}
+        out_states: dict[int, State] = {}
+        if cfg.entry is None:
+            self.in_states = in_states
+            return in_states
+        in_states[cfg.entry.id] = self.initial()
+        worklist = [cfg.entry]
+        queued = {cfg.entry.id}
+        while worklist:
+            block = worklist.pop()
+            queued.discard(block.id)
+            if block.id not in in_states:
+                # Reachable only through blocks not yet processed.
+                continue
+            out = self._block_out(block, in_states[block.id])
+            if out_states.get(block.id) == out:
+                continue
+            out_states[block.id] = out
+            for succ in block.succs:
+                ins = [
+                    out_states[p.id] for p in preds[succ.id]
+                    if p.id in out_states
+                ]
+                if self.may:
+                    joined = frozenset().union(*ins) if ins \
+                        else frozenset()
+                else:
+                    joined = frozenset.intersection(*ins) if ins \
+                        else frozenset()
+                if in_states.get(succ.id) != joined:
+                    in_states[succ.id] = joined
+                    if succ.id not in queued:
+                        worklist.append(succ)
+                        queued.add(succ.id)
+                elif succ.id not in out_states:
+                    if succ.id not in queued:
+                        worklist.append(succ)
+                        queued.add(succ.id)
+        self.in_states = in_states
+        self.out_states = out_states
+        return in_states
+
+    def replay(self, block: Block):
+        """Yield (elem, state_before_elem) under the converged
+        solution — the reporting pass. Unreached blocks yield
+        nothing."""
+        state = self.in_states.get(block.id)
+        if state is None:
+            return
+        for elem in block.elems:
+            yield elem, state
+            state = self.transfer(elem, state)
+
+    def exit_state(self, block: Block) -> State | None:
+        """Out-state of `block` (where an Exit's facts are read);
+        None if the block was never reached."""
+        state = self.in_states.get(block.id)
+        if state is None:
+            return None
+        return self._block_out(block, state)
+
+
+class GenKill(ForwardAnalysis):
+    """Convenience for per-element gen/kill analyses: implement
+    `gen(elem, state)` and `kill(elem, state)` returning iterables of
+    facts; transfer is (state - kill) | gen, with gen computed against
+    the PRE-kill state so a fact can observe what it replaces."""
+
+    def gen(self, elem: Element, state: State):
+        return ()
+
+    def kill(self, elem: Element, state: State):
+        return ()
+
+    def transfer(self, elem: Element, state: State) -> State:
+        gen = frozenset(self.gen(elem, state))
+        kill = frozenset(self.kill(elem, state))
+        return (state - kill) | gen

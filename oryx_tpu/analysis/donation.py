@@ -93,7 +93,9 @@ class UseAfterDonateChecker(Checker):
     # ---- pass 1: build the donation registry -----------------------------
 
     def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.Assign
+        ):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 params = [a.arg for a in node.args.args]
                 ctx.fn_params.setdefault(node.name, params)
@@ -166,9 +168,10 @@ class UseAfterDonateChecker(Checker):
     def check(
         self, mod: ParsedModule, ctx: RepoContext
     ) -> Iterator[Finding | None]:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield from self._check_fn(mod, node, ctx)
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef
+        ):
+            yield from self._check_fn(mod, node, ctx)
 
     def _check_fn(
         self, mod: ParsedModule, fn: ast.FunctionDef, ctx: RepoContext

@@ -58,16 +58,16 @@ class HostSyncChecker(Checker):
     def check(
         self, mod: ParsedModule, ctx: RepoContext
     ) -> Iterator[Finding | None]:
-        for node in ast.walk(mod.tree):
-            if isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef)
-            ) and is_hot(mod, node):
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef
+        ):
+            if is_hot(mod, node):
                 yield from self._check_fn(mod, node)
 
     def _check_fn(
         self, mod: ParsedModule, fn: ast.FunctionDef
     ) -> Iterator[Finding | None]:
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             msg = self._sync_reason(node)

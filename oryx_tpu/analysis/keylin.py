@@ -1,0 +1,576 @@
+"""key-linearity: JAX PRNG keys are linear — consume once, then re-bind.
+
+Every byte-parity guarantee the engine sells (greedy parity, spec
+rollback, fused replay) assumes PRNG keys are used linearly: a key is
+split or sampled from exactly once, and fresh subkeys are re-bound
+before the next consume. Reusing a consumed key is the classic silent
+correctness bug — outputs correlate across sites that must be
+independent, and nothing crashes.
+
+The rule runs a may-dataflow over the function CFG (cfg.py):
+
+  * a parameter with a key-ish name ({key, keys, rng, ...} or
+    `*_key`/`*_keys`), or a local assigned from a producer
+    (`jax.random.split`/`fold_in`/`PRNGKey`/..., including
+    `jax.vmap(lambda k: jax.random.split(k, n))(keys)`), is tracked;
+  * a *consume* is a tracked name passed BARE to a registered consumer:
+    `jax.random` derive ops (split/fold_in — they retire the operand)
+    and draw ops (uniform/categorical/...), the vmap-wrapped forms, and
+    repo functions discovered by the scan pass (a function whose key-ish
+    parameter it consumes — found transitively, the lockorder.py
+    call-summary idiom — consumes its caller's key: `sample_token_rows`,
+    `spec_verify_rows`, ...). Subscripts/slices (`ks[i]`, `pair[:, 1]`)
+    are non-consuming projections of already-derived material;
+  * assignment to a name KILLS its facts (the `key, sk =
+    jax.random.split(key)` re-bind idiom), and `a = key` moves rather
+    than copies;
+  * two consumes reaching the same point (sequentially or on both arms
+    of a join that later merges) is a finding — EXCEPT the lane-split
+    contract generate.py is built on: two derives of the same op and
+    width whose results are consumed through disjoint constant lanes
+    (`split(k, 2)` used via `[:, 1]` here and `[:, 0]` there) partition
+    the key material and are legal. Draws never partition.
+
+Nested `def`s and lambdas are separate scopes (closure reuse inside a
+`lax.scan` body is that scope's contract, analyzed separately), so the
+fused-scan key chain validates instead of needing suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from .cfg import Bind, build_cfg
+from .core import Checker, Finding, ParsedModule, RepoContext, dotted_name
+from .dataflow import ForwardAnalysis
+
+# jax.random ops that retire their key operand and hand back fresh key
+# material (derives) vs ops that draw samples (draws). Matched as
+# `<prefix>.<op>` where the prefix's last component is `random` (so
+# `jax.random.split` and `jrandom.split` match; the stdlib `random`
+# module has no `split`/`fold_in` and its draw names are claimed by
+# replay-taint, not this rule).
+DERIVE_OPS = {"split", "fold_in", "clone"}
+DRAW_OPS = {
+    "uniform", "normal", "bernoulli", "categorical", "gumbel", "bits",
+    "randint", "truncated_normal", "exponential", "beta", "gamma",
+    "poisson", "choice", "permutation", "ball", "cauchy", "dirichlet",
+    "laplace", "logistic", "loggamma", "maxwell", "multivariate_normal",
+    "orthogonal", "rademacher", "rayleigh", "t", "weibull_min",
+}
+# Ops that CREATE keys from seeds (producers that consume nothing).
+CREATE_OPS = {"key", "PRNGKey", "wrap_key_data"}
+
+KEYISH_NAMES = {"key", "keys", "rng", "prng_key", "rng_key", "subkey",
+                "subkeys"}
+
+
+def is_keyish(name: str) -> bool:
+    return (
+        name in KEYISH_NAMES
+        or name.endswith("_key")
+        or name.endswith("_keys")
+    )
+
+
+def _random_op(call: ast.Call) -> str | None:
+    """`jax.random.split(...)` → "split"; None for anything else."""
+    dn = dotted_name(call.func)
+    if not dn or "." not in dn:
+        return None
+    prefix, op = dn.rsplit(".", 1)
+    if prefix.split(".")[-1] != "random" or prefix == "random":
+        return None
+    return op
+
+
+def _const_int(node: ast.AST | None) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsumeSite:
+    """One consume of a key operand: `kind` is "derive" | "draw" |
+    "call"; `width` the constant split width (derives only); `lanes`
+    the constant final-axis lanes the result is consumed through
+    (frozenset, or None = unknown/whole)."""
+
+    line: int
+    col: int
+    kind: str
+    op: str
+    width: int | None
+    lanes: frozenset | None
+
+    def compatible(self, other: "ConsumeSite") -> bool:
+        """May these two consumes of the SAME key coexist? Only the
+        lane-split contract qualifies: same derive op, same known
+        width, disjoint known lanes."""
+        if self.kind != "derive" or other.kind != "derive":
+            return False
+        if self.op != other.op or self.width is None \
+                or self.width != other.width:
+            return False
+        if self.lanes is None or other.lanes is None:
+            return False
+        return not (self.lanes & other.lanes)
+
+
+class _SkipNested(ast.NodeVisitor):
+    """Collect Call nodes in evaluation order, not descending into
+    nested function/lambda bodies (separate scopes) or into a
+    comprehension's element parts beyond their iterables."""
+
+    def __init__(self):
+        self.calls: list[ast.Call] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.calls.append(node)
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return  # separate scope
+
+    def visit_FunctionDef(self, node) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        return
+
+
+def _calls_in(node: ast.AST) -> list[ast.Call]:
+    v = _SkipNested()
+    v.visit(node)
+    return v.calls
+
+
+def _is_vmap(call: ast.Call) -> bool:
+    dn = dotted_name(call.func)
+    return dn is not None and dn.split(".")[-1] == "vmap"
+
+
+class _Classifier:
+    """Maps a Call to the key operands it consumes. `repo_consumers`
+    is the scan pass's registry: simple fn name -> set of (position,
+    param name) key parameters."""
+
+    def __init__(self, repo_consumers: dict[str, set] | None = None):
+        self.repo_consumers = repo_consumers or {}
+
+    def consumed_operands(
+        self, call: ast.Call
+    ) -> list[tuple[ast.expr, str, str, int | None]]:
+        """[(operand expr, kind, op, width)] — operands may be any
+        expression; the caller filters for bare tracked Names."""
+        op = _random_op(call)
+        if op is not None:
+            if op in DERIVE_OPS:
+                operand = self._key_arg(call)
+                if operand is not None:
+                    width = _const_int(
+                        call.args[1] if len(call.args) > 1 else
+                        self._kwarg(call, "num")
+                    )
+                    return [(operand, "derive", op, width)]
+                return []
+            if op in DRAW_OPS:
+                operand = self._key_arg(call)
+                if operand is not None:
+                    return [(operand, "draw", op, None)]
+                return []
+            return []
+        # jax.vmap(lambda k: <consume of k>)(keys): the outer call
+        # consumes `keys` with the lambda body's kind/op/width.
+        if isinstance(call.func, ast.Call) and _is_vmap(call.func) \
+                and call.func.args:
+            mapped = call.func.args[0]
+            if isinstance(mapped, ast.Lambda):
+                params = [a.arg for a in mapped.args.args]
+                out = []
+                for inner in _calls_in_lambda(mapped.body):
+                    for operand, kind, iop, width in \
+                            self.consumed_operands(inner):
+                        if isinstance(operand, ast.Name) \
+                                and operand.id in params:
+                            idx = params.index(operand.id)
+                            if idx < len(call.args):
+                                out.append(
+                                    (call.args[idx], kind, iop, width)
+                                )
+                return out
+            name = dotted_name(mapped)
+            if name:
+                return self._repo_call(call, name.split(".")[-1])
+        dn = dotted_name(call.func)
+        if dn:
+            return self._repo_call(call, dn.split(".")[-1])
+        return []
+
+    def _repo_call(self, call: ast.Call, fname: str):
+        out = []
+        for pos, pname in self.repo_consumers.get(fname, ()):
+            operand = None
+            if pos is not None and pos < len(call.args):
+                operand = call.args[pos]
+            else:
+                operand = self._kwarg(call, pname)
+            if operand is not None:
+                out.append((operand, "call", fname, None))
+        return out
+
+    @staticmethod
+    def _key_arg(call: ast.Call) -> ast.expr | None:
+        if call.args:
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg in ("key", "keys"):
+                return kw.value
+        return None
+
+    @staticmethod
+    def _kwarg(call: ast.Call, name: str) -> ast.expr | None:
+        for kw in call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def produces_keys(self, expr: ast.expr) -> bool:
+        """Does evaluating `expr` yield fresh key material?"""
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value  # projections of key material are keys
+        if not isinstance(expr, ast.Call):
+            return False
+        op = _random_op(expr)
+        if op is not None:
+            return op in DERIVE_OPS or op in CREATE_OPS
+        if isinstance(expr.func, ast.Call) and _is_vmap(expr.func) \
+                and expr.func.args:
+            mapped = expr.func.args[0]
+            if isinstance(mapped, ast.Lambda):
+                return any(
+                    (_random_op(c) or "") in (DERIVE_OPS | CREATE_OPS)
+                    for c in _calls_in_lambda(mapped.body)
+                )
+        return False
+
+
+def _calls_in_lambda(body: ast.expr) -> list[ast.Call]:
+    # The one place we DO look inside a lambda: classifying the
+    # vmap-mapped body itself.
+    return [n for n in ast.walk(body) if isinstance(n, ast.Call)]
+
+
+def _lanes_for_site(
+    call: ast.Call, mod: ParsedModule,
+    subscript_index: dict[str, object],
+) -> frozenset | None:
+    """Which constant final-axis lanes is this derive's result consumed
+    through? `vmap(split)(k)[:, 1]` → {1}; `pair = ...` where `pair`
+    only ever appears as `pair[:, c]` → the set of cs; anything used
+    whole → None."""
+    parent = mod.parent(call)
+    if isinstance(parent, ast.Subscript) and parent.value is call:
+        lane = _final_lane(parent)
+        return frozenset((lane,)) if lane is not None else None
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        targets = parent.targets if isinstance(parent, ast.Assign) \
+            else [parent.target]
+        if len(targets) == 1 and isinstance(targets[0], ast.Name):
+            lanes = subscript_index.get(targets[0].id)
+            if isinstance(lanes, frozenset):
+                return lanes
+    return None
+
+
+def _final_lane(sub: ast.Subscript) -> int | None:
+    idx = sub.slice
+    if isinstance(idx, ast.Tuple) and idx.elts:
+        idx = idx.elts[-1]
+    return _const_int(idx)
+
+
+def _subscript_index(mod: ParsedModule, fn: ast.AST) -> dict[str, object]:
+    """name -> frozenset of constant final lanes, for names ONLY ever
+    read through constant-lane subscripts; any whole/non-constant use
+    maps the name to None."""
+    lanes: dict[str, set] = {}
+    poisoned: set[str] = set()
+    sub_values: set[int] = set()
+    nodes = mod.walk(fn)
+    for node in nodes:
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.value, ast.Name
+        ):
+            sub_values.add(id(node.value))
+            lane = _final_lane(node)
+            if lane is None:
+                poisoned.add(node.value.id)
+            else:
+                lanes.setdefault(node.value.id, set()).add(lane)
+    for node in nodes:
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, ast.Load
+        ) and id(node) not in sub_values:
+            poisoned.add(node.id)
+    out: dict[str, object] = {}
+    for name, ls in lanes.items():
+        out[name] = None if name in poisoned else frozenset(ls)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class _Fn:
+    """Scan-pass summary: one function definition."""
+
+    name: str
+    params: tuple
+    node: ast.AST
+    mod: ParsedModule
+
+
+class _KeyFlow(ForwardAnalysis):
+    """Facts: ("key", var) — var holds live key material;
+    ("used", var, ConsumeSite) — var was consumed at that site on some
+    path. May-analysis (union join)."""
+
+    may = True
+
+    def __init__(self, mod: ParsedModule, fn, classifier: _Classifier):
+        self.mod = mod
+        self.fn = fn
+        self.classifier = classifier
+        self.sub_index = _subscript_index(mod, fn)
+        # (line, col, var) -> conflicting prior site — filled during
+        # transfer; the reporting pass reads it after convergence.
+        self.conflicts: dict[tuple, ConsumeSite] = {}
+
+    def initial(self):
+        args = self.fn.args
+        params = [
+            a.arg for a in
+            args.posonlyargs + args.args + args.kwonlyargs
+        ]
+        return frozenset(
+            ("key", p) for p in params if is_keyish(p)
+        )
+
+    # -- helpers -----------------------------------------------------------
+
+    def _consume(self, state, call: ast.Call):
+        for operand, kind, op, width in \
+                self.classifier.consumed_operands(call):
+            if not isinstance(operand, ast.Name):
+                continue  # projections / expressions: not a bare key
+            var = operand.id
+            if ("key", var) not in state:
+                continue
+            site = ConsumeSite(
+                call.lineno, call.col_offset, kind, op, width,
+                _lanes_for_site(call, self.mod, self.sub_index),
+            )
+            for fact in state:
+                if fact[0] == "used" and fact[1] == var:
+                    prior = fact[2]
+                    if not prior.compatible(site):
+                        key = (site.line, site.col, var)
+                        old = self.conflicts.get(key)
+                        if old is None or prior.line < old.line:
+                            self.conflicts[key] = prior
+            state = state | {("used", var, site)}
+        return state
+
+    def _kill(self, state, var: str):
+        return frozenset(
+            f for f in state
+            if not (f[0] in ("key", "used") and f[1] == var)
+        )
+
+    def _target_names(self, target: ast.expr) -> list[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = []
+            for elt in target.elts:
+                if isinstance(elt, ast.Starred):
+                    elt = elt.value
+                out.extend(self._target_names(elt))
+            return out
+        return []
+
+    def _bind(self, state, targets: list[ast.expr],
+              value: ast.expr | None):
+        names = [n for t in targets for n in self._target_names(t)]
+        produces = value is not None and (
+            self.classifier.produces_keys(value)
+        )
+        moved = (
+            value.id if isinstance(value, ast.Name)
+            and ("key", value.id) in state else None
+        )
+        for n in names:
+            state = self._kill(state, n)
+        if produces or moved:
+            for n in names:
+                state = state | {("key", n)}
+        if moved is not None and moved not in names:
+            state = self._kill(state, moved)  # linear move, not copy
+        return state
+
+    # -- transfer ----------------------------------------------------------
+
+    def transfer(self, elem, state):
+        if isinstance(elem, Bind):
+            # A for-loop's iterable was already consumed when its
+            # header element ran (once, before the first iteration);
+            # the per-iteration Bind must not re-consume it.
+            if elem.value is not None and elem.kind != "for":
+                for call in _calls_in(elem.value):
+                    state = self._consume(state, call)
+            if elem.kind == "for" and elem.target is not None:
+                state = self._bind(state, [elem.target], elem.value)
+            elif elem.target is not None:
+                state = self._bind(state, [elem.target], None)
+            return state
+        for call in _calls_in(elem):
+            state = self._consume(state, call)
+        if isinstance(elem, ast.Assign):
+            return self._bind(state, elem.targets, elem.value)
+        if isinstance(elem, ast.AnnAssign) and elem.value is not None:
+            return self._bind(state, [elem.target], elem.value)
+        if isinstance(elem, ast.AugAssign):
+            for n in self._target_names(elem.target):
+                state = self._kill(state, n)
+        return state
+
+
+class KeyLinearityChecker(Checker):
+    name = "key-linearity"
+
+    def __init__(self) -> None:
+        self._fns: list[_Fn] = []
+        self._consumers: dict[str, set] | None = None
+
+    # -- scan: build the repo consumer registry (transitively) -------------
+
+    def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef
+        ):
+            args = node.args
+            params = tuple(
+                a.arg for a in
+                args.posonlyargs + args.args + args.kwonlyargs
+            )
+            self._fns.append(_Fn(node.name, params, node, mod))
+
+    def _registry(self) -> dict[str, set]:
+        """Fixpoint over function summaries: f consumes its key-ish
+        param p if ANY call in f's body (nested scopes included — a
+        closure consuming the param still consumes it from the
+        caller's view) passes bare `p` to a known consumer. Seeded by
+        the jax.random registry, grown until stable (the lockorder
+        may-acquire idiom)."""
+        if self._consumers is not None:
+            return self._consumers
+        consumers: dict[str, set] = {}
+        # Candidate call lists are re-read every fixpoint round —
+        # compute them once up front.
+        cands = []
+        for fn in self._fns:
+            keyish = {
+                p: i for i, p in enumerate(fn.params)
+                if is_keyish(p)
+            }
+            if not keyish:
+                continue
+            calls = [
+                n for n in fn.mod.walk(fn.node)
+                if isinstance(n, ast.Call)
+            ]
+            cands.append((fn, keyish, calls))
+        changed = True
+        while changed:
+            changed = False
+            clf = _Classifier(consumers)
+            for fn, keyish, calls in cands:
+                have = consumers.get(fn.name, set())
+                for call in calls:
+                    for operand, _k, _o, _w in \
+                            clf.consumed_operands(call):
+                        if isinstance(operand, ast.Name) \
+                                and operand.id in keyish:
+                            entry = (
+                                keyish[operand.id], operand.id
+                            )
+                            if entry not in have:
+                                have = have | {entry}
+                                changed = True
+                if have:
+                    consumers[fn.name] = have
+        self._consumers = consumers
+        return consumers
+
+    # -- check -------------------------------------------------------------
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding]:
+        registry = self._registry()
+        classifier = _Classifier(registry)
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef
+        ):
+            if not self._may_consume(mod, node, registry):
+                continue
+            yield from self._check_fn(mod, node, classifier)
+
+    @staticmethod
+    def _may_consume(mod, fn, registry) -> bool:
+        """Cheap superset test: the dataflow can only ever report a
+        function that CONTAINS a consume site (a jax.random derive/draw
+        or a call reaching a registry consumer, dotted or bare)."""
+        for n in mod.walk(fn):
+            if isinstance(n, ast.Call):
+                op = _random_op(n)
+                if op is not None and (
+                    op in DERIVE_OPS or op in DRAW_OPS
+                ):
+                    return True
+            elif isinstance(n, ast.Name):
+                if n.id in registry:
+                    return True
+            elif isinstance(n, ast.Attribute):
+                if n.attr in registry:
+                    return True
+        return False
+
+    def _check_fn(self, mod, fn, classifier):
+        flow = _KeyFlow(mod, fn, classifier)
+        cfg = build_cfg(fn.body, anchor=fn)
+        flow.run(cfg)
+        # Reporting pass: replay each reachable block under the
+        # converged states so conflicts carry final path facts.
+        flow.conflicts.clear()
+        for block in cfg.blocks:
+            for _ in flow.replay(block):
+                pass
+        reported: set = set()
+        for (line, col, var), prior in sorted(flow.conflicts.items()):
+            if (line, col, var) in reported:
+                continue
+            reported.add((line, col, var))
+            anchor = ast.Name(id=var)
+            anchor.lineno, anchor.col_offset = line, col
+            yield self.finding(
+                mod, anchor,
+                f"PRNG key `{var}` is consumed again here but was "
+                f"already consumed at line {prior.line} "
+                f"({prior.kind} {prior.op}): keys are linear — "
+                "re-bind first (`key, sk = jax.random.split(key)`) "
+                "or consume disjoint constant lanes of one equal-"
+                "width split",
+            )

@@ -131,6 +131,9 @@ class LockOrderChecker(Checker):
         self.manifest: list[tuple[str, int, list[str]]] = []
         self.imports: dict[str, dict[str, str]] = {}  # path -> alias->modtail
         self._analyzed: dict | None = None
+        # Lazy name -> [_Fn] indexes (built once, first _resolve).
+        self._name_index: dict[str, list[_Fn]] | None = None
+        self._fn_name_index: dict[str, list[_Fn]] | None = None
 
     # ------------------------------------------------------------------
     # scan pass
@@ -138,15 +141,15 @@ class LockOrderChecker(Checker):
 
     def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
         path = mod.path
-        for line in range(1, len(mod.lines) + 1):
-            m = _LOCK_ORDER_RE.search(mod.comment_text(line))
+        for line, text in sorted(mod.comments().items()):
+            m = _LOCK_ORDER_RE.search(text)
             if m:
                 chain = [p.strip() for p in m.group(1).split("<")]
                 chain = [p for p in chain if p]
                 if len(chain) >= 2:
                     self.manifest.append((path, line, chain))
         imap = self.imports.setdefault(path, {})
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes_of(ast.Import, ast.ImportFrom):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     imap[a.asname or a.name.split(".")[0]] = \
@@ -155,14 +158,12 @@ class LockOrderChecker(Checker):
                 for a in node.names:
                     imap[a.asname or a.name] = a.name
         modtail = path.rsplit("/", 1)[-1].removesuffix(".py")
-        parents: dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(mod.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
         # Pass A: declarations — name-lock bindings anywhere, class
         # field types, self.<attr> lock declarations — so the held-set
         # walk below resolves locks regardless of source order.
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes_of(
+            ast.ClassDef, ast.Assign, ast.AnnAssign
+        ):
             if isinstance(node, ast.ClassDef):
                 self.known_classes.add(node.name)
                 for item in node.body:
@@ -180,18 +181,16 @@ class LockOrderChecker(Checker):
                 self._scan_lock_decl(
                     mod, node,
                     module_level=isinstance(
-                        parents.get(node), ast.Module
+                        mod.parent(node), ast.Module
                     ),
                     modtail=modtail,
                 )
         # Pass B: one summary per function, owner class = direct
         # parent ClassDef (nested closures register by bare name).
-        for node in ast.walk(mod.tree):
-            if not isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef)
-            ):
-                continue
-            owner = parents.get(node)
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef
+        ):
+            owner = mod.parent(node)
             cls = owner.name if isinstance(owner, ast.ClassDef) else None
             info = _Fn(path, cls, node.name, is_hot(mod, node))
             if cls is not None:
@@ -258,7 +257,7 @@ class LockOrderChecker(Checker):
             for a in list(fn.args.args) + list(fn.args.kwonlyargs)
             if a.annotation is not None
         }
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if not isinstance(node, (ast.Assign, ast.AnnAssign)):
                 continue
             targets = (
@@ -335,13 +334,30 @@ class LockOrderChecker(Checker):
             self._walk_held(mod, info, node.body, inner,
                             cls=cls, modtail=modtail)
             return
-        if isinstance(node, ast.Call):
-            ref = self._call_ref(node)
-            if ref is not None:
-                info.calls.append((ref, held, node.lineno))
-        for child in ast.iter_child_nodes(node):
-            self._walk_node(mod, info, child, held, cls=cls,
-                            modtail=modtail)
+        # Generic statement/expression: scan the preorder slice,
+        # skipping nested-scope subtrees whole and handing With
+        # subtrees back to the held-set logic (recursing node-by-node
+        # costs a Python frame per AST node; this is the same
+        # traversal over a precomputed list).
+        sub = mod.walk(node)
+        i, total = 0, len(sub)
+        while i < total:
+            n = sub[i]
+            if n is not node:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                    i += mod.subtree_size(n)
+                    continue
+                if isinstance(n, ast.With):
+                    self._walk_node(mod, info, n, held, cls=cls,
+                                    modtail=modtail)
+                    i += mod.subtree_size(n)
+                    continue
+            if isinstance(n, ast.Call):
+                ref = self._call_ref(n)
+                if ref is not None:
+                    info.calls.append((ref, held, n.lineno))
+            i += 1
 
     def _call_ref(self, call: ast.Call) -> tuple | None:
         func = call.func
@@ -394,11 +410,12 @@ class LockOrderChecker(Checker):
             ctor = self.methods.get((f, "__init__"))
             if ctor:
                 return ctor
-            out = []
-            for (_, name), fns in self.functions.items():
-                if name == f:
-                    out.extend(fns)
-            return out
+            if self._fn_name_index is None:
+                idx: dict[str, list[_Fn]] = {}
+                for (_, name), fns in self.functions.items():
+                    idx.setdefault(name, []).extend(fns)
+                self._fn_name_index = idx
+            return self._fn_name_index.get(f, [])
         if kind in ("self", "selfattr", "any"):
             return self._by_name(ref[-1])
         return []
@@ -406,14 +423,14 @@ class LockOrderChecker(Checker):
     def _by_name(self, m: str) -> list[_Fn]:
         if m in _STDLIB_METHODS:
             return []
-        out: list[_Fn] = []
-        for (_, name), fns in self.methods.items():
-            if name == m:
-                out.extend(fns)
-        for (_, name), fns in self.functions.items():
-            if name == m:
-                out.extend(fns)
-        return out
+        if self._name_index is None:
+            idx: dict[str, list[_Fn]] = {}
+            for (_, name), fns in self.methods.items():
+                idx.setdefault(name, []).extend(fns)
+            for (_, name), fns in self.functions.items():
+                idx.setdefault(name, []).extend(fns)
+            self._name_index = idx
+        return self._name_index.get(m, [])
 
     def _analyze(self) -> dict:
         if self._analyzed is not None:
@@ -645,28 +662,28 @@ class AtomicityChecker(Checker):
 
     def check(self, mod: ParsedModule, ctx: RepoContext
               ) -> Iterator[Finding | None]:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                fields = {
-                    f: arg
-                    for f, (kind, arg) in
-                    field_annotations(mod, node).items()
-                    if kind == "guarded-by"
-                }
-                if not fields:
-                    continue
-                for item in node.body:
-                    if isinstance(
-                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
-                    ) and item.name != "__init__":
-                        yield from self._check_method(mod, item, fields)
+        for node in mod.nodes_of(ast.ClassDef):
+            fields = {
+                f: arg
+                for f, (kind, arg) in
+                field_annotations(mod, node).items()
+                if kind == "guarded-by"
+            }
+            if not fields:
+                continue
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and item.name != "__init__":
+                    yield from self._check_method(mod, item, fields)
 
-    def _lock_blocks(self, fn, fields) -> list[tuple[str, ast.With]]:
+    def _lock_blocks(self, mod, fn, fields
+                     ) -> list[tuple[str, ast.With]]:
         """(lock_attr, with_node) for every `with self.<lock>:` block
         over a lock that guards at least one annotated field."""
         locks = set(fields.values())
         out = []
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if not isinstance(node, ast.With):
                 continue
             for item in node.items:
@@ -731,7 +748,7 @@ class AtomicityChecker(Checker):
 
     def _check_method(self, mod, fn, fields
                       ) -> Iterator[Finding | None]:
-        blocks = self._lock_blocks(fn, fields)
+        blocks = self._lock_blocks(mod, fn, fields)
         if len(blocks) < 2:
             return
         reported: set[tuple[int, str]] = set()
@@ -742,7 +759,7 @@ class AtomicityChecker(Checker):
             # Escape form: the escaped value's test guards a later
             # same-lock block that mutates the field.
             guard_ranges: list[tuple[int, int, str]] = []
-            for n in ast.walk(fn):
+            for n in mod.walk(fn):
                 if isinstance(n, (ast.If, ast.While)) \
                         and n.lineno > a.lineno:
                     used = {

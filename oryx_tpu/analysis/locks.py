@@ -40,9 +40,8 @@ class LockDisciplineChecker(Checker):
     def check(
         self, mod: ParsedModule, ctx: RepoContext
     ) -> Iterator[Finding | None]:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                yield from self._check_class(mod, node)
+        for node in mod.nodes_of(ast.ClassDef):
+            yield from self._check_class(mod, node)
 
     def _guarded_fields(
         self, mod: ParsedModule, cls: ast.ClassDef

@@ -174,7 +174,7 @@ class MetricNameChecker(Checker):
     # ---- pass 1: gather every (name, kind) site --------------------------
 
     def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
-        for call in ast.walk(mod.tree):
+        for call in mod.walk():
             if not isinstance(call, ast.Call):
                 continue
             mk = _metric_call(call)
@@ -201,7 +201,7 @@ class MetricNameChecker(Checker):
     def check(
         self, mod: ParsedModule, ctx: RepoContext
     ) -> Iterator[Finding | None]:
-        for call in ast.walk(mod.tree):
+        for call in mod.walk():
             if not isinstance(call, ast.Call):
                 continue
             builder = _event_builder_name(call)

@@ -1,0 +1,202 @@
+"""terminal-path: every exit of an annotated scope discharges its
+declared obligations.
+
+The scheduler's bug history (queue-depth gauge leaked on the
+containment path, a rejected request's cost ledger never finalized,
+SLO gauge re-arm starved by an early `continue` — all hand-found in
+PRs 5-7) is one shape: a terminal path that forgets a resource. This
+rule makes the contract declarative:
+
+    # obligations: _finalize_cost, _emit_request_event
+    def _finish(self, s, slot, h): ...
+
+Every exit — `return`s, `raise`s, exits out of `except` handlers,
+falling off the end — must *discharge* each named obligation. A loop
+may be annotated too (`# obligations:` on/above a `for`/`while`
+header): then every path to the next iteration — early `continue` and
+normal fall-through — must discharge per iteration (the gauge re-arm
+shape). `break`/`return` paths leave the loop's domain and are the
+function-level annotation's business.
+
+Discharge grammar (per path, any one of):
+  * a call whose final name component equals the obligation token
+    (`self._finalize_cost(...)` discharges `_finalize_cost`);
+  * a call whose first positional argument is the token as a string
+    literal (`self.metrics.set_gauge("queue_depth", n)` discharges
+    `queue_depth` — how gauge re-arms are named);
+  * an explicit `# discharges: <token>` comment on a statement line
+    (for indirect discharges the checker cannot see).
+
+Verification is a must-dataflow (intersection join) over the cfg.py
+graph: a fact survives a join only if EVERY path in established it,
+and an `except` handler's entry state is the try-entry state (any
+statement in the body may raise before discharging). `finally` bodies
+are inlined on every leaving edge, so a discharge there proves all
+paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from .cfg import Bind, Exit, build_cfg, function_cfg, loop_cfg
+from .core import Checker, Finding, ParsedModule, RepoContext
+from .dataflow import ForwardAnalysis
+
+_OBLIGATIONS_RE = re.compile(r"#\s*obligations:\s*([\w\., ]+)")
+_DISCHARGES_RE = re.compile(r"#\s*discharges:\s*([\w\., ]+)")
+
+# Exit kinds verified per annotation domain.
+_FN_EXIT_KINDS = {"return", "raise", "implicit"}
+_LOOP_EXIT_KINDS = {"continue", "fallthrough"}
+
+
+def _tokens(spec: str) -> list[str]:
+    return [t.strip() for t in spec.split(",") if t.strip()]
+
+
+def declared_obligations(
+    mod: ParsedModule, node: ast.stmt
+) -> list[str]:
+    """Tokens from `# obligations:` on the header line or on the
+    contiguous comment block immediately above it (above decorators
+    for a def). Real comments only (comment_text), so quoting the
+    syntax in a docstring is inert."""
+    first = min(
+        [node.lineno]
+        + [d.lineno for d in getattr(node, "decorator_list", [])]
+    )
+    m = _OBLIGATIONS_RE.search(mod.comment_text(node.lineno))
+    if m:
+        return _tokens(m.group(1))
+    line = first - 1
+    while line >= 1:
+        text = mod.comment_text(line)
+        if not text:
+            break
+        m = _OBLIGATIONS_RE.search(text)
+        if m:
+            return _tokens(m.group(1))
+        line -= 1
+    return []
+
+
+def _call_discharges(call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    func = call.func
+    name = None
+    if isinstance(func, ast.Attribute):
+        name = func.attr
+    elif isinstance(func, ast.Name):
+        name = func.id
+    if name:
+        out.add(name)
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        out.add(call.args[0].value)
+    return out
+
+
+class _SkipNestedCalls(ast.NodeVisitor):
+    def __init__(self):
+        self.names: set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.names |= _call_discharges(node)
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node) -> None:
+        return
+
+    def visit_FunctionDef(self, node) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        return
+
+
+class _Obligations(ForwardAnalysis):
+    """Facts: ("done", token). Must-analysis — a discharge counts only
+    when every path in performed it."""
+
+    may = False
+
+    def __init__(self, mod: ParsedModule, declared: list[str]):
+        self.mod = mod
+        self.declared = declared
+
+    def transfer(self, elem, state):
+        node = elem.node if isinstance(elem, Bind) else elem
+        walk_root = elem.value if isinstance(elem, Bind) else elem
+        names: set[str] = set()
+        if walk_root is not None:
+            v = _SkipNestedCalls()
+            v.visit(walk_root)
+            names = v.names
+        line = getattr(node, "lineno", None)
+        if line is not None:
+            m = _DISCHARGES_RE.search(self.mod.comment_text(line))
+            if m:
+                names |= set(_tokens(m.group(1)))
+        done = {
+            ("done", t) for t in self.declared if t in names
+        }
+        return state | done if done else state
+
+
+class ObligationChecker(Checker):
+    name = "terminal-path"
+
+    def check(
+        self, mod: ParsedModule, ctx: RepoContext
+    ) -> Iterator[Finding]:
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.For, ast.While
+        ):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                declared = declared_obligations(mod, node)
+                if declared:
+                    yield from self._verify(
+                        mod, node.name, declared,
+                        function_cfg(node), _FN_EXIT_KINDS,
+                        "terminal path",
+                    )
+            else:
+                declared = declared_obligations(mod, node)
+                if declared:
+                    yield from self._verify(
+                        mod, f"loop at line {node.lineno}", declared,
+                        loop_cfg(node), _LOOP_EXIT_KINDS,
+                        "iteration path",
+                    )
+
+    def _verify(self, mod, scope, declared, cfg, exit_kinds, what):
+        flow = _Obligations(mod, declared)
+        flow.run(cfg)
+        seen: set[tuple] = set()
+        for ex in cfg.exits:
+            if ex.kind not in exit_kinds:
+                continue
+            state = flow.exit_state(ex.block)
+            if state is None:
+                continue  # unreachable exit
+            missing = [
+                t for t in declared if ("done", t) not in state
+            ]
+            if not missing:
+                continue
+            key = (getattr(ex.node, "lineno", 0), tuple(missing))
+            if key in seen:
+                continue
+            seen.add(key)
+            yield self.finding(
+                mod, ex.node,
+                f"{what} ({ex.kind}) out of `{scope}` leaves "
+                f"obligation(s) {', '.join(missing)} undischarged: "
+                "every exit must call each declared obligation (or "
+                "carry `# discharges: <token>` where the call is "
+                "indirect)",
+            )

@@ -77,7 +77,9 @@ class RecompileHazardChecker(Checker):
     # ---- pass 1: collect jitted defs -------------------------------------
 
     def scan(self, mod: ParsedModule, ctx: RepoContext) -> None:
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.Assign
+        ):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 params = [a.arg for a in node.args.args] + [
                     a.arg for a in node.args.kwonlyargs
@@ -129,15 +131,18 @@ class RecompileHazardChecker(Checker):
     def check(
         self, mod: ParsedModule, ctx: RepoContext
     ) -> Iterator[Finding | None]:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for node in mod.nodes_of(
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.Call
+        ):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
                 if node.name in ctx.jitted_static and self._is_jitted(
                     node
                 ):
                     yield from self._check_tracer_branches(
                         mod, node, ctx.jitted_static[node.name]
                     )
-            elif isinstance(node, ast.Call):
+            else:
                 yield from self._check_static_operands(mod, node, ctx)
 
     @staticmethod
@@ -177,7 +182,7 @@ class RecompileHazardChecker(Checker):
         ]
 
     def _tainted_locals(
-        self, fn: ast.FunctionDef, traced: set[str]
+        self, mod: ParsedModule, fn: ast.FunctionDef, traced: set[str]
     ) -> set[str]:
         """Locals DERIVED from traced parameters (the packed-buffer
         idiom hazard: `num_live = (~finished).sum()` then
@@ -188,20 +193,20 @@ class RecompileHazardChecker(Checker):
         fixpoint so chains (`a = x; b = a`) and loop back-edges
         resolve."""
         tainted: set[str] = set()
+        assigns = [
+            (node.targets[0].id,
+             {n.id for n in self._dynamic_names(node.value)})
+            for node in mod.walk(fn)
+            if isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ]
         changed = True
         while changed:
             changed = False
-            for node in ast.walk(fn):
-                if not (
-                    isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                ):
-                    continue
-                tgt = node.targets[0].id
+            for tgt, names in assigns:
                 if tgt in tainted:
                     continue
-                names = {n.id for n in self._dynamic_names(node.value)}
                 if names & (traced | tainted):
                     tainted.add(tgt)
                     changed = True
@@ -215,7 +220,7 @@ class RecompileHazardChecker(Checker):
             for a in list(fn.args.args) + list(fn.args.kwonlyargs)
             if a.arg not in statics and a.arg != "self"
         }
-        tainted = self._tainted_locals(fn, traced)
+        tainted = self._tainted_locals(mod, fn, traced)
 
         def value_dependent_names(test: ast.expr) -> list[ast.Name]:
             """Direct value tests on a traced parameter name or a local
@@ -247,7 +252,7 @@ class RecompileHazardChecker(Checker):
                 return out
             return []
 
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
                 continue
             for name in value_dependent_names(node.test):

@@ -12,6 +12,7 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 from typing import Iterable
 
 from oryx_tpu.analysis.core import (
@@ -20,11 +21,14 @@ from oryx_tpu.analysis.core import (
     render_text,
     run_lint,
 )
+from oryx_tpu.analysis.determinism import ReplayTaintChecker
 from oryx_tpu.analysis.donation import UseAfterDonateChecker
 from oryx_tpu.analysis.hostsync import HostSyncChecker
+from oryx_tpu.analysis.keylin import KeyLinearityChecker
 from oryx_tpu.analysis.lockorder import AtomicityChecker, LockOrderChecker
 from oryx_tpu.analysis.locks import LockDisciplineChecker
 from oryx_tpu.analysis.metric_names import MetricNameChecker
+from oryx_tpu.analysis.obligations import ObligationChecker
 from oryx_tpu.analysis.recompile import RecompileHazardChecker
 from oryx_tpu.analysis.swallow import SwallowedExceptionChecker
 
@@ -37,7 +41,14 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     RecompileHazardChecker,
     MetricNameChecker,
     SwallowedExceptionChecker,
+    KeyLinearityChecker,
+    ObligationChecker,
+    ReplayTaintChecker,
 )
+
+# Seam for the --time-budget gate's unit test: tests monkeypatch this
+# to a fake clock; production is the monotonic wall clock.
+_monotonic = time.monotonic
 
 # Fixture prefix -> the rule module whose behavior it pins. A change to
 # EITHER invalidates the `--changed-only` fast path: a rule edit can
@@ -53,6 +64,9 @@ FIXTURE_RULE_MODULES: dict[str, str] = {
     "recompile": "recompile.py",
     "metric": "metric_names.py",
     "swallow": "swallow.py",
+    "keylin": "keylin.py",
+    "obligation": "obligations.py",
+    "taint": "determinism.py",
 }
 
 # Directories that are not our python (vendored assets, fixtures that
@@ -166,7 +180,8 @@ def main(argv: list[str] | None = None) -> int:
         description=(
             "oryxlint: JAX-aware static analysis (lock-discipline, "
             "lock-order, atomicity, use-after-donate, host-sync, "
-            "recompile-hazard, metric-name, swallowed-exception). "
+            "recompile-hazard, metric-name, swallowed-exception, "
+            "key-linearity, terminal-path, replay-taint). "
             "Exits 1 on any finding; --strict (the CI gate) "
             "additionally fails on files that don't parse; "
             "--max-suppressions N fails when justified suppressions "
@@ -210,11 +225,36 @@ def main(argv: list[str] | None = None) -> int:
         "justified escapes from silently accumulating",
     )
     parser.add_argument(
+        "--max-suppressions-per-rule", action="append", default=[],
+        metavar="RULE=N", dest="per_rule_caps",
+        help="fail when rule RULE has more than N suppressions "
+        "(repeatable) — pins NEW rules at 0 escapes independently "
+        "of the global --max-suppressions ratchet",
+    )
+    parser.add_argument(
+        "--time-budget", type=float, default=None, metavar="SECONDS",
+        help="fail when the lint run (parse + scan + check over the "
+        "selected tree) exceeds this wall time — the CI gate that "
+        "keeps the dataflow fixpoint passes from creeping",
+    )
+    parser.add_argument(
         "--json-out", default=None, metavar="PATH",
         help="also write the JSON report to PATH (the CI artifact; "
         "stdout keeps whichever format --json selects)",
     )
     args = parser.parse_args(argv)
+
+    per_rule_caps: dict[str, int] = {}
+    known_rules = {cls.name for cls in ALL_CHECKERS}
+    for spec in args.per_rule_caps:
+        rule, sep, cap = spec.partition("=")
+        if not sep or not cap.strip().isdigit() \
+                or rule.strip() not in known_rules:
+            raise SystemExit(
+                f"oryxlint: bad --max-suppressions-per-rule {spec!r} "
+                f"(want RULE=N with RULE in {sorted(known_rules)})"
+            )
+        per_rule_caps[rule.strip()] = int(cap.strip())
 
     if args.list_rules:
         for cls in ALL_CHECKERS:
@@ -247,9 +287,11 @@ def main(argv: list[str] | None = None) -> int:
     else:
         files = default_files(root)
 
+    t0 = _monotonic()
     result = run_lint(
         _sources(files), make_checkers(args.rules), check_only=check_only
     )
+    elapsed = _monotonic() - t0
     print(render_json(result) if args.as_json else render_text(result))
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as f:
@@ -268,6 +310,25 @@ def main(argv: list[str] | None = None) -> int:
             f"--max-suppressions ratchet ({args.max_suppressions}); "
             "either fix the new site or consciously bump the ratchet "
             "in scripts/check_tier1.sh with a justification",
+            file=sys.stderr,
+        )
+        rc = 1
+    for rule, cap in sorted(per_rule_caps.items()):
+        seen = result.suppressed_by_rule.get(rule, 0)
+        if seen > cap:
+            print(
+                f"oryxlint: rule {rule} has {seen} suppression(s), "
+                f"over its per-rule ratchet ({cap}); fix the site or "
+                "consciously bump the pin in scripts/check_tier1.sh",
+                file=sys.stderr,
+            )
+            rc = 1
+    if args.time_budget is not None and elapsed > args.time_budget:
+        print(
+            f"oryxlint: run took {elapsed:.2f}s, over the "
+            f"--time-budget gate ({args.time_budget:.2f}s); a "
+            "fixpoint pass is creeping — profile the new rule before "
+            "raising the budget",
             file=sys.stderr,
         )
         rc = 1

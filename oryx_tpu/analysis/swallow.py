@@ -108,9 +108,7 @@ class SwallowedExceptionChecker(Checker):
     def check(
         self, mod: ParsedModule, ctx: RepoContext
     ) -> Iterator[Finding | None]:
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
+        for node in mod.nodes_of(ast.ExceptHandler):
             if not _is_broad(node) or not _drops_silently(node):
                 continue
             if is_fault_boundary(mod, node):

@@ -576,7 +576,12 @@ def paged_prefill_chunks(
             embeds, jnp.asarray(off - start, jnp.int32),
             width=prefill_chunk,
         )
-        kv_pages, tok, nkeys = paged_prefill(
+        # Every chunk DELIBERATELY consumes the same original per-row
+        # keys: only the final real chunk's sample + advanced key are
+        # kept (see docstring), which is exactly the single-shot RNG
+        # contract. Re-deriving per chunk would make tok0 depend on
+        # prefill_chunk — a replay-breaking divergence.
+        kv_pages, tok, nkeys = paged_prefill(  # oryxlint: disable=key-linearity
             params, cfg, sl, jnp.minimum(lengths, end), block_tables,
             kv_pages, jnp.asarray([off], np.int32), keys,
             temperature, top_p, top_k,

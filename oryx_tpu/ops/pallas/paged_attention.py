@@ -390,9 +390,14 @@ def autotune_ragged_grid(
     # Tiny synthetic problem in the target shape class.
     R, S, maxp, P = 16, 8, 8, 64
     Hk = int(num_kv_heads)
-    key_ = jax.random.key(0)
-    q = jax.random.normal(key_, (R, Hk * 2, head_dim), jnp.float32)
-    kp = jax.random.normal(key_, (P, page_size, Hk, head_dim), jnp.float32)
+    # Independent subkeys: drawing q and the KV pages from one key
+    # correlates the synthetic operands (identical leading random
+    # stream), skewing the softmax mass the candidate grids are timed
+    # against (found by oryxlint key-linearity self-application,
+    # oryx_tpu/ops/pallas/paged_attention.py:395).
+    kq, kk = jax.random.split(jax.random.key(0))
+    q = jax.random.normal(kq, (R, Hk * 2, head_dim), jnp.float32)
+    kp = jax.random.normal(kk, (P, page_size, Hk, head_dim), jnp.float32)
     bt = jnp.tile(jnp.arange(maxp, dtype=jnp.int32)[None], (S, 1))
     seg = jnp.arange(R, dtype=jnp.int32) % S
     pos = jnp.full((R,), maxp * page_size - 1, jnp.int32)

@@ -1159,6 +1159,7 @@ class ContinuousScheduler:
             self.watchdog.stop()
         return drained
 
+    # obligations: _reset_pool
     def fail_inflight(self, msg: str, *, kind: str = "unavailable"
                       ) -> None:
         """Error out EVERY queued and resident request and rebuild the
@@ -1875,6 +1876,7 @@ class ContinuousScheduler:
                     if req is not None:
                         self._finish_error(s, msg)
                 with self._cond:
+                    # obligations: _finalize_cost, _emit_request_event
                     while self._queue:
                         r = self._queue.popleft()
                         cost = self._finalize_cost(None, r)
@@ -1901,6 +1903,7 @@ class ContinuousScheduler:
                 self._abort_profile()
                 self._reset_pool()
 
+    # obligations: _finalize_cost, _emit_request_event
     def _reject_queued(
         self, req: _Request, msg: str, *, kind: str = "server_error"
     ) -> None:
@@ -1917,6 +1920,36 @@ class ContinuousScheduler:
         req.trace.finish(error=msg, cost=cost)
         self._emit_request_event(req, status="error", error_kind=kind)
         _LOG.info("request %s dropped: %s", req.trace.id, msg)
+
+    # obligations: cancelled, _finalize_cost, _emit_request_event
+    def _cancel_queued(self, req: _Request) -> None:
+        """Terminal path for a client hang-up BEFORE admission (the
+        request holds no slot, no pages): ledger finalized with zero
+        resources but real queue_s, trace closed, wide event emitted,
+        and the `cancelled` counter advanced — this path used to skip
+        the counter while the three slot-holding cancel paths bumped
+        it, so queue cancels undercounted (found by the terminal-path
+        obligations annotation, finding scheduler.py `_cancel_queued`
+        / cancelled)."""
+        self.metrics.inc("cancelled")
+        cost = self._finalize_cost(None, req)
+        req.trace.finish(cancelled=True, cost=cost)
+        self._emit_request_event(req, status="cancelled")
+        _LOG.info("request %s cancelled in queue", req.trace.id)
+
+    # obligations: cancelled, _finalize_cost, _clear_slot, _emit_request_event
+    def _cancel_slot(self, s: int, req: _Request, where: str) -> None:
+        """Terminal path for a client hang-up while holding slot `s`
+        (mid-prefill or mid-decode): the slot's pages — including
+        spliced prefix-cache shares — return NOW, before any further
+        dispatch. One body for the three call sites so the obligation
+        set is declared (and machine-checked) once."""
+        self.metrics.inc("cancelled")
+        cost = self._finalize_cost(s, req)
+        self._clear_slot(s)
+        req.trace.finish(cancelled=True, cost=cost)
+        self._emit_request_event(req, status="cancelled")
+        _LOG.info("request %s cancelled %s", req.trace.id, where)
 
     def _enforce_deadlines(self) -> None:
         """Cancel every request past its deadline, wherever it lives:
@@ -2066,10 +2099,7 @@ class ContinuousScheduler:
                 # /debug/requests?state=done, and the every-finished-
                 # request-has-a-complete-ledger audit must hold there
                 # too.
-                cost = self._finalize_cost(None, req)
-                req.trace.finish(cancelled=True, cost=cost)
-                self._emit_request_event(req, status="cancelled")
-                _LOG.info("request %s cancelled in queue", req.trace.id)
+                self._cancel_queued(req)
                 continue
             if req.embeds is None:
                 # The request reached the queue head: queue_wait ends,
@@ -2404,14 +2434,7 @@ class ContinuousScheduler:
                 # pages (including spliced prefix-cache shares) return
                 # now. Same invariant as the mid-decode cancel in
                 # _advance.
-                self.metrics.inc("cancelled")
-                cost = self._finalize_cost(s, req)
-                self._clear_slot(s)
-                req.trace.finish(cancelled=True, cost=cost)
-                self._emit_request_event(req, status="cancelled")
-                _LOG.info(
-                    "request %s cancelled mid-prefill", req.trace.id
-                )
+                self._cancel_slot(s, req, "mid-prefill")
                 continue
             self._advance_prefill(s, req)
 
@@ -2608,6 +2631,7 @@ class ContinuousScheduler:
                     )
                     break
 
+    # obligations: _clear_slot, queue_depth, evicted
     def _evict(self, s: int) -> None:
         """Free slot s and requeue its request at the FRONT; replay
         (same key0, same prompt) re-derives its stream deterministically
@@ -2969,14 +2993,7 @@ class ContinuousScheduler:
             if req is None or req.activated:
                 continue
             if req.handle.cancelled:
-                self.metrics.inc("cancelled")
-                cost = self._finalize_cost(s, req)
-                self._clear_slot(s)
-                req.trace.finish(cancelled=True, cost=cost)
-                self._emit_request_event(req, status="cancelled")
-                _LOG.info(
-                    "request %s cancelled mid-prefill", req.trace.id
-                )
+                self._cancel_slot(s, req, "mid-prefill")
         if any(r is not None and r.activated for r in self.slots):
             self._ensure_capacity()  # may evict — recompute live below
         live = [
@@ -3158,6 +3175,7 @@ class ContinuousScheduler:
                 self._activate(pf_s, pf_req, pf_tok0[np.newaxis], pf_key)
         self._occupancy_gauge()
 
+    # replay-decision
     def _select_fuse_k(self, live: list[int], pf_req) -> int:
         """Pick K — logical engine steps for the next decode dispatch
         (docs/DESIGN.md "Fused multi-step decode").
@@ -3527,12 +3545,7 @@ class ContinuousScheduler:
         tokenizer = self.pipe.tokenizer
         useful = 0
         if req.handle.cancelled:
-            self.metrics.inc("cancelled")
-            cost = self._finalize_cost(s, req)
-            self._clear_slot(s)
-            req.trace.finish(cancelled=True, cost=cost)
-            self._emit_request_event(req, status="cancelled")
-            _LOG.info("request %s cancelled mid-decode", req.trace.id)
+            self._cancel_slot(s, req, "mid-decode")
             return useful
         chunk_start = len(req.emitted)
         finish = None  # (reason, completion_count)
@@ -3609,6 +3622,7 @@ class ContinuousScheduler:
                 req.handle.events.put(("delta", safe[len(req.text_done):]))
             req.text_done = safe
 
+    # obligations: _finalize_cost, _clear_slot, _emit_request_event, completed
     def _finish(self, s: int, reason: str, completion: int) -> None:
         req = self.slots[s]
         cost = self._finalize_cost(s, req)
@@ -3646,6 +3660,7 @@ class ContinuousScheduler:
         )
         self.metrics.inc("completed")
 
+    # obligations: _finalize_cost, _clear_slot, _emit_request_event
     def _finish_error(
         self, s: int, msg: str, *, kind: str = "server_error"
     ) -> None:

@@ -1,0 +1,33 @@
+"""Clean fixture: linear key discipline the key-linearity rule must
+accept — re-bind chains, moves, disjoint-lane split contracts, and
+branch-exclusive consumes. Zero findings, zero suppressions."""
+
+import jax
+
+
+def rebind_chain(key):
+    key, sk = jax.random.split(key)
+    x = jax.random.normal(sk, ())
+    key, sk = jax.random.split(key)
+    y = jax.random.normal(sk, ())
+    return key, x + y
+
+
+def key0_split_contract(keys):
+    # The generate.py key0 contract: ONE equal-width split consumed on
+    # disjoint constant lanes (advanced keys vs sample keys).
+    next_keys = jax.random.split(keys, 2)[:, 0]
+    subkeys = jax.random.split(keys, 2)[:, 1]
+    return next_keys, subkeys
+
+
+def linear_move(key):
+    other = key  # a move: `key` is dead from here on
+    return jax.random.normal(other, ())
+
+
+def branch_exclusive(key, flag):
+    # One consume per PATH is fine — the two sites never co-execute.
+    if flag:
+        return jax.random.bernoulli(key)
+    return jax.random.normal(key, ())

@@ -1,0 +1,39 @@
+"""Positive fixture: PRNG-key linearity violations the key-linearity
+rule must flag, with exact `# expect:` line markers. Keys are linear
+values: one consume per binding, re-bind before the next."""
+
+import jax
+
+
+def double_draw(logits, key):
+    first = jax.random.categorical(key, logits)
+    second = jax.random.categorical(key, logits)  # expect: key-linearity
+    return first, second
+
+
+def split_then_reuse_parent(key):
+    key2, sub = jax.random.split(key)
+    noise = jax.random.normal(key, (4,))  # expect: key-linearity
+    return key2, sub, noise
+
+
+def consume_on_one_branch_then_join(key, flag):
+    if flag:
+        tok = jax.random.bernoulli(key)
+    else:
+        tok = 0
+    extra = jax.random.bernoulli(key)  # expect: key-linearity
+    return tok, extra
+
+
+def loop_reuse(key, n):
+    total = 0
+    for _ in range(n):
+        total = total + jax.random.bernoulli(key)  # expect: key-linearity
+    return total
+
+
+def same_lane_twice(keys):
+    advanced = jax.random.split(keys, 2)[:, 0]
+    again = jax.random.split(keys, 2)[:, 0]  # expect: key-linearity
+    return advanced, again

@@ -1,0 +1,13 @@
+"""Suppressed fixture: deliberate key reuse (a replay-determinism
+assertion) behind a justified suppression — quiet but counted."""
+
+import jax
+
+
+def determinism_probe(logits, key):
+    # Same key on purpose: the probe asserts the two draws are
+    # IDENTICAL (the replay invariant), which only holds under reuse.
+    first = jax.random.categorical(key, logits)
+    again = jax.random.categorical(key, logits)  # oryxlint: disable=key-linearity
+    assert (first == again).all()
+    return first

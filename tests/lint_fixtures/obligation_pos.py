@@ -1,0 +1,64 @@
+"""Positive fixture: annotated terminal paths that skip a declared
+obligation, with exact `# expect:` line markers.
+
+The first two shapes reproduce real bugs fixed by hand in PRs 5-7:
+the PR 5 queue-depth-gauge leak (a queue pop path that skips the
+gauge refresh, leaving /metrics claiming a deeper queue than exists)
+and the PR 7 zero-resource-ledger bug (a cancelled-in-queue request
+whose terminal path never finalizes its cost ledger, so the
+?state=done audit and saturated-regime cost attribution miss it).
+"""
+
+
+class Engine:
+    # PR 7 shape: cancelled-in-queue is still a terminal path — the
+    # ledger (zero resources, real queue_s) and the wide event must
+    # land even though the request never held a slot.
+    # obligations: _finalize_cost, _emit_request_event
+    def _cancel_queued(self, req):
+        if req.handle.cancelled:
+            req.trace.finish(cancelled=True)
+            return  # expect: terminal-path
+        cost = self._finalize_cost(None, req)
+        req.trace.finish(cancelled=True, cost=cost)
+        self._emit_request_event(req, status="cancelled")
+
+    # PR 5 shape: EVERY pop must refresh the queue_depth gauge; the
+    # early-continue cancel path skips it and the gauge goes stale.
+    def _drain(self, msg):
+        # obligations: _finalize_cost, queue_depth
+        while self._queue:
+            r = self._queue.popleft()
+            if r.handle.cancelled:
+                continue  # expect: terminal-path
+            cost = self._finalize_cost(None, r)
+            r.trace.finish(error=msg, cost=cost)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+
+    # A raise is an exit too: the slot must not leak on the error
+    # path.
+    # obligations: _clear_slot
+    def _finish_error(self, s, msg):
+        req = self.slots[s]
+        if req is None:
+            raise KeyError(s)  # expect: terminal-path
+        self._clear_slot(s)
+
+    # An except-handler return is an exit: a dispatch failure must
+    # still finalize the ledger.
+    # obligations: _finalize_cost
+    def _step(self, req):
+        try:
+            self._dispatch(req)
+        except RuntimeError:
+            return None  # expect: terminal-path
+        cost = self._finalize_cost(None, req)
+        return cost
+
+    # Falling off the end of the function is an exit: the guard makes
+    # the discharge conditional, so the implicit exit misses it (the
+    # finding anchors on the def).
+    # obligations: _reset_pool
+    def _recover(self, ok):  # expect: terminal-path
+        if ok:
+            self._reset_pool()

@@ -1,0 +1,12 @@
+"""Suppressed fixture: a justified terminal-path escape — quiet but
+counted by the suppression ratchet."""
+
+
+class Engine:
+    # obligations: _finalize_cost
+    def _probe(self, req):
+        if req is None:
+            # Synthetic warmup probes have no ledger to finalize and
+            # the ?state=done audit skips them by construction.
+            return None  # oryxlint: disable=terminal-path
+        return self._finalize_cost(None, req)

@@ -1,0 +1,26 @@
+"""Clean fixture: wall-clock reads that stay OUT of replay-critical
+state — zero findings, zero suppressions."""
+
+import time
+
+
+class Engine:
+    def _finish_step(self, step, rows):
+        t0 = time.monotonic()
+        self._dispatch(rows)
+        # Timing feeds metrics only; the journal entry carries
+        # deterministic facts. Field-granular taint: `self._dur`
+        # being wall-clock does not poison `self` wholesale.
+        self._dur = time.monotonic() - t0
+        self.metrics.observe("step_seconds", self._dur)
+        self.journal.append(build_journal_event(
+            kind="step", step=step, rows=len(rows),
+        ))
+
+    # replay-decision
+    def _select_fuse_k(self, live, replay_plan):
+        # Replay consults the journaled plan; the live policy reads
+        # only replay state (resident count), never the wall clock.
+        if replay_plan is not None:
+            return replay_plan.get(self.steps_run, 1)
+        return 2 if len(live) == 1 else 1

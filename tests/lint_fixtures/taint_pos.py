@@ -1,0 +1,41 @@
+"""Positive fixture: nondeterminism flowing into replay-critical
+sinks (journal writes, marked decisions), with exact `# expect:`
+line markers."""
+
+import random
+import time
+
+
+class Engine:
+    def _journal_step(self, step):
+        stamp = time.monotonic()
+        self.journal.append(build_journal_event(  # expect: replay-taint
+            kind="step", step=step, ts_unix_s=stamp,
+        ))
+
+    def _pick_victim(self, slots):
+        victim = random.randrange(len(slots))
+        self.journal.append(build_journal_event(  # expect: replay-taint
+            kind="evict", victim_request_id=victim,
+        ))
+
+    def _admit_order(self, ids):
+        # Set iteration order is hash-seed-dependent: the journaled
+        # admit order would differ between record and replay.
+        for rid in set(ids):
+            self.journal.append(build_journal_event(  # expect: replay-taint
+                kind="admit", request_id=rid,
+            ))
+
+    def _stamp(self, req):
+        # Field-granular: tainting req.admit_t taints exactly that
+        # attribute, and journaling it is the finding.
+        req.admit_t = time.monotonic()
+        self.journal.append(build_journal_event(  # expect: replay-taint
+            kind="admit", ts_unix_s=req.admit_t,
+        ))
+
+    # replay-decision
+    def _select_fuse_k(self, live):
+        jitter = time.monotonic_ns()
+        return int(jitter) % 4  # expect: replay-taint

@@ -1,0 +1,19 @@
+"""Suppressed fixture: justified escapes for the replay-taint rule —
+the counted `# oryxlint: disable=` form and the uncounted, tokenized
+`# replay-exempt: <why>` form (which requires a nonempty reason)."""
+
+import time
+
+
+class Engine:
+    def _stamp_recording(self, meta):
+        # The header records when the RECORDING was made — a label for
+        # humans, never read back by the replayer.
+        wall = time.time()
+        self.journal.stamp_header(meta, wall)  # oryxlint: disable=replay-taint
+
+    def _debug_note(self, step):
+        # replay-exempt: trace-only note, never read back by replay
+        self.journal.append(build_journal_event(
+            kind="note", step=step, ts_unix_s=time.monotonic(),
+        ))

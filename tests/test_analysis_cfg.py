@@ -1,0 +1,463 @@
+"""Unit tests for the dataflow tier's CFG builder and fixpoint engine.
+
+cfg.py and dataflow.py are the shared substrate under key-linearity,
+terminal-path, and replay-taint; the checker-level fixtures in
+tests/lint_fixtures/ exercise them end to end, while these tests pin
+the graph shapes and lattice semantics directly: exit kinds, handler
+edges, finally inlining, loop back edges, may/must joins, and GenKill
+ordering.
+"""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+
+import pytest
+
+from oryx_tpu.analysis.cfg import (
+    Bind,
+    build_cfg,
+    function_cfg,
+    loop_cfg,
+)
+from oryx_tpu.analysis.dataflow import ForwardAnalysis, GenKill
+
+
+def _fn(src: str) -> ast.FunctionDef:
+    tree = ast.parse(textwrap.dedent(src))
+    fn = tree.body[0]
+    assert isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return fn
+
+
+def _first_loop(src: str) -> ast.For | ast.While:
+    fn = _fn(src)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.While)):
+            return node
+    raise AssertionError("no loop in source")
+
+
+def _exit_kinds(cfg) -> list[str]:
+    return sorted(e.kind for e in cfg.exits)
+
+
+class _Calls(ForwardAnalysis):
+    """Collects simple-name call targets seen on a path; `may` is set
+    per-instance so one transfer serves both lattices."""
+
+    def __init__(self, may: bool):
+        self.may = may
+
+    def transfer(self, elem, state):
+        root = elem.value if isinstance(elem, Bind) else elem
+        names = set()
+        if root is not None:
+            for n in ast.walk(root):
+                if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Name
+                ):
+                    names.add(n.func.id)
+        return state | frozenset(names) if names else state
+
+
+def _exit_states(cfg, analysis) -> dict[str, list[frozenset]]:
+    analysis.run(cfg)
+    out: dict[str, list[frozenset]] = {}
+    for ex in cfg.exits:
+        state = analysis.exit_state(ex.block)
+        if state is not None:
+            out.setdefault(ex.kind, []).append(state)
+    return out
+
+
+# ---- CFG construction ----------------------------------------------------
+
+
+def test_straight_line_has_single_implicit_exit():
+    cfg = function_cfg(_fn("""
+        def f():
+            a()
+            b()
+    """))
+    assert _exit_kinds(cfg) == ["implicit"]
+    elems = list(cfg.elements())
+    assert len(elems) == 2
+
+
+def test_early_return_yields_return_and_implicit_exits():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            if x:
+                return 1
+            a()
+    """))
+    assert _exit_kinds(cfg) == ["implicit", "return"]
+    ret = next(e for e in cfg.exits if e.kind == "return")
+    assert isinstance(ret.node, ast.Return)
+
+
+def test_both_arms_return_prunes_implicit_exit():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            if x:
+                return 1
+            else:
+                return 2
+    """))
+    assert _exit_kinds(cfg) == ["return", "return"]
+    # The if's join block is unreachable and must have been pruned.
+    ids = {b.id for b in cfg.blocks}
+    for b in cfg.blocks:
+        assert all(s.id in ids for s in b.succs)
+
+
+def test_unhandled_raise_is_a_raise_exit():
+    cfg = function_cfg(_fn("""
+        def f():
+            raise ValueError("boom")
+    """))
+    assert _exit_kinds(cfg) == ["raise"]
+
+
+def test_raise_inside_try_flows_to_handler_not_exit():
+    cfg = function_cfg(_fn("""
+        def f():
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                a()
+    """))
+    # The raise is absorbed by the handler: no raise exit remains.
+    assert _exit_kinds(cfg) == ["implicit"]
+
+
+def test_try_body_elements_edge_to_every_handler():
+    cfg = function_cfg(_fn("""
+        def f():
+            try:
+                a()
+                b()
+            except ValueError:
+                c()
+            except KeyError:
+                d()
+    """))
+    # Each handler entry holds its `except` Bind; both must have >= 2
+    # incoming edges (one per try-body element able to raise).
+    preds = cfg.preds()
+    handler_blocks = [
+        b for b in cfg.blocks
+        if any(
+            isinstance(e, Bind) and e.kind == "except" for e in b.elems
+        )
+    ]
+    assert len(handler_blocks) == 2
+    for hb in handler_blocks:
+        assert len(preds[hb.id]) >= 2
+
+
+def test_while_loop_has_back_edge():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            while x:
+                a()
+            return 1
+    """))
+    # Some block must edge to an earlier block (the back edge).
+    assert any(
+        s.id < b.id for b in cfg.blocks for s in b.succs
+    )
+    assert _exit_kinds(cfg) == ["return"]
+
+
+def test_while_true_without_break_has_no_implicit_exit():
+    cfg = function_cfg(_fn("""
+        def f():
+            while True:
+                a()
+    """))
+    assert _exit_kinds(cfg) == []
+
+
+def test_while_true_with_break_falls_through():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            while True:
+                if x:
+                    break
+            a()
+    """))
+    assert _exit_kinds(cfg) == ["implicit"]
+
+
+def test_for_emits_target_bind():
+    cfg = function_cfg(_fn("""
+        def f(xs):
+            for x in xs:
+                a(x)
+    """))
+    binds = [e for e in cfg.elements() if isinstance(e, Bind)]
+    assert [b.kind for b in binds] == ["for"]
+    assert isinstance(binds[0].target, ast.Name)
+    assert isinstance(binds[0].value, ast.Name)
+
+
+def test_with_emits_context_bind():
+    cfg = function_cfg(_fn("""
+        def f(lock):
+            with lock:
+                a()
+    """))
+    binds = [e for e in cfg.elements() if isinstance(e, Bind)]
+    assert [b.kind for b in binds] == ["with"]
+
+
+def test_loop_cfg_exit_kinds():
+    loop = _first_loop("""
+        def f(xs, y):
+            for x in xs:
+                if x:
+                    continue
+                if y:
+                    break
+                step()
+    """)
+    cfg = loop_cfg(loop)
+    assert _exit_kinds(cfg) == ["break", "continue", "fallthrough"]
+
+
+def test_loop_cfg_return_keeps_its_kind():
+    loop = _first_loop("""
+        def f(xs):
+            for x in xs:
+                if x:
+                    return x
+                step()
+    """)
+    cfg = loop_cfg(loop)
+    assert _exit_kinds(cfg) == ["fallthrough", "return"]
+
+
+def test_build_cfg_empty_body_loop_mode():
+    cfg = build_cfg([], loop_body=True, anchor=ast.Pass())
+    assert _exit_kinds(cfg) == ["fallthrough"]
+
+
+# ---- finally inlining ----------------------------------------------------
+
+
+def test_finally_inlined_on_return_path():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            try:
+                if x:
+                    return 1
+                work()
+            finally:
+                cleanup()
+            return 2
+    """))
+    states = _exit_states(cfg, _Calls(may=False))
+    assert len(states["return"]) == 2
+    for st in states["return"]:
+        assert "cleanup" in st
+
+
+def test_finally_inlined_on_raise_path():
+    cfg = function_cfg(_fn("""
+        def f():
+            try:
+                raise ValueError("boom")
+            finally:
+                cleanup()
+    """))
+    states = _exit_states(cfg, _Calls(may=False))
+    (st,) = states["raise"]
+    assert "cleanup" in st
+
+
+def test_finally_inlined_on_continue_path_in_loop_mode():
+    loop = _first_loop("""
+        def f(xs):
+            for x in xs:
+                try:
+                    if x:
+                        continue
+                finally:
+                    rearm()
+                tail()
+    """)
+    cfg = loop_cfg(loop)
+    states = _exit_states(cfg, _Calls(may=False))
+    (st,) = states["continue"]
+    assert "rearm" in st
+    (st,) = states["fallthrough"]
+    assert {"rearm", "tail"} <= st
+
+
+def test_nested_finallies_both_run_on_return():
+    cfg = function_cfg(_fn("""
+        def f():
+            try:
+                try:
+                    return 1
+                finally:
+                    inner()
+            finally:
+                outer()
+    """))
+    states = _exit_states(cfg, _Calls(may=False))
+    (st,) = states["return"]
+    assert {"inner", "outer"} <= st
+
+
+# ---- fixpoint lattices ---------------------------------------------------
+
+
+def test_may_join_is_union_across_branches():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            if x:
+                a()
+            else:
+                b()
+            return 1
+    """))
+    states = _exit_states(cfg, _Calls(may=True))
+    (st,) = states["return"]
+    assert {"a", "b"} <= st
+
+
+def test_must_join_is_intersection_across_branches():
+    cfg = function_cfg(_fn("""
+        def f(x):
+            if x:
+                a()
+            else:
+                a()
+                b()
+            return 1
+    """))
+    states = _exit_states(cfg, _Calls(may=False))
+    (st,) = states["return"]
+    assert "a" in st
+    assert "b" not in st
+
+
+def test_must_join_handler_path_drops_unguaranteed_facts():
+    cfg = function_cfg(_fn("""
+        def f():
+            try:
+                a()
+                b()
+            except Exception:
+                pass
+            return 1
+    """))
+    states = _exit_states(cfg, _Calls(may=False))
+    (st,) = states["return"]
+    # `a` ran on every path in (any raise happens after it completes);
+    # `b` may have been skipped by a raise into the handler.
+    assert "a" in st
+    assert "b" not in st
+
+
+def test_may_fact_flows_around_loop_back_edge():
+    cfg = function_cfg(_fn("""
+        def f(xs):
+            for x in xs:
+                mark()
+            return 1
+    """))
+    flow = _Calls(may=True)
+    flow.run(cfg)
+    # The loop-body entry block (holding the `for` Bind) must see
+    # `mark` in its in-state on the converged solution: the fact
+    # travels the back edge.
+    body_entry = next(
+        b for b in cfg.blocks
+        if any(
+            isinstance(e, Bind) and e.kind == "for" for e in b.elems
+        )
+    )
+    assert "mark" in flow.in_states[body_entry.id]
+
+
+def test_replay_yields_pre_transfer_states():
+    cfg = function_cfg(_fn("""
+        def f():
+            a()
+            b()
+    """))
+    flow = _Calls(may=True)
+    flow.run(cfg)
+    block = next(b for b in cfg.blocks if b.elems)
+    pairs = list(flow.replay(block))
+    assert len(pairs) == 2
+    (e0, s0), (e1, s1) = pairs
+    assert s0 == frozenset()
+    assert s1 == frozenset({"a"})
+
+
+def test_exit_state_none_for_unreached_block():
+    cfg = function_cfg(_fn("""
+        def f():
+            return 1
+    """))
+    flow = _Calls(may=True)
+    flow.run(cfg)
+    orphan = object.__new__(type(cfg.blocks[0]))
+    orphan.id = 10_000
+    orphan.elems = []
+    orphan.succs = []
+    assert flow.exit_state(orphan) is None
+
+
+class _GK(GenKill):
+    """Rebind semantics: an Assign kills the target fact and gens a
+    fresh one; gen observes the PRE-kill state."""
+
+    may = True
+
+    def __init__(self):
+        self.saw_prekill = False
+
+    def gen(self, elem, state):
+        if isinstance(elem, ast.Assign):
+            if ("x", "old") in state:
+                self.saw_prekill = True
+            return {("x", "new")}
+        return ()
+
+    def kill(self, elem, state):
+        if isinstance(elem, ast.Assign):
+            return {("x", "old")}
+        return ()
+
+
+def test_genkill_gen_observes_prekill_state():
+    gk = _GK()
+    out = gk.transfer(
+        ast.parse("x = 1").body[0], frozenset({("x", "old")})
+    )
+    assert gk.saw_prekill
+    assert out == frozenset({("x", "new")})
+
+
+def test_genkill_over_cfg_rebind_replaces_fact():
+    cfg = function_cfg(_fn("""
+        def f():
+            x = 1
+            return x
+    """))
+    gk = _GK()
+    states = _exit_states(cfg, gk)
+    (st,) = states["return"]
+    assert ("x", "new") in st
+    assert ("x", "old") not in st
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -271,7 +271,8 @@ def test_cli_list_rules_names_all_rules():
     assert out.returncode == 0
     for rule in ("lock-discipline", "lock-order", "atomicity",
                  "use-after-donate", "host-sync", "recompile-hazard",
-                 "metric-name", "swallowed-exception"):
+                 "metric-name", "swallowed-exception", "key-linearity",
+                 "terminal-path", "replay-taint"):
         assert rule in out.stdout
 
 
@@ -286,6 +287,74 @@ def test_cli_max_suppressions_ratchet(tmp_path):
     assert "exceed the --max-suppressions ratchet" in (
         over.stdout + over.stderr
     )
+
+
+def test_cli_json_per_rule_breakdown():
+    """The JSON artifact carries a per-rule finding/suppression
+    breakdown so the CI ratchet can pin individual rules."""
+    out = _cli("--json", str(FIXTURES / "keylin_pos.py"),
+               str(FIXTURES / "keylin_suppressed.py"))
+    assert out.returncode == 1  # the pos fixture's findings
+    payload = json.loads(out.stdout)
+    br = payload["by_rule"]["key-linearity"]
+    assert br["findings"] == len(
+        expected_findings(FIXTURES / "keylin_pos.py")
+    )
+    assert br["suppressed"] == 1
+
+
+def test_cli_max_suppressions_per_rule():
+    """`--max-suppressions-per-rule RULE=N` pins a single rule's
+    escape count independently of the global ratchet."""
+    path = FIXTURES / "taint_suppressed.py"
+    ok = _cli(str(path), "--max-suppressions-per-rule", "replay-taint=1")
+    assert ok.returncode == 0, (ok.stdout, ok.stderr)
+    # Pinning an unrelated rule at 0 doesn't trip on this file...
+    other = _cli(str(path), "--max-suppressions-per-rule",
+                 "key-linearity=0")
+    assert other.returncode == 0, (other.stdout, other.stderr)
+    # ...but pinning the suppressed rule at 0 does.
+    over = _cli(str(path), "--max-suppressions-per-rule",
+                "replay-taint=0")
+    assert over.returncode == 1
+    assert "per-rule ratchet" in over.stdout + over.stderr
+    # Malformed or unknown specs are a usage error, not a silent pass.
+    bad = _cli(str(path), "--max-suppressions-per-rule", "replay-taint")
+    assert bad.returncode != 0
+    unknown = _cli(str(path), "--max-suppressions-per-rule",
+                   "no-such-rule=0")
+    assert unknown.returncode != 0
+
+
+def test_cli_time_budget_gate(monkeypatch):
+    """`--time-budget` compares the lint wall time against the budget
+    via the runner._monotonic seam (monkeypatched to a fake clock so
+    the test is deterministic)."""
+    from oryx_tpu.analysis import runner
+
+    ticks = iter([100.0, 107.5])
+    monkeypatch.setattr(runner, "_monotonic", lambda: next(ticks))
+    rc = runner.main(
+        [str(FIXTURES / "donate_clean.py"), "--time-budget", "5.0"]
+    )
+    assert rc == 1
+    ticks = iter([100.0, 100.9])
+    monkeypatch.setattr(runner, "_monotonic", lambda: next(ticks))
+    rc = runner.main(
+        [str(FIXTURES / "donate_clean.py"), "--time-budget", "5.0"]
+    )
+    assert rc == 0
+
+
+def test_cli_time_budget_within_budget_for_real(capsys):
+    """The repo-wide CI gate: a full lint run must fit the 5s budget
+    (run in-process against the real tree; generous margin is the
+    point — the gate exists to catch fixpoint blowups, not jitter)."""
+    from oryx_tpu.analysis import runner
+
+    rc = runner.main(["--strict", "--time-budget", "5.0"])
+    capsys.readouterr()
+    assert rc == 0
 
 
 def test_cli_json_out_writes_artifact(tmp_path):
@@ -342,6 +411,15 @@ def test_changed_files_widens_on_linter_or_fixture_change(monkeypatch):
         fake_run(["scripts/run_oryxlint.py"]),
     )
     assert runner.changed_files(str(ROOT)) is None
+    # The dataflow-tier fixtures are in the map too: touching any of
+    # them must widen exactly like touching their rule module.
+    for fixture in ("tests/lint_fixtures/keylin_pos.py",
+                    "tests/lint_fixtures/obligation_suppressed.py",
+                    "tests/lint_fixtures/taint_clean.py"):
+        monkeypatch.setattr(
+            runner.subprocess, "run", fake_run([fixture])
+        )
+        assert runner.changed_files(str(ROOT)) is None, fixture
 
 
 def test_fixture_rule_map_covers_every_fixture_prefix():

@@ -422,3 +422,41 @@ def test_dispatch_metrics_split_mode(pipe):
     text = sm.render()
     assert "oryx_serving_dispatches_total" in text
     assert "oryx_serving_dispatch_rows_bucket" in text
+
+
+def test_autotune_synthetic_operands_draw_independent_keys():
+    """Regression for the autotune key-reuse defect (oryxlint
+    key-linearity self-application, finding at
+    oryx_tpu/ops/pallas/paged_attention.py:395): `autotune_ragged_grid`
+    drew its synthetic q AND its synthetic KV pages from the same
+    `jax.random.key(0)`, so the operands the candidate grids are timed
+    against shared their key material. The fix splits the seed into
+    independent subkeys; this test runs the key-linearity dataflow over
+    the real module so the shape cannot come back, and proves the guard
+    is live by linting the pre-fix construction."""
+    import pathlib
+
+    from oryx_tpu.analysis import make_checkers, run_lint
+
+    path = pathlib.Path(ppa.__file__.replace(".pyc", ".py"))
+    res = run_lint(
+        [(str(path), path.read_text())],
+        make_checkers("key-linearity"),
+    )
+    assert [f.line for f in res.findings] == []
+    old_shape = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def autotune(head_dim):\n"
+        "    key_ = jax.random.key(0)\n"
+        "    q = jax.random.normal(key_, (16, 8, head_dim), jnp.float32)\n"
+        "    kp = jax.random.normal(key_, (64, 16, 8, head_dim), jnp.float32)\n"
+        "    return q, kp\n"
+    )
+    res = run_lint(
+        [("autotune_defect.py", old_shape)],
+        make_checkers("key-linearity"),
+    )
+    assert [(f.line, f.rule) for f in res.findings] == [
+        (6, "key-linearity")
+    ]

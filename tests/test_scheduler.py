@@ -482,6 +482,35 @@ def test_cancelled_in_queue_gets_zero_cost_ledger(pipe):
     assert h2.debug["cost"] == cost
 
 
+def test_cancelled_in_queue_increments_cancelled_counter(pipe):
+    """Regression for the queue-cancel undercount (oryxlint
+    terminal-path obligation finding on scheduler.py `_cancel_queued`:
+    `cancelled` undischarged): the pre-admission cancel path finalized
+    the ledger and emitted the wide event but skipped
+    `metrics.inc("cancelled")`, so the counter only saw the three
+    slot-holding cancel paths and queue cancels undercounted. All four
+    cancel exits now route through `_cancel_queued`/`_cancel_slot`,
+    each carrying a machine-checked `# obligations:` set."""
+    import time as time_lib
+
+    metrics = ServingMetrics()
+    sched = ContinuousScheduler(
+        pipe, num_slots=1, page_size=16, chunk=4, max_ctx=512,
+        metrics=metrics, autostart=False,
+    )
+    h1 = sched.submit({"question": "hello there"}, 3)
+    h2 = sched.submit({"question": "tell me more"}, 3)
+    h2.cancelled = True  # client hung up while queued behind h1
+    sched.start()
+    assert h1.result(timeout=600)[0]
+    for _ in range(200):  # the engine pops h2 at a later loop pass
+        if h2.trace.done:
+            break
+        time_lib.sleep(0.05)
+    sched.close()
+    assert metrics.get("cancelled") == 1
+
+
 def test_queued_deadline_rejection_carries_cost_ledger(pipe):
     """Review fix: a request that dies while still QUEUED (deadline
     expired before admission) is a terminal path too — its ledger

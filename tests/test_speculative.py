@@ -177,14 +177,16 @@ def test_spec_verify_greedy_longest_prefix():
     acc, cand, _ = _verify(lg, [9], [[2, 4, 1]], [3], keys, 0.0)
     assert (int(acc[0]), int(cand[0])) == (3, 5)
     # Mismatch at lane 1: accept 1, bonus = lane-1 argmax (the token
-    # sequential decode would have produced there).
-    acc, cand, _ = _verify(lg, [9], [[2, 9, 1]], [3], keys, 0.0)
+    # sequential decode would have produced there). Key reuse across
+    # these calls is the point: each verifies a different proposal
+    # against the SAME frozen sampling state.
+    acc, cand, _ = _verify(lg, [9], [[2, 9, 1]], [3], keys, 0.0)  # oryxlint: disable=key-linearity
     assert (int(acc[0]), int(cand[0])) == (1, 4)
     # draft_len masks trailing lanes even when they would match.
-    acc, cand, _ = _verify(lg, [9], [[2, 4, 1]], [1], keys, 0.0)
+    acc, cand, _ = _verify(lg, [9], [[2, 4, 1]], [1], keys, 0.0)  # oryxlint: disable=key-linearity
     assert (int(acc[0]), int(cand[0])) == (1, 4)
     # Zero proposals degenerate to the plain decode step.
-    acc, cand, _ = _verify(lg, [9], [[0, 0, 0]], [0], keys, 0.0)
+    acc, cand, _ = _verify(lg, [9], [[0, 0, 0]], [0], keys, 0.0)  # oryxlint: disable=key-linearity
     assert (int(acc[0]), int(cand[0])) == (0, 2)
 
 
@@ -199,8 +201,9 @@ def test_spec_verify_eos_truncation():
     # counts.
     acc, _, _ = _verify(lg, [9], [[2, eos, 1]], [3], keys, 0.0, eos=eos)
     assert int(acc[0]) == 2
-    # A fed EOS accepts nothing at all.
-    acc, _, _ = _verify(lg, [eos], [[2, eos, 1]], [3], keys, 0.0,
+    # A fed EOS accepts nothing at all (same keys: same frozen sampling
+    # state, different fed token — that contrast is the assertion).
+    acc, _, _ = _verify(lg, [eos], [[2, eos, 1]], [3], keys, 0.0,  # oryxlint: disable=key-linearity
                         eos=eos)
     assert int(acc[0]) == 0
 
